@@ -1,0 +1,74 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Round-1 metric: brute-force kNN throughput (QPS) on a synthetic SIFT-shaped
+dataset (100K x 128 fp32, k=10, 10K queries), recall-gated at >=0.95 against
+the exact top-k path (the reference's QPS@recall methodology,
+docs/source/raft_ann_benchmarks.md:420-438). Uses the fused
+distance+approx-top-k pipeline (TPU-KNN-paper style partial reduce).
+
+vs_baseline anchors to the north-star throughput target in BASELINE.md
+(IVF-PQ on SIFT-1B: >=1M QPS on v5e-64): vs_baseline = QPS / 1e6 on ONE chip.
+
+Timing note: on the tunneled TPU platform, dispatch overhead is ~70ms/call and
+block_until_ready does not synchronize; we amortize by dispatching R calls
+back-to-back and forcing completion with a scalar host fetch.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.neighbors import brute_force
+
+N, DIM, Q, K = 100_000, 128, 10_000, 10
+NORTH_STAR_QPS = 1e6
+REPS = 10
+
+
+def main():
+    key = jax.random.key(0)
+    kd, kq = jax.random.split(key)
+    dataset = jax.random.normal(kd, (N, DIM), jnp.float32)
+    queries = jax.random.normal(kq, (Q, DIM), jnp.float32)
+
+    index = brute_force.build(dataset, metric="sqeuclidean")
+
+    def run(qs):
+        return brute_force.search(index, qs, K, select_algo="approx")
+
+    # warm / compile, force completion via host fetch
+    v, i = run(queries)
+    float(jnp.sum(v))
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        v, i = run(queries)
+    float(jnp.sum(v))  # drains the dispatch queue
+    dt = (time.perf_counter() - t0) / REPS
+    qps = Q / dt
+
+    # recall gate vs exact search
+    v_ex, i_ex = brute_force.search(index, queries, K, select_algo="exact")
+    got, want = np.asarray(i), np.asarray(i_ex)
+    recall = np.mean(
+        [len(set(got[r]) & set(want[r])) / K for r in range(0, Q, 13)]
+    )
+    assert recall >= 0.95, f"recall {recall:.3f} < 0.95"
+
+    print(
+        json.dumps(
+            {
+                "metric": "brute_force_knn_qps_100k_128_k10_recall>=0.95",
+                "value": round(qps, 1),
+                "unit": "QPS",
+                "vs_baseline": round(qps / NORTH_STAR_QPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""raft_tpu — TPU-native vector-search & ML-primitives framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of RAPIDS RAFT
+(reference: cpp/include/raft/** at yinze00/raft v24.02): dense/sparse primitives,
+clustering, ANN indexes (brute-force, IVF-Flat, IVF-PQ, CAGRA-style graph), and a
+multi-chip distributed layer over XLA collectives.
+
+Design principles (TPU-first, not a port):
+  * static shapes everywhere — variable-length CUDA constructs (interleaved IVF
+    lists, device hashmaps) become padded/bucketed dense layouts + validity masks;
+  * matmul-dominant formulations so the MXU does the FLOPs (expanded distances,
+    one-hot matmul gathers);
+  * `jax.lax` control flow under jit; Pallas kernels for ops XLA won't fuse well;
+  * multi-chip via `jax.sharding.Mesh` + `shard_map` collectives (psum/all_gather/
+    ppermute) in place of NCCL/UCX (reference cpp/include/raft/comms/).
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources, current_resources, use_resources
+
+__all__ = [
+    "Resources",
+    "current_resources",
+    "use_resources",
+    "__version__",
+]

@@ -1,0 +1,37 @@
+"""Core runtime: execution context, serialization, logging, bitsets.
+
+TPU-native analog of the reference's core layer (cpp/include/raft/core/):
+`raft::resources` / `device_resources` (core/resources.hpp:47,
+core/device_resources.hpp:61) become :class:`Resources` — a lightweight context
+holding devices, the default sharding mesh, a PRNG key stream and workspace
+limits. mdspan/mdarray (core/mdarray.hpp:129) need no analog: `jax.Array` with
+row-major layout is the array vocabulary; helpers here cover what jnp doesn't
+(numpy-header serialization, packed bitsets, cooperative interruption).
+"""
+
+from raft_tpu.core.resources import Resources, current_resources, use_resources
+from raft_tpu.core.serialize import (
+    serialize_array,
+    deserialize_array,
+    save_arrays,
+    load_arrays,
+)
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.logger import get_logger
+from raft_tpu.core.interruptible import InterruptedException, check_interrupt, cancel, clear
+
+__all__ = [
+    "Resources",
+    "current_resources",
+    "use_resources",
+    "serialize_array",
+    "deserialize_array",
+    "save_arrays",
+    "load_arrays",
+    "Bitset",
+    "get_logger",
+    "InterruptedException",
+    "check_interrupt",
+    "cancel",
+    "clear",
+]

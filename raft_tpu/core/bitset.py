@@ -1,0 +1,77 @@
+"""Packed device bitset for search prefiltering.
+
+Reference: cpp/include/raft/core/bitset.cuh:147 — a device bitset consumed by
+`bitset_filter` (neighbors/sample_filter.cuh:31) to exclude dataset rows from
+ANN search. TPU design: a uint32-packed jnp array; the filter is applied
+vectorized (test of k candidate ids per query at once) rather than per-thread.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Bitset:
+    """Fixed-size bitset over ``[0, n_bits)`` packed into uint32 words."""
+
+    bits: jax.Array  # (ceil(n_bits/32),) uint32
+    n_bits: int
+
+    @classmethod
+    def create(cls, n_bits: int, default: bool = True) -> "Bitset":
+        n_words = (n_bits + 31) // 32
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        return cls(jnp.full((n_words,), fill, dtype=jnp.uint32), n_bits)
+
+    @classmethod
+    def from_mask(cls, mask) -> "Bitset":
+        """Build from a boolean vector (True = keep)."""
+        mask = jnp.asarray(mask, dtype=jnp.bool_)
+        n_bits = mask.shape[0]
+        n_words = (n_bits + 31) // 32
+        pad = n_words * 32 - n_bits
+        padded = jnp.concatenate([mask, jnp.zeros((pad,), jnp.bool_)]) if pad else mask
+        w = padded.reshape(n_words, 32).astype(jnp.uint32)
+        weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+        return cls((w * weights).sum(axis=1).astype(jnp.uint32), n_bits)
+
+    def test(self, ids: jax.Array) -> jax.Array:
+        """Vectorized membership test; out-of-range ids return False."""
+        ids = jnp.asarray(ids)
+        word = self.bits[jnp.clip(ids // 32, 0, self.bits.shape[0] - 1)]
+        bit = (word >> (ids % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        return (bit == 1) & (ids >= 0) & (ids < self.n_bits)
+
+    def set(self, ids, value: bool = True) -> "Bitset":
+        """Return a new bitset with ``ids`` set/cleared (functional update).
+
+        Duplicate ids are tolerated: the update goes through a boolean scatter
+        (idempotent), then repacks — O(n_bits) but branch-free under jit.
+        """
+        ids = jnp.asarray(ids)
+        touched = jnp.zeros((self.n_bits,), jnp.bool_).at[ids].set(True, mode="drop")
+        packed = Bitset.from_mask(touched).bits
+        if value:
+            return Bitset(self.bits | packed, self.n_bits)
+        return Bitset(self.bits & ~packed, self.n_bits)
+
+    def to_mask(self) -> jax.Array:
+        weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+        bits = ((self.bits[:, None] & weights) != 0).reshape(-1)
+        return bits[: self.n_bits]
+
+    def count(self) -> jax.Array:
+        return self.to_mask().sum()
+
+    # pytree protocol
+    def tree_flatten(self):
+        return (self.bits,), self.n_bits
+
+    @classmethod
+    def tree_unflatten(cls, n_bits, children):
+        return cls(children[0], n_bits)

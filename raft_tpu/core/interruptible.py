@@ -1,0 +1,44 @@
+"""Cooperative cross-thread cancellation.
+
+Reference: cpp/include/raft/core/interruptible.hpp:71-168 — a per-thread token
+that stream-synchronizing loops poll; `cancel()` from another thread raises
+`interrupted_exception` at the next synchronization point. The TPU analog:
+long-running *host-side* loops (k-means EM, NN-descent rounds, tiled batch
+queries) call :func:`check_interrupt` between device steps.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_flags: dict = {}
+_lock = threading.Lock()
+
+
+class InterruptedException(RuntimeError):
+    """Raised at the next check point after :func:`cancel` (the reference's
+    raft::interrupted_exception; named to avoid shadowing the builtin
+    InterruptedError, which is an OSError for EINTR)."""
+
+
+def _token(thread_id=None) -> int:
+    return thread_id if thread_id is not None else threading.get_ident()
+
+
+def cancel(thread_id=None) -> None:
+    """Request cancellation of ``thread_id`` (default: current thread)."""
+    with _lock:
+        _flags[_token(thread_id)] = True
+
+
+def clear(thread_id=None) -> None:
+    with _lock:
+        _flags.pop(_token(thread_id), None)
+
+
+def check_interrupt() -> None:
+    """Raise :class:`InterruptedException` if this thread was cancelled."""
+    tid = threading.get_ident()
+    with _lock:
+        if _flags.pop(tid, False):
+            raise InterruptedException(f"thread {tid} interrupted")

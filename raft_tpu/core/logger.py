@@ -1,0 +1,47 @@
+"""Logging — analog of the reference's spdlog-backed logger.
+
+Reference: cpp/include/raft/core/logger-inl.hpp:74-89 (callback sink so Python
+can capture C++ logs), logger-macros.hpp (RAFT_LOG_*). Here the whole stack is
+Python, so we use stdlib logging with the same capability: a process-wide named
+logger plus an optional callback sink.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+_LOGGER_NAME = "raft_tpu"
+
+
+class _CallbackHandler(logging.Handler):
+    def __init__(self, fn: Callable[[int, str], None]):
+        super().__init__()
+        self._fn = fn
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._fn(record.levelno, self.format(record))
+        except Exception:  # pragma: no cover - sink errors must not propagate
+            pass
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("[%(levelname)s] [%(name)s] %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.WARNING)
+    return logger
+
+
+def set_callback_sink(fn: Optional[Callable[[int, str], None]]) -> None:
+    """Install (or with None, remove) a callback sink — the analog of the
+    reference's log_callback for Python capture (core/logger-inl.hpp:74)."""
+    logger = get_logger()
+    for h in list(logger.handlers):
+        if isinstance(h, _CallbackHandler):
+            logger.removeHandler(h)
+    if fn is not None:
+        logger.addHandler(_CallbackHandler(fn))

@@ -1,0 +1,116 @@
+"""Execution context — the TPU-native analog of ``raft::resources``.
+
+Reference: cpp/include/raft/core/resources.hpp:47 (type-erased lazy resource
+registry), core/device_resources.hpp:61 (per-GPU specialization: stream, cuBLAS
+handles, workspace memory), core/resource/comms.hpp:64 (communicator injection).
+
+On TPU/JAX most of those resources are owned by the runtime (XLA manages streams,
+fusion replaces handle-based BLAS, the compiler manages workspace). What remains
+context-like is captured here:
+
+  * which devices / default `Mesh` to run on (the COMMUNICATOR analog);
+  * a splittable PRNG key stream (the RNG-state resource);
+  * workspace/tile-size budget used by tiled algorithms (the
+    WORKSPACE_RESOURCE analog, cpp core/resource/workspace_resource.hpp);
+  * default compute dtype for matmul-heavy paths (bf16-in/fp32-accum on MXU).
+
+A default global context is created lazily; `use_resources` scopes an override.
+All public APIs accept ``res=None`` and fall back to :func:`current_resources`,
+mirroring how every reference API takes ``(resources const&, ...)`` first.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Resources:
+    """Execution context for raft_tpu calls.
+
+    Attributes:
+      devices: devices to use; defaults to ``jax.devices()``.
+      mesh: optional default ``jax.sharding.Mesh`` for distributed algorithms.
+      key: base PRNG key; ``next_key()`` splits from it statefully (the analog
+        of the mutable ``rng_state`` resource, reference random/rng_state.hpp:28).
+      workspace_bytes: soft budget tiled algorithms use to pick tile sizes
+        (analog of the workspace memory resource / batch sizing in
+        neighbors/detail/knn_brute_force.cuh:78-91).
+      compute_dtype: dtype fed to the MXU for distance matmuls. fp32 inputs are
+        cast to this for the gemm, with fp32 accumulation.
+    """
+
+    devices: Sequence[jax.Device] = field(default_factory=jax.devices)
+    mesh: Optional[jax.sharding.Mesh] = None
+    key: jax.Array = None  # type: ignore[assignment]
+    workspace_bytes: int = 1 << 30
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.key is None:
+            self.key = jax.random.key(0)
+        self._key_lock = threading.Lock()
+
+    # -- PRNG stream -------------------------------------------------------
+    def next_key(self) -> jax.Array:
+        """Split and return a fresh PRNG key (stateful, like rng_state advance;
+        locked — the global default Resources is shared across threads)."""
+        with self._key_lock:
+            self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def with_seed(self, seed: int) -> "Resources":
+        return replace(self, key=jax.random.key(seed))
+
+    # -- device helpers ----------------------------------------------------
+    @property
+    def device(self) -> jax.Device:
+        return self.devices[0]
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def default_mesh(self, axis_name: str = "data") -> jax.sharding.Mesh:
+        """The mesh to run distributed algorithms over (1-D over all devices
+        unless an explicit mesh was installed — the `set_comms` analog)."""
+        if self.mesh is not None:
+            return self.mesh
+        return jax.sharding.Mesh(list(self.devices), (axis_name,))
+
+
+_tls = threading.local()
+_default_lock = threading.Lock()
+_default: Optional[Resources] = None
+
+
+def current_resources() -> Resources:
+    """Return the innermost scoped Resources, or the lazily-created global one."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Resources()
+    return _default
+
+
+@contextlib.contextmanager
+def use_resources(res: Resources):
+    """Scope ``res`` as the current context within the ``with`` block."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(res)
+    try:
+        yield res
+    finally:
+        stack.pop()

@@ -1,0 +1,79 @@
+"""Array + container serialization with numpy-compatible headers.
+
+Reference: cpp/include/raft/core/serialize.hpp:36-126 serializes mdspans to an
+iostream with a numpy-format dtype header so host tools can read device dumps.
+Here we serialize `jax.Array`/`numpy` arrays as standard ``.npy`` payloads inside
+a tiny tagged container, so a file written by raft_tpu is readable with plain
+numpy — the same interop goal.
+
+Container format (used by every index's serialize/deserialize — the analog of
+neighbors/{ivf_flat,ivf_pq,cagra,brute_force}_serialize.cuh):
+
+    magic  b"RAFTTPU\\0"  (8 bytes)
+    version uint32 LE
+    meta_len uint64 LE, meta = UTF-8 JSON (scalar params, dtype names, order)
+    for each array in meta["arrays"]: a standard .npy blob, in order
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import Any, Dict, Mapping, Tuple
+
+import jax
+import numpy as np
+
+_MAGIC = b"RAFTTPU\x00"
+_VERSION = 1
+
+
+def serialize_array(stream: io.IOBase, arr) -> None:
+    """Write one array as a standard .npy blob (numpy-header format parity with
+    reference serialize_mdspan, core/serialize.hpp:91)."""
+    np.save(stream, np.asarray(arr), allow_pickle=False)
+
+
+def deserialize_array(stream: io.IOBase) -> np.ndarray:
+    return np.load(stream, allow_pickle=False)
+
+
+def save_arrays(path_or_stream, meta: Mapping[str, Any], arrays: Mapping[str, Any]) -> None:
+    """Save a JSON-meta + named-array container (index checkpoint format)."""
+    own = isinstance(path_or_stream, (str, bytes, os.PathLike))
+    stream = open(path_or_stream, "wb") if own else path_or_stream
+    try:
+        meta = dict(meta)
+        meta["arrays"] = list(arrays.keys())
+        blob = json.dumps(meta).encode("utf-8")
+        stream.write(_MAGIC)
+        stream.write(struct.pack("<I", _VERSION))
+        stream.write(struct.pack("<Q", len(blob)))
+        stream.write(blob)
+        for name in meta["arrays"]:
+            serialize_array(stream, arrays[name])
+    finally:
+        if own:
+            stream.close()
+
+
+def load_arrays(path_or_stream) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Load a container written by :func:`save_arrays`."""
+    own = isinstance(path_or_stream, (str, bytes, os.PathLike))
+    stream = open(path_or_stream, "rb") if own else path_or_stream
+    try:
+        magic = stream.read(8)
+        if magic != _MAGIC:
+            raise ValueError(f"bad magic {magic!r}: not a raft_tpu container")
+        (version,) = struct.unpack("<I", stream.read(4))
+        if version > _VERSION:
+            raise ValueError(f"unsupported container version {version}")
+        (meta_len,) = struct.unpack("<Q", stream.read(8))
+        meta = json.loads(stream.read(meta_len).decode("utf-8"))
+        arrays = {name: deserialize_array(stream) for name in meta["arrays"]}
+        return meta, arrays
+    finally:
+        if own:
+            stream.close()

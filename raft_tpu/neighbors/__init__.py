@@ -1,0 +1,20 @@
+"""ANN indexes — the crown jewels (reference cpp/include/raft/neighbors/).
+
+Re-designed TPU-first:
+  * `brute_force` — tiled exact kNN (reference brute_force-inl.cuh:157,
+    detail/knn_brute_force.cuh:61): gemm distances + streaming top-k merge
+    under `lax.scan`, out-of-core over dataset tiles.
+  * `ivf_flat` — padded/bucketed dense cluster lists + validity masks in place
+    of the CUDA interleaved-group layout (ivf_flat_types.hpp:47).
+  * `ivf_pq` — PQ codebooks + LUT scan (the flagship kernel), bf16/int8 LUT
+    compression as the fp8 analog (detail/ivf_pq_fp_8bit.cuh).
+  * `cagra` — fixed-degree graph + fixed-iteration best-first search with
+    sort-based dedup instead of device hashmaps (detail/cagra/hashmap.hpp).
+  * `refine` — exact re-ranking of candidate lists (refine-inl.cuh:70).
+All share the filter protocol (`Bitset` prefilter, sample_filter.cuh:31) and
+container serialization (core/serialize.py).
+"""
+
+from raft_tpu.neighbors import brute_force
+
+__all__ = ["brute_force"]

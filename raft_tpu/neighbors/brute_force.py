@@ -1,0 +1,220 @@
+"""Brute-force (exact) k-nearest-neighbor search, tiled for out-of-core scale.
+
+Reference: raft::neighbors::brute_force (brute_force-inl.cuh:157 knn, :337
+build, :417 search) and the tiled engine tiled_brute_force_knn
+(neighbors/detail/knn_brute_force.cuh:61): pick a memory-bounded tile, compute
+pairwise distances per tile, select_k per tile, then merge partial results
+(knn_merge_parts.cuh:140).
+
+TPU design: the dataset is reshaped into static tiles and the whole
+tile-scan-merge loop is a single `lax.scan` under jit — XLA pipelines the gemm
+of tile i+1 against the top-k merge of tile i (the stream-overlap analog).
+Distances ride the MXU via the expanded forms; dataset norms are precomputed at
+build time (brute_force_types.hpp:50 stores norms for the same reason).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops.select_k import select_k
+from raft_tpu.utils.tiling import ceil_div, pad_and_tile, pad_rows
+
+# Metrics where larger is better (search selects max instead of min).
+_MAX_METRICS = frozenset({"inner_product"})
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class BruteForceIndex:
+    """Exact-search index: the dataset plus precomputed row norms
+    (brute_force_types.hpp:50 analog)."""
+
+    dataset: jax.Array  # (n, dim)
+    norms: Optional[jax.Array]  # (n,) L2^2 norms, only for expanded metrics
+    metric: str
+    metric_arg: float = 2.0
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    def tree_flatten(self):
+        return (self.dataset, self.norms), (self.metric, self.metric_arg)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    # -- persistence (brute_force_serialize.cuh analog) --------------------
+    def save(self, path) -> None:
+        arrays = {"dataset": self.dataset}
+        if self.norms is not None:
+            arrays["norms"] = self.norms
+        save_arrays(
+            path,
+            {"kind": "brute_force", "metric": self.metric, "metric_arg": self.metric_arg},
+            arrays,
+        )
+
+    @classmethod
+    def load(cls, path) -> "BruteForceIndex":
+        meta, arrays = load_arrays(path)
+        if meta.get("kind") != "brute_force":
+            raise ValueError(f"not a brute_force index: {meta.get('kind')}")
+        norms = jnp.asarray(arrays["norms"]) if "norms" in arrays else None
+        return cls(jnp.asarray(arrays["dataset"]), norms, meta["metric"], meta.get("metric_arg", 2.0))
+
+
+def build(dataset, metric: str = "sqeuclidean", metric_arg: float = 2.0,
+          res: Optional[Resources] = None) -> BruteForceIndex:
+    """Build = store dataset + precompute norms (brute_force-inl.cuh:337)."""
+    del res
+    metric = dist_mod.canonical_metric(metric)
+    dataset = jnp.asarray(dataset)
+    norms = None
+    if metric in ("sqeuclidean", "euclidean", "cosine"):
+        norms = jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1)
+    return BruteForceIndex(dataset, norms, metric, metric_arg)
+
+
+def _tile_distances(queries, tile, tile_norms, metric, metric_arg, compute_dtype, precision=None):
+    """Distances of all queries against one dataset tile, reusing precomputed
+    tile norms for the expanded metrics."""
+    if metric in ("sqeuclidean", "euclidean"):
+        ip = dist_mod.matmul_t(queries, tile, compute_dtype, precision)
+        qn = jnp.sum(queries * queries, axis=1, dtype=jnp.float32)
+        d = jnp.maximum(qn[:, None] + tile_norms[None, :] - 2.0 * ip, 0.0)
+        return jnp.sqrt(d) if metric == "euclidean" else d
+    if metric == "cosine":
+        ip = dist_mod.matmul_t(queries, tile, compute_dtype, precision)
+        qn = jnp.sqrt(jnp.sum(queries * queries, axis=1, dtype=jnp.float32))
+        tn = jnp.sqrt(tile_norms)
+        return 1.0 - ip / jnp.maximum(qn[:, None] * tn[None, :], 1e-30)
+    if metric == "inner_product":
+        return dist_mod.matmul_t(queries, tile, compute_dtype, precision)
+    if metric in dist_mod.EXPANDED_METRICS:
+        return dist_mod._expanded_distance(queries, tile, metric, compute_dtype, precision)
+    if metric == "haversine":
+        return dist_mod.haversine(queries, tile)
+    return dist_mod._elementwise_tile(queries, tile, metric, metric_arg)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "metric_arg", "tile_rows", "select_algo", "compute_dtype"),
+)
+def _search_impl(queries, dataset, norms, filter_bits, k, metric, metric_arg,
+                 tile_rows, select_algo, compute_dtype):
+    n, dim = dataset.shape
+    q = queries.shape[0]
+    select_min = metric not in _MAX_METRICS
+    bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
+
+    tiles, n_tiles = pad_and_tile(dataset, tile_rows)
+    tnorms = (
+        pad_and_tile(norms, tile_rows)[0]
+        if norms is not None
+        else jnp.zeros((n_tiles, tile_rows), jnp.float32)
+    )
+
+    def step(_, inp):
+        tile, tn, start = inp
+        d = _tile_distances(queries, tile, tn, metric, metric_arg, compute_dtype)
+        ids = start + jnp.arange(tile_rows, dtype=jnp.int32)
+        valid = ids < n
+        if filter_bits is not None:
+            word = filter_bits[jnp.clip(ids // 32, 0, filter_bits.shape[0] - 1)]
+            keep = ((word >> (ids % 32).astype(jnp.uint32)) & jnp.uint32(1)) == 1
+            valid = valid & keep
+        d = jnp.where(valid[None, :], d, bad)
+        # per-tile top-k, fused with the distance gemm (never materializes the
+        # full tile distance matrix to HBM)
+        vals, sel = select_k(d, k, select_min=select_min, algo=select_algo)
+        sel_ids = jnp.where(vals == bad, -1, jnp.take(ids, sel))
+        return None, (vals, sel_ids)
+
+    starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile_rows
+    if n_tiles == 1:
+        _, (vals, idx) = step(None, (tiles[0], tnorms[0], starts[0]))
+        return vals, idx
+    # scan over dataset tiles, then one exact merge over n_tiles*k candidates
+    # per query (knn_merge_parts analog, knn_merge_parts.cuh:140)
+    _, (tile_vals, tile_idx) = lax.scan(step, None, (tiles, tnorms, starts))
+    cat_vals = jnp.moveaxis(tile_vals, 0, 1).reshape(q, n_tiles * k)
+    cat_idx = jnp.moveaxis(tile_idx, 0, 1).reshape(q, n_tiles * k)
+    return select_k(cat_vals, k, select_min=select_min, indices=cat_idx, algo="exact")
+
+
+def search(
+    index: BruteForceIndex,
+    queries,
+    k: int,
+    filter: Optional[Bitset] = None,
+    tile_rows: Optional[int] = None,
+    select_algo: str = "exact",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN of each query row: returns (distances (q,k), indices (q,k)).
+
+    Mirrors brute_force::search (brute_force-inl.cuh:417) with the tiled merge
+    engine of detail/knn_brute_force.cuh:61. ``filter`` excludes dataset rows
+    (bitset_filter analog, sample_filter.cuh:31).
+    """
+    res = res or current_resources()
+    queries = jnp.asarray(queries)
+    n = index.size
+    if filter is not None and filter.n_bits != n:
+        raise ValueError(
+            f"filter covers {filter.n_bits} bits but index has {n} rows"
+        )
+    if tile_rows is None:
+        # Budget: mirrors chooseTileSize (knn_brute_force.cuh:84). Expanded
+        # metrics materialize a (q, tile) fp32 distance block; elementwise
+        # metrics additionally broadcast a (q, tile, dim) intermediate.
+        q = queries.shape[0]
+        if index.metric in dist_mod.EXPANDED_METRICS:
+            per_col = max(1, q * 4 + index.dim * 4)
+        else:
+            per_col = max(1, q * index.dim * 4)
+        tile_rows = int(min(n, max(k, res.workspace_bytes // per_col)))
+    tile_rows = max(min(tile_rows, n), min(n, k))
+    filter_bits = filter.bits if filter is not None else None
+    return _search_impl(
+        queries,
+        index.dataset,
+        index.norms,
+        filter_bits,
+        int(k),
+        index.metric,
+        float(index.metric_arg),
+        int(tile_rows),
+        select_algo,
+        res.compute_dtype if index.metric in dist_mod.EXPANDED_METRICS else None,
+    )
+
+
+def knn(
+    queries,
+    dataset,
+    k: int,
+    metric: str = "sqeuclidean",
+    metric_arg: float = 2.0,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot exact kNN (brute_force-inl.cuh:157 analog)."""
+    return search(build(dataset, metric, metric_arg), queries, k, **kwargs)

@@ -1,0 +1,25 @@
+"""Dense compute primitives (L3 of the reference layer map, SURVEY.md §1).
+
+TPU-native re-designs of cpp/include/raft/{distance,matrix,linalg}:
+  * `distance` — pairwise distances, 20 metrics (reference
+    distance/distance_types.hpp:26-66) as MXU-friendly gemm expansions where
+    possible, tiled VPU elementwise otherwise; fused L2 + argmin.
+  * `select_k` — top-k selection (reference matrix/select_k.cuh:84); exact
+    (sort-based `lax.top_k`) and TPU-optimized approximate (`lax.approx_min_k`,
+    the partial-reduce algorithm from the TPU-KNN paper) backends.
+  * `linalg` / `matrix` — reductions, norms, key'd reductions, gather/scatter,
+    row/col ops (reference linalg/*.cuh, matrix/*.cuh).
+"""
+
+from raft_tpu.ops import distance, linalg, matrix, select_k
+from raft_tpu.ops.distance import pairwise_distance, fused_l2_nn_argmin
+from raft_tpu.ops.select_k import select_k as select_k_fn
+
+__all__ = [
+    "distance",
+    "linalg",
+    "matrix",
+    "select_k",
+    "pairwise_distance",
+    "fused_l2_nn_argmin",
+]

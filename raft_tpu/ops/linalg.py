@@ -1,0 +1,167 @@
+"""Dense linear algebra + reductions (reference cpp/include/raft/linalg/).
+
+On TPU, most of the reference's hand-written reduction/map kernels are a single
+jnp expression that XLA fuses; what earns a real design here:
+  * key'd reductions as **one-hot matmuls** so they run on the MXU instead of
+    scatter-adds (reduce_rows_by_key.cuh / reduce_cols_by_key.cuh analogs) —
+    this is also the k-means centroid-update workhorse;
+  * gemm with explicit accumulation dtype (linalg/gemm.cuh:61 analog);
+  * decompositions (eig/QR/SVD/lstsq/rsvd: linalg/eig.cuh, rsvd.cuh) via
+    jnp.linalg with deterministic sign conventions (matrix/detail sign_flip).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gemm(a, b, transpose_a=False, transpose_b=False, alpha=1.0, beta=0.0, c=None):
+    """alpha * op(a) @ op(b) + beta * c with fp32 accumulation
+    (raft::linalg::gemm analog, linalg/gemm.cuh:61)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    ca = ((0,) if transpose_a else (1,), (1,) if transpose_b else (0,))
+    out = lax.dot_general(a, b, (ca, ((), ())), preferred_element_type=jnp.float32)
+    out = alpha * out
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out.astype(a.dtype)
+
+
+def dot(x, y):
+    return jnp.dot(x, y, preferred_element_type=jnp.float32)
+
+
+def axpy(alpha, x, y):
+    return alpha * x + y
+
+
+# -- norms / normalization (linalg/norm.cuh, normalize.cuh) -----------------
+
+_NORM_FNS = {
+    "l1": lambda x, ax: jnp.sum(jnp.abs(x), axis=ax),
+    "l2": lambda x, ax: jnp.sqrt(jnp.sum(x * x, axis=ax)),
+    "sql2": lambda x, ax: jnp.sum(x * x, axis=ax),
+    "linf": lambda x, ax: jnp.max(jnp.abs(x), axis=ax),
+}
+
+
+def norm(x, norm_type: str = "l2", axis: int = 1) -> jax.Array:
+    """Row (axis=1) or column (axis=0) norms."""
+    if norm_type not in _NORM_FNS:
+        raise ValueError(f"unknown norm {norm_type!r}")
+    return _NORM_FNS[norm_type](jnp.asarray(x), axis)
+
+
+def normalize(x, norm_type: str = "l2", axis: int = 1, eps: float = 1e-30) -> jax.Array:
+    n = norm(x, norm_type, axis)
+    n = jnp.maximum(n, eps)
+    return x / (n[:, None] if axis == 1 else n[None, :])
+
+
+# -- reductions (coalesced_reduction.cuh / strided_reduction.cuh) -----------
+
+
+def reduce(x, axis: int = 1, op: str = "sum", main_op=None):
+    """Generic row/col reduction; ``main_op`` maps elements first (the
+    reference's main_op/reduce_op functor composition, linalg/reduce.cuh)."""
+    x = jnp.asarray(x)
+    if main_op is not None:
+        x = main_op(x)
+    fns = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max, "mean": jnp.mean}
+    return fns[op](x, axis=axis)
+
+
+def reduce_rows_by_key(x, keys, n_keys: int) -> jax.Array:
+    """Sum rows of x (m,k) grouped by keys (m,) → (n_keys, k).
+
+    One-hot matmul formulation: out = onehot(keys).T @ x runs on the MXU —
+    the TPU answer to reduce_rows_by_key.cuh's atomic scatter kernel, and the
+    k-means calc_centers workhorse (cluster/detail/kmeans_balanced.cuh)."""
+    x = jnp.asarray(x)
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=x.dtype)  # (m, n_keys)
+    return lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+def reduce_cols_by_key(x, keys, n_keys: int) -> jax.Array:
+    """Sum columns of x (m,k) grouped by keys (k,) → (m, n_keys)."""
+    x = jnp.asarray(x)
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=x.dtype)  # (k, n_keys)
+    return lax.dot_general(
+        x, onehot, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+def bincount(keys, n_keys: int, weights=None, dtype=jnp.float32) -> jax.Array:
+    """Histogram of integer keys (static length, jit-safe)."""
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=dtype)
+    if weights is not None:
+        return (onehot * jnp.asarray(weights)[:, None]).sum(axis=0)
+    return onehot.sum(axis=0)
+
+
+def matrix_vector_op(x, v, axis: int = 1, op=jnp.add):
+    """Broadcast a vector along rows (axis=1: v has len k) or cols (axis=0)
+    (linalg/matrix_vector_op.cuh analog)."""
+    v = jnp.asarray(v)
+    return op(x, v[None, :] if axis == 1 else v[:, None])
+
+
+# -- decompositions (cuSOLVER-wrapper analogs) ------------------------------
+
+
+def sign_flip(u: jax.Array) -> jax.Array:
+    """Deterministic sign convention: flip each column so its max-|.| element
+    is positive (matrix/detail/math.cuh signFlip analog — makes eig/svd
+    reproducible across backends)."""
+    idx = jnp.argmax(jnp.abs(u), axis=0)
+    signs = jnp.sign(u[idx, jnp.arange(u.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return u * signs[None, :]
+
+
+def eig_dc(a) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition, ascending eigenvalues (linalg/eig.cuh)."""
+    w, v = jnp.linalg.eigh(a)
+    return w, sign_flip(v)
+
+
+def svd(a, full_matrices: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    u, s, vt = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return sign_flip(u), s, vt
+
+
+def qr(a) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(a)
+
+
+def lstsq(a, b) -> jax.Array:
+    """Least-squares solve via normal equations fallback-free SVD
+    (linalg/lstsq.cuh analog)."""
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+def rsvd(a, k: int, p: int = 10, n_iter: int = 4, key: Optional[jax.Array] = None):
+    """Randomized SVD (linalg/rsvd.cuh analog): range-finder with power
+    iterations; rank-k factors."""
+    if key is None:
+        key = jax.random.key(0)
+    m, n = a.shape
+    l = min(n, k + p)
+    omega = jax.random.normal(key, (n, l), dtype=a.dtype)
+    y = a @ omega
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):
+        q, _ = jnp.linalg.qr(a.T @ q)
+        q, _ = jnp.linalg.qr(a @ q)
+    b = q.T @ a
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return sign_flip(u[:, :k]), s[:k], vt[:k]

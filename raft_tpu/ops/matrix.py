@@ -1,0 +1,63 @@
+"""Matrix manipulation primitives (reference cpp/include/raft/matrix/).
+
+argmax/argmin, gather/scatter, slicing, per-row sort, linewise ops — each a
+fused XLA expression rather than a kernel. Kept as a module so the API surface
+mirrors the reference inventory (SURVEY.md §2.2) one-to-one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def argmax(x, axis: int = 1) -> jax.Array:
+    return jnp.argmax(jnp.asarray(x), axis=axis).astype(jnp.int32)
+
+
+def argmin(x, axis: int = 1) -> jax.Array:
+    return jnp.argmin(jnp.asarray(x), axis=axis).astype(jnp.int32)
+
+
+def gather(x, row_ids) -> jax.Array:
+    """Gather rows (matrix/gather.cuh analog)."""
+    return jnp.take(jnp.asarray(x), jnp.asarray(row_ids), axis=0)
+
+
+def scatter(x, row_ids, updates) -> jax.Array:
+    """Functional row scatter (matrix/scatter.cuh analog)."""
+    return jnp.asarray(x).at[jnp.asarray(row_ids)].set(jnp.asarray(updates))
+
+
+def slice_matrix(x, rows: Tuple[int, int], cols: Tuple[int, int]) -> jax.Array:
+    """Static submatrix view (matrix/slice.cuh analog)."""
+    return jnp.asarray(x)[rows[0] : rows[1], cols[0] : cols[1]]
+
+
+def sort_cols_per_row(x, ascending: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Sort values within each row, returning (sorted, permutation)
+    (matrix/col_wise_sort.cuh analog)."""
+    x = jnp.asarray(x)
+    idx = jnp.argsort(x, axis=1, descending=not ascending).astype(jnp.int32)
+    return jnp.take_along_axis(x, idx, axis=1), idx
+
+
+def linewise_op(x, vec, along_rows: bool = True, op=jnp.multiply) -> jax.Array:
+    """Apply op(x, vec) broadcasting vec along rows or columns
+    (matrix/linewise_op.cuh analog)."""
+    vec = jnp.asarray(vec)
+    return op(x, vec[None, :] if along_rows else vec[:, None])
+
+
+def copy(x) -> jax.Array:
+    return jnp.array(x, copy=True)
+
+
+def reverse(x, axis: int = 1) -> jax.Array:
+    return jnp.flip(jnp.asarray(x), axis=axis)
+
+
+def init_constant(shape, value, dtype=jnp.float32) -> jax.Array:
+    return jnp.full(shape, value, dtype=dtype)

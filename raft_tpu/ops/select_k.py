@@ -1,0 +1,90 @@
+"""Top-k selection — the load-bearing primitive for all ANN search.
+
+Reference: raft::matrix::select_k (matrix/select_k.cuh:84) with algorithm
+choices enumerated in matrix/select_k_types.hpp:36-66 — radix "AIR top-k"
+(detail/select_radix.cuh) and warp-sort (detail/select_warpsort.cuh).
+
+TPU design: radix select does not map to the VPU (no per-lane scatter/atomics);
+the idiomatic backends are
+  * ``"exact"`` — `lax.top_k` (XLA's sort-based top-k; exact, any k);
+  * ``"approx"`` — `lax.approx_min_k`/`approx_max_k`, the TPU partial-reduce
+    top-k from the TPU-KNN paper (PAPERS.md: "TPU-KNN: K Nearest Neighbor
+    Search at Peak FLOP/s") — ~recall_target accuracy at much higher
+    throughput; the right default inside ANN search pipelines where candidate
+    lists are over-fetched anyway.
+
+Both operate row-wise on a (batch, n) matrix, like the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "algo", "recall_target"))
+def _select_k_impl(values, k, select_min, algo, recall_target):
+    if algo == "approx":
+        if select_min:
+            vals, idx = lax.approx_min_k(values, k, recall_target=recall_target)
+        else:
+            vals, idx = lax.approx_max_k(values, k, recall_target=recall_target)
+    else:
+        if select_min:
+            neg_vals, idx = lax.top_k(-values, k)
+            vals = -neg_vals
+        else:
+            vals, idx = lax.top_k(values, k)
+    return vals, idx.astype(jnp.int32)
+
+
+def select_k(
+    values,
+    k: int,
+    select_min: bool = True,
+    indices=None,
+    algo: str = "exact",
+    recall_target: float = 0.95,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select k smallest (or largest) per row of ``values`` (batch, n).
+
+    Returns ``(selected_values, selected_indices)`` with int32 indices. If
+    ``indices`` (batch, n) is given, returned indices are gathered from it —
+    the candidate-id remap used by IVF search's two-stage select (reference
+    detail/ivf_flat_search-inl.cuh:130,194).
+
+    ``algo``: "exact" | "approx" (TPU partial-reduce; ``recall_target``
+    trades recall for speed).
+    """
+    values = jnp.asarray(values)
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[None, :]
+    if not 0 < k <= values.shape[-1]:
+        raise ValueError(f"k={k} out of range for n={values.shape[-1]}")
+    if algo not in ("exact", "approx"):
+        raise ValueError(f"unknown select_k algo {algo!r}")
+    vals, idx = _select_k_impl(values, int(k), bool(select_min), algo, float(recall_target))
+    if indices is not None:
+        indices = jnp.asarray(indices)
+        if squeeze and indices.ndim == 1:
+            indices = indices[None, :]
+        idx = jnp.take_along_axis(indices, idx, axis=1)
+    if squeeze:
+        return vals[0], idx[0]
+    return vals, idx
+
+
+def merge_topk(
+    vals_a, idx_a, vals_b, idx_b, select_min: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge two per-row top-k lists into one (the knn_merge_parts analog,
+    reference neighbors/detail/knn_merge_parts.cuh:140)."""
+    vals = jnp.concatenate([vals_a, vals_b], axis=-1)
+    idx = jnp.concatenate([idx_a, idx_b], axis=-1)
+    k = vals_a.shape[-1]
+    return select_k(vals, k, select_min=select_min, indices=idx)

@@ -1,0 +1,33 @@
+"""Random generation (reference cpp/include/raft/random/).
+
+The reference's counter-based Philox/PCG RNG (random/rng_state.hpp:28-33,
+rng_device.cuh) maps directly onto JAX's splittable threefry keys — both give
+reproducible, order-independent streams. Dataset generators re-designed on top:
+make_blobs (random/make_blobs.cuh:65), make_regression, permute,
+sample_without_replacement, multi-variable gaussian, and the RMAT rectangular
+graph generator (random/rmat_rectangular_generator.cuh:81).
+"""
+
+from raft_tpu.random.generators import (
+    RngState,
+    make_blobs,
+    make_regression,
+    multi_variable_gaussian,
+    permute,
+    rmat,
+    sample_without_replacement,
+    uniform,
+    normal,
+)
+
+__all__ = [
+    "RngState",
+    "make_blobs",
+    "make_regression",
+    "multi_variable_gaussian",
+    "permute",
+    "rmat",
+    "sample_without_replacement",
+    "uniform",
+    "normal",
+]

@@ -1,0 +1,158 @@
+"""RNG state + dataset/graph generators — see package docstring."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class RngState:
+    """Stateful convenience wrapper over a splittable key (the analog of the
+    mutable rng_state handed through reference APIs, random/rng_state.hpp:28)."""
+
+    def __init__(self, seed: int = 0):
+        self.key = jax.random.key(seed)
+
+    def split(self) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def _as_key(key_or_seed) -> jax.Array:
+    if isinstance(key_or_seed, RngState):
+        return key_or_seed.split()
+    if isinstance(key_or_seed, int):
+        return jax.random.key(key_or_seed)
+    return key_or_seed
+
+
+def uniform(key_or_seed, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_as_key(key_or_seed), shape, dtype, low, high)
+
+
+def normal(key_or_seed, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(_as_key(key_or_seed), shape, dtype)
+
+
+def permute(key_or_seed, n: int) -> jax.Array:
+    """Random permutation of [0, n) (random/permute.cuh analog)."""
+    return jax.random.permutation(_as_key(key_or_seed), n).astype(jnp.int32)
+
+
+def sample_without_replacement(key_or_seed, n_population: int, n_samples: int) -> jax.Array:
+    """Uniform sample of ``n_samples`` distinct ids from [0, n_population)
+    (random/sample_without_replacement.cuh analog)."""
+    key = _as_key(key_or_seed)
+    return jax.random.choice(
+        key, n_population, shape=(n_samples,), replace=False
+    ).astype(jnp.int32)
+
+
+def make_blobs(
+    key_or_seed,
+    n_rows: int,
+    n_cols: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Isotropic Gaussian blobs: (data (n_rows, n_cols), labels, centers)
+    (random/make_blobs.cuh:65 analog)."""
+    key = _as_key(key_or_seed)
+    k_centers, k_labels, k_noise = jax.random.split(key, 3)
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_cols), dtype, center_box[0], center_box[1]
+        )
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(k_labels, (n_rows,), 0, n_clusters).astype(jnp.int32)
+    noise = cluster_std * jax.random.normal(k_noise, (n_rows, n_cols), dtype)
+    return centers[labels] + noise, labels, centers
+
+
+def make_regression(
+    key_or_seed,
+    n_rows: int,
+    n_cols: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model regression data: (X, y, coef)
+    (random/make_regression.cuh analog)."""
+    key = _as_key(key_or_seed)
+    k_x, k_w, k_n = jax.random.split(key, 3)
+    n_informative = n_cols if n_informative is None else n_informative
+    x = jax.random.normal(k_x, (n_rows, n_cols), dtype)
+    coef = jnp.zeros((n_cols, n_targets), dtype)
+    coef = coef.at[:n_informative].set(
+        100.0 * jax.random.uniform(k_w, (n_informative, n_targets), dtype)
+    )
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(k_n, y.shape, dtype)
+    return x, jnp.squeeze(y), jnp.squeeze(coef)
+
+
+def multi_variable_gaussian(key_or_seed, mean, cov, n_samples: int) -> jax.Array:
+    """Samples from N(mean, cov) via Cholesky (random/multi_variable_gaussian.cuh)."""
+    key = _as_key(key_or_seed)
+    mean = jnp.asarray(mean)
+    return jax.random.multivariate_normal(
+        key, mean, jnp.asarray(cov), shape=(n_samples,), dtype=mean.dtype
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("r_scale", "c_scale", "n_edges"))
+def _rmat_impl(key, theta, r_scale, c_scale, n_edges):
+    # theta: (max_scale, 4) per-level quadrant probabilities (a, b, c, d).
+    max_scale = max(r_scale, c_scale)
+    keys = jax.random.split(key, max_scale)
+
+    def level(carry, inputs):
+        rows, cols = carry
+        lvl, k = inputs
+        p = theta[lvl]  # (4,)
+        q = jax.random.choice(k, 4, shape=(n_edges,), p=p)
+        r_bit = (q >= 2).astype(jnp.int32)  # quadrants c,d are lower half
+        c_bit = (q % 2).astype(jnp.int32)  # quadrants b,d are right half
+        rows = jnp.where(lvl < r_scale, rows * 2 + r_bit, rows)
+        cols = jnp.where(lvl < c_scale, cols * 2 + c_bit, cols)
+        return (rows, cols), None
+
+    init = (jnp.zeros((n_edges,), jnp.int32), jnp.zeros((n_edges,), jnp.int32))
+    (rows, cols), _ = lax.scan(level, init, (jnp.arange(max_scale), keys))
+    return rows, cols
+
+
+def rmat(
+    key_or_seed,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    theta=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """RMAT rectangular graph generator: edge list (rows, cols) with
+    2^r_scale × 2^c_scale vertex space (random/rmat_rectangular_generator.cuh:81).
+
+    ``theta`` is (max(r_scale,c_scale), 4) per-level quadrant probabilities;
+    default is the standard (0.57, 0.19, 0.19, 0.05) at every level.
+    """
+    key = _as_key(key_or_seed)
+    max_scale = max(r_scale, c_scale)
+    if theta is None:
+        theta = jnp.tile(jnp.array([[0.57, 0.19, 0.19, 0.05]], jnp.float32), (max_scale, 1))
+    else:
+        theta = jnp.asarray(theta, jnp.float32).reshape(max_scale, 4)
+        theta = theta / theta.sum(axis=1, keepdims=True)
+    return _rmat_impl(key, theta, int(r_scale), int(c_scale), int(n_edges))

@@ -1,0 +1,5 @@
+"""Shared utilities: tiling helpers used by every out-of-core algorithm."""
+
+from raft_tpu.utils.tiling import pad_rows, pad_and_tile, ceil_div
+
+__all__ = ["pad_rows", "pad_and_tile", "ceil_div"]

@@ -1,0 +1,36 @@
+"""Row-tiling helpers — the one place the pad/reshape pattern lives.
+
+Every tiled algorithm (elementwise distances, fused L2 argmin, brute-force
+search) pads its row dimension to a tile multiple and reshapes to
+(n_tiles, tile, ...); centralized so budget fixes propagate (the memory-aware
+tiling role of reference neighbors/detail/knn_brute_force.cuh:78-91).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def pad_rows(x: jax.Array, multiple: int, fill=0) -> jax.Array:
+    """Pad axis 0 up to the next multiple (no-op if already aligned)."""
+    m = x.shape[0]
+    pad = ceil_div(m, multiple) * multiple - m
+    if pad == 0:
+        return x
+    pad_shape = (pad,) + x.shape[1:]
+    return jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)])
+
+
+def pad_and_tile(x: jax.Array, tile: int, fill=0) -> Tuple[jax.Array, int]:
+    """Pad axis 0 to a multiple of ``tile`` and reshape to
+    (n_tiles, tile, *rest). Returns (tiles, n_tiles)."""
+    xp = pad_rows(x, tile, fill)
+    n_tiles = xp.shape[0] // tile
+    return xp.reshape((n_tiles, tile) + x.shape[1:]), n_tiles

@@ -1,0 +1,27 @@
+"""Test config: force CPU with 8 virtual devices so multi-chip sharding paths
+are exercised without TPU hardware (SURVEY.md §4.3 — the LocalCUDACluster
+analog is a one-process virtual device mesh)."""
+
+import os
+
+# Force CPU: the ambient JAX_PLATFORMS may point at real TPU hardware, but the
+# test suite needs 8 virtual devices (and fp32 matmul exactness for tier-1
+# oracles). The TPU plugin can override the env var, so set the config too.
+# Set RAFT_TPU_TEST_PLATFORM to override.
+_platform = os.environ.get("RAFT_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,81 @@
+"""Brute-force kNN — tier-1 oracle: exact match vs numpy full-sort reference
+(reference cpp/test/neighbors/tiled_knn.cu compares tiled vs full knn)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as sp_dist
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, use_resources
+from raft_tpu.neighbors import brute_force
+
+
+def _ref_knn(q, d, k, metric="sqeuclidean"):
+    dist = sp_dist.cdist(q.astype(np.float64), d.astype(np.float64), metric)
+    idx = np.argsort(dist, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(dist, idx, axis=1), idx
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine", "l1"])
+def test_knn_exact(metric, rng):
+    d = rng.random((500, 32)).astype(np.float32)
+    q = rng.random((40, 32)).astype(np.float32)
+    vals, idx = brute_force.knn(q, d, 10, metric=metric)
+    ref_vals, _ = _ref_knn(q, d, 10, metric if metric != "l1" else "cityblock")
+    # distances must match the exact reference (indices may differ on ties)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, rtol=1e-3, atol=1e-4)
+    # gathered distances from returned ids must equal returned distances
+    full = sp_dist.cdist(q, d, metric if metric != "l1" else "cityblock")
+    np.testing.assert_allclose(
+        np.asarray(vals), np.take_along_axis(full, np.asarray(idx), axis=1),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_knn_tiled_matches_untiled(rng):
+    d = rng.random((1000, 16)).astype(np.float32)
+    q = rng.random((20, 16)).astype(np.float32)
+    idx_full = brute_force.knn(q, d, 5)[1]
+    with use_resources(Resources(workspace_bytes=1 << 14)):
+        idx_tiled = brute_force.knn(q, d, 5)[1]
+    np.testing.assert_array_equal(np.asarray(idx_full), np.asarray(idx_tiled))
+
+
+def test_knn_inner_product(rng):
+    d = rng.random((300, 24)).astype(np.float32)
+    q = rng.random((10, 24)).astype(np.float32)
+    vals, idx = brute_force.knn(q, d, 7, metric="inner_product")
+    sim = q @ d.T
+    want = np.sort(sim, axis=1)[:, ::-1][:, :7]
+    np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-4)
+
+
+def test_knn_filter(rng):
+    d = rng.random((200, 8)).astype(np.float32)
+    q = rng.random((5, 8)).astype(np.float32)
+    mask = np.ones(200, bool)
+    mask[::2] = False  # exclude even ids
+    bs = Bitset.from_mask(mask)
+    _, idx = brute_force.search(brute_force.build(d), q, 10, filter=bs)
+    assert (np.asarray(idx) % 2 == 1).all()
+
+
+def test_index_serialize_roundtrip(tmp_path, rng):
+    d = rng.random((100, 8)).astype(np.float32)
+    q = rng.random((4, 8)).astype(np.float32)
+    index = brute_force.build(d, metric="cosine")
+    path = str(tmp_path / "bf.raft")
+    index.save(path)
+    loaded = brute_force.BruteForceIndex.load(path)
+    v1, i1 = brute_force.search(index, q, 3)
+    v2, i2 = brute_force.search(loaded, q, 3)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+
+
+def test_k_larger_than_tile(rng):
+    d = rng.random((64, 4)).astype(np.float32)
+    q = rng.random((3, 4)).astype(np.float32)
+    vals, idx = brute_force.knn(q, d, 20, tile_rows=16)
+    ref_vals, _ = _ref_knn(q, d, 20)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, rtol=1e-3, atol=1e-5)

@@ -1,0 +1,111 @@
+"""Core runtime tests: resources, serialization, bitset, interruptible."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    Bitset,
+    InterruptedException,
+    Resources,
+    cancel,
+    check_interrupt,
+    current_resources,
+    load_arrays,
+    save_arrays,
+    use_resources,
+)
+from raft_tpu.core.serialize import deserialize_array, serialize_array
+
+
+def test_resources_scoping():
+    base = current_resources()
+    override = Resources(workspace_bytes=123)
+    with use_resources(override):
+        assert current_resources().workspace_bytes == 123
+    assert current_resources() is base
+
+
+def test_resources_key_stream():
+    import jax.random
+
+    res = Resources().with_seed(7)
+    k1, k2 = res.next_key(), res.next_key()
+    assert not np.array_equal(
+        np.asarray(jax.random.key_data(k1)), np.asarray(jax.random.key_data(k2))
+    )
+
+
+def test_serialize_array_numpy_readable():
+    buf = io.BytesIO()
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    serialize_array(buf, arr)
+    buf.seek(0)
+    got = np.load(buf)  # plain numpy must read it (format parity goal)
+    np.testing.assert_array_equal(got, arr)
+    buf.seek(0)
+    np.testing.assert_array_equal(deserialize_array(buf), arr)
+
+
+def test_container_roundtrip(tmp_path):
+    path = str(tmp_path / "c.raft")
+    meta = {"kind": "test", "n": 5}
+    arrays = {"a": np.ones((2, 2)), "b": np.arange(3, dtype=np.int32)}
+    save_arrays(path, meta, arrays)
+    meta2, arrays2 = load_arrays(path)
+    assert meta2["kind"] == "test" and meta2["n"] == 5
+    np.testing.assert_array_equal(arrays2["a"], arrays["a"])
+    np.testing.assert_array_equal(arrays2["b"], arrays["b"])
+
+
+def test_container_bad_magic(tmp_path):
+    path = str(tmp_path / "bad.raft")
+    with open(path, "wb") as f:
+        f.write(b"NOTRAFT!" + b"\0" * 16)
+    with pytest.raises(ValueError):
+        load_arrays(path)
+
+
+def test_bitset_roundtrip(rng):
+    mask = rng.random(100) > 0.5
+    bs = Bitset.from_mask(mask)
+    np.testing.assert_array_equal(np.asarray(bs.to_mask()), mask)
+    assert int(bs.count()) == mask.sum()
+
+
+def test_bitset_test_and_set():
+    bs = Bitset.create(70, default=False)
+    bs = bs.set(np.array([0, 33, 69]))
+    got = np.asarray(bs.test(np.array([0, 1, 33, 69, 70, -1])))
+    np.testing.assert_array_equal(got, [True, False, True, True, False, False])
+    bs = bs.set(np.array([33]), value=False)
+    assert not bool(bs.test(np.array([33]))[0])
+
+
+def test_interruptible():
+    check_interrupt()  # no-op when not cancelled
+    cancel()  # cancel self
+    with pytest.raises(InterruptedException):
+        check_interrupt()
+    check_interrupt()  # flag consumed
+
+
+def test_interruptible_cross_thread():
+    state = {}
+
+    def worker():
+        try:
+            for _ in range(1000):
+                check_interrupt()
+                threading.Event().wait(0.001)
+            state["done"] = "finished"
+        except InterruptedException:
+            state["done"] = "interrupted"
+
+    t = threading.Thread(target=worker)
+    t.start()
+    cancel(t.ident)
+    t.join(timeout=5)
+    assert state["done"] == "interrupted"

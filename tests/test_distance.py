@@ -1,0 +1,141 @@
+"""Distance tests — tier-2 oracle: tolerance match vs scipy/numpy host
+recomputation (SURVEY.md §4.3; reference cpp/test/distance/dist_*.cu)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as sp_dist
+
+from raft_tpu.core.resources import Resources, use_resources
+from raft_tpu.ops.distance import (
+    ALL_METRICS,
+    fused_l2_nn_argmin,
+    pairwise_distance,
+)
+
+# metric -> (scipy cdist name, input kind)
+_SCIPY = {
+    "sqeuclidean": ("sqeuclidean", "real"),
+    "euclidean": ("euclidean", "real"),
+    "cosine": ("cosine", "real"),
+    "l1": ("cityblock", "real"),
+    "chebyshev": ("chebyshev", "real"),
+    "canberra": ("canberra", "real"),
+    "braycurtis": ("braycurtis", "positive"),
+    "correlation": ("correlation", "real"),
+    "hamming": ("hamming", "binary"),
+    "jensenshannon": ("jensenshannon", "prob"),
+    "russellrao": ("russellrao", "binary"),
+    "dice": ("dice", "binary"),
+    "jaccard": ("jaccard", "binary"),
+    "minkowski": ("minkowski", "real"),
+}
+
+
+def _make(kind, rng, m, n, k):
+    x = rng.random((m, k)).astype(np.float32)
+    y = rng.random((n, k)).astype(np.float32)
+    if kind == "binary":
+        x = (x > 0.5).astype(np.float32)
+        y = (y > 0.5).astype(np.float32)
+    elif kind == "prob":
+        x /= x.sum(axis=1, keepdims=True)
+        y /= y.sum(axis=1, keepdims=True)
+    elif kind == "positive":
+        x += 0.1
+        y += 0.1
+    return x, y
+
+
+@pytest.mark.parametrize("metric", sorted(_SCIPY))
+def test_pairwise_vs_scipy(metric, rng):
+    name, kind = _SCIPY[metric]
+    x, y = _make(kind, rng, 33, 47, 19)
+    got = np.asarray(pairwise_distance(x, y, metric=metric, p=3.0))
+    if name == "minkowski":
+        want = sp_dist.cdist(x.astype(np.float64), y.astype(np.float64), name, p=3.0)
+    else:
+        want = sp_dist.cdist(x.astype(np.float64), y.astype(np.float64), name)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_inner_product(rng):
+    x, y = _make("real", rng, 10, 12, 8)
+    got = np.asarray(pairwise_distance(x, y, metric="inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-5)
+
+
+def test_kl_divergence(rng):
+    x, y = _make("prob", rng, 9, 11, 16)
+    got = np.asarray(pairwise_distance(x, y, metric="kl_divergence"))
+    want = np.array([[np.sum(xi * np.log(xi / yj)) for yj in y] for xi in x])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_hellinger(rng):
+    x, y = _make("prob", rng, 9, 11, 16)
+    got = np.asarray(pairwise_distance(x, y, metric="hellinger"))
+    want = np.sqrt(np.maximum(1.0 - np.sqrt(x)[:, None, :] @ np.sqrt(y).T[None], 0))
+    want = np.sqrt(np.maximum(1.0 - np.einsum("ik,jk->ij", np.sqrt(x), np.sqrt(y)), 0))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_haversine():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1.0, 1.0, (5, 2)).astype(np.float32)
+    y = rng.uniform(-1.0, 1.0, (7, 2)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="haversine"))
+
+    def hav(a, b):
+        dlat, dlon = b[0] - a[0], b[1] - a[1]
+        h = np.sin(dlat / 2) ** 2 + np.cos(a[0]) * np.cos(b[0]) * np.sin(dlon / 2) ** 2
+        return 2 * np.arcsin(np.sqrt(h))
+
+    want = np.array([[hav(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tiled_elementwise_matches_untiled(rng):
+    """Row-tiling must not change results (workspace budget forces tiles)."""
+    x, y = _make("real", rng, 200, 64, 32)
+    small = Resources(workspace_bytes=1 << 16)
+    with use_resources(small):
+        got = np.asarray(pairwise_distance(x, y, metric="l1"))
+    want = sp_dist.cdist(x.astype(np.float64), y.astype(np.float64), "cityblock")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_l2_nn(rng):
+    x = rng.random((100, 16)).astype(np.float32)
+    c = rng.random((10, 16)).astype(np.float32)
+    val, idx = fused_l2_nn_argmin(x, c)
+    d = sp_dist.cdist(x.astype(np.float64), c.astype(np.float64), "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(idx), d.argmin(axis=1))
+    np.testing.assert_allclose(np.asarray(val), d.min(axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_l2_nn_tiled(rng):
+    x = rng.random((500, 8)).astype(np.float32)
+    c = rng.random((7, 8)).astype(np.float32)
+    with use_resources(Resources(workspace_bytes=1 << 12)):
+        val, idx = fused_l2_nn_argmin(x, c)
+    d = sp_dist.cdist(x.astype(np.float64), c.astype(np.float64), "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(idx), d.argmin(axis=1))
+
+
+def test_metric_aliases():
+    x = np.ones((2, 3), np.float32)
+    for alias in ("l2", "cityblock", "linf", "ip"):
+        pairwise_distance(x, x, metric=alias)
+
+
+def test_all_metrics_covered():
+    # every advertised metric must run
+    rng = np.random.default_rng(1)
+    x = np.abs(rng.random((4, 6)).astype(np.float32)) + 0.01
+    x /= x.sum(axis=1, keepdims=True)
+    for m in ALL_METRICS:
+        if m == "haversine":
+            continue
+        out = pairwise_distance(x, x, metric=m)
+        assert out.shape == (4, 4)
+        assert np.isfinite(np.asarray(out)).all(), m
