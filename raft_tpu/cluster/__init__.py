@@ -1,0 +1,13 @@
+"""Clustering algorithms (reference cpp/include/raft/cluster/, SURVEY.md §2.4).
+
+  * :mod:`raft_tpu.cluster.kmeans` — Lloyd k-means with kmeans++ init
+    (cluster/kmeans.cuh).
+  * :mod:`raft_tpu.cluster.kmeans_balanced` — balanced hierarchical k-means,
+    the IVF coarse-quantizer trainer (cluster/kmeans_balanced.cuh).
+  * single-linkage agglomerative clustering arrives with the sparse/MST layer.
+"""
+
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansParams
+
+__all__ = ["kmeans", "kmeans_balanced", "KMeansParams"]

@@ -1,0 +1,229 @@
+"""Lloyd k-means with kmeans++ initialization.
+
+Reference surface: raft::cluster::kmeans — fit (cluster/kmeans.cuh:88), predict
+(:152), fit_predict (:215), transform (:244), cluster_cost (:367),
+init_plus_plus (:584), fit_main (:617); params struct cluster/kmeans_types.hpp
+(n_clusters, init, max_iter, tol, n_init, oversampling_factor, batch_samples).
+
+TPU design: the reference's inner loop is fusedL2NN (assignment) + a
+scatter-reduce (centroid update), tiled by ``batch_samples`` to bound the
+distance-matrix workspace. Here the assignment is
+:func:`raft_tpu.ops.distance.fused_l2_nn_argmin` (gemm + rank-1 correction +
+row-argmin, tiled by the Resources workspace budget) and the update is
+``jax.ops.segment_sum`` — both fuse into one XLA program per EM step. The EM
+loop itself is a ``lax.while_loop`` carrying (centers, inertia, iteration), so
+`fit` is one compiled computation regardless of iteration count: no
+host↔device sync per step (the reference syncs per iteration to check the
+stop condition; on TPU that would leave the chip idle every step).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t, pairwise_distance
+
+
+@dataclass(frozen=True)
+class KMeansParams:
+    """Hyper-parameters (aggregate-struct analog of KMeansParams,
+    cluster/kmeans_types.hpp:37-110)."""
+
+    n_clusters: int = 8
+    init: str = "k-means++"  # "k-means++" | "random" | "array"
+    max_iter: int = 300
+    tol: float = 1e-4
+    n_init: int = 1
+    metric: str = "sqeuclidean"
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.init not in ("k-means++", "random", "array"):
+            raise ValueError(f"unknown init {self.init!r}")
+        if self.metric not in ("sqeuclidean", "euclidean", "l2"):
+            raise ValueError("kmeans supports L2 metrics only (reference parity)")
+
+
+class KMeansOutput(NamedTuple):
+    centroids: jax.Array  # (n_clusters, dim)
+    inertia: jax.Array  # scalar fp32, sum of squared distances to centers
+    n_iter: jax.Array  # scalar int32, EM iterations executed
+
+
+# ---------------------------------------------------------------------------
+# EM pieces
+# ---------------------------------------------------------------------------
+
+
+def _update_centers(X, labels, weights, n_clusters, old_centers):
+    """M step: weighted per-cluster mean; empty clusters keep their center."""
+    w = weights[:, None]
+    sums = jax.ops.segment_sum(X * w, labels, num_segments=n_clusters)
+    counts = jax.ops.segment_sum(weights, labels, num_segments=n_clusters)
+    safe = jnp.maximum(counts, 1e-12)[:, None]
+    return jnp.where(counts[:, None] > 0, sums / safe, old_centers), counts
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "tol", "n_clusters"))
+def _lloyd(X, centers0, weights, max_iter, tol, n_clusters):
+    """Whole-fit-in-one-program Lloyd loop (fit_main analog, kmeans.cuh:617)."""
+
+    def em_step(centers):
+        d2, labels = fused_l2_nn_argmin(X, centers)
+        new_centers, _ = _update_centers(X, labels, weights, n_clusters, centers)
+        inertia = jnp.sum(d2 * weights)
+        return new_centers, inertia
+
+    def cond(carry):
+        _, inertia, prev_inertia, it = carry
+        # converged once inertia stops improving by a relative tol
+        not_converged = inertia < prev_inertia * (1.0 - tol)
+        return jnp.logical_and(it < max_iter, not_converged)
+
+    def body(carry):
+        centers, inertia, _, it = carry
+        new_centers, new_inertia = em_step(centers)
+        return new_centers, new_inertia, inertia, it + 1
+
+    centers1, inertia1 = em_step(centers0)
+    centers, inertia, _, n_iter = lax.while_loop(
+        cond, body, (centers1, inertia1, jnp.float32(jnp.inf), jnp.int32(1))
+    )
+    # final assignment determines reported inertia for the *returned* centers
+    d2, _ = fused_l2_nn_argmin(X, centers)
+    return centers, jnp.sum(d2 * weights), n_iter
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters",))
+def _init_plus_plus(key, X, weights, n_clusters):
+    """kmeans++ seeding (init_plus_plus analog, cluster/kmeans.cuh:584):
+    first center uniform; each next sampled ∝ weight·D²(x) to chosen centers.
+
+    One `fori_loop` iteration per center — n_clusters sequential (n,dim)
+    distance sweeps, each a single fused gemm+argmin on the MXU.
+    """
+    n = X.shape[0]
+    k0, key = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centers = jnp.zeros((n_clusters, X.shape[1]), X.dtype).at[0].set(X[first])
+    d2 = jnp.sum((X - X[first]) ** 2, axis=1)
+
+    def body(i, carry):
+        centers, d2, key = carry
+        kc, key = jax.random.split(key)
+        p = d2 * weights
+        nxt = jax.random.categorical(kc, jnp.log(jnp.maximum(p, 1e-30)))
+        centers = centers.at[i].set(X[nxt])
+        d2 = jnp.minimum(d2, jnp.sum((X - X[nxt]) ** 2, axis=1))
+        return centers, d2, key
+
+    centers, _, _ = lax.fori_loop(1, n_clusters, body, (centers, d2, key))
+    return centers
+
+
+def _init_random(key, X, n_clusters):
+    rows = jax.random.choice(key, X.shape[0], (n_clusters,), replace=False)
+    return X[rows]
+
+
+# ---------------------------------------------------------------------------
+# Public API (mirrors cluster/kmeans.cuh + pylibraft cluster/kmeans.pyx)
+# ---------------------------------------------------------------------------
+
+
+def fit(
+    X,
+    params: KMeansParams = KMeansParams(),
+    sample_weight=None,
+    centroids=None,
+    res: Optional[Resources] = None,
+) -> KMeansOutput:
+    """Train k-means (raft::cluster::kmeans::fit, cluster/kmeans.cuh:88).
+
+    Runs ``params.n_init`` independent seeded fits and keeps the lowest-inertia
+    one (kmeans_types.hpp n_init). ``centroids`` seeds the fit when
+    ``params.init == "array"`` (InitMethod::Array).
+    """
+    res = res or current_resources()
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    if params.n_clusters > n:
+        raise ValueError(f"n_clusters={params.n_clusters} > n_samples={n}")
+    weights = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
+    key = jax.random.key(params.seed)
+
+    best: Optional[KMeansOutput] = None
+    for _ in range(max(1, params.n_init)):
+        kinit, key = jax.random.split(key)
+        if params.init == "array":
+            if centroids is None:
+                raise ValueError('init="array" requires centroids')
+            centers0 = jnp.asarray(centroids)
+        elif params.init == "random":
+            centers0 = _init_random(kinit, X, params.n_clusters)
+        else:
+            centers0 = _init_plus_plus(kinit, X, weights, params.n_clusters)
+        out = KMeansOutput(
+            *_lloyd(X, centers0, weights, params.max_iter, float(params.tol), params.n_clusters)
+        )
+        if best is None or float(out.inertia) < float(best.inertia):
+            best = out
+        if params.init == "array":
+            break  # deterministic start: n_init re-runs would be identical
+    assert best is not None
+    if params.metric == "euclidean":
+        best = best._replace(inertia=jnp.sqrt(best.inertia))
+    return best
+
+
+def predict(
+    X, centroids, sample_weight=None, res: Optional[Resources] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Assign each row to its nearest centroid → (labels, inertia)
+    (raft::cluster::kmeans::predict, cluster/kmeans.cuh:152)."""
+    X = jnp.asarray(X)
+    centroids = jnp.asarray(centroids)
+    d2, labels = fused_l2_nn_argmin(X, centroids, res=res)
+    if sample_weight is not None:
+        d2 = d2 * jnp.asarray(sample_weight, jnp.float32)
+    return labels, jnp.sum(d2)
+
+
+def fit_predict(
+    X,
+    params: KMeansParams = KMeansParams(),
+    sample_weight=None,
+    centroids=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, KMeansOutput]:
+    """fit + predict in one call (cluster/kmeans.cuh:215)."""
+    out = fit(X, params, sample_weight=sample_weight, centroids=centroids, res=res)
+    labels, _ = predict(X, out.centroids, res=res)
+    return labels, out
+
+
+def transform(X, centroids, res: Optional[Resources] = None) -> jax.Array:
+    """Distance from every row to every centroid (cluster/kmeans.cuh:244)."""
+    return pairwise_distance(X, centroids, metric="sqeuclidean", res=res)
+
+
+def cluster_cost(X, centroids, res: Optional[Resources] = None) -> jax.Array:
+    """Sum of squared distances to nearest centroid (cluster/kmeans.cuh:367)."""
+    d2, _ = fused_l2_nn_argmin(X, centroids, res=res)
+    return jnp.sum(d2)

@@ -1,0 +1,198 @@
+"""Balanced k-means — the IVF coarse-quantizer trainer.
+
+Reference surface: raft::cluster::kmeans_balanced — fit
+(cluster/kmeans_balanced.cuh:76), predict (:134), fit_predict (:199),
+build_clusters (:258), calc_centers_and_sizes (:337); the balancing EM +
+mesocluster hierarchy live in cluster/detail/kmeans_balanced.cuh. Supported
+metrics: L2 and inner product (kmeans_balanced_types.hpp:29).
+
+Why it exists: IVF indexes need cluster lists of *roughly equal size* — search
+cost is bounded by the largest probed list, and (on TPU specifically) padded
+dense list storage wastes memory proportional to skew. Plain Lloyd happily
+produces empty and mega clusters; balanced k-means reseeds underweight
+clusters each iteration.
+
+TPU design: the reference's `adjust_centers` walks small clusters on the host
+and steals a random point from an over-average cluster. That per-cluster
+data-dependent loop doesn't vectorize; instead each EM step here does a
+static-shape reseed: rank all points by distance to their assigned center
+(descending, one `top_k`) and hand the i-th underweight cluster the i-th
+worst-served point. Same fixpoint pressure (small clusters teleport to dense
+under-covered regions), one fused program per iteration, no host sync.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t
+
+
+@dataclass(frozen=True)
+class KMeansBalancedParams:
+    """Aggregate params (kmeans_balanced_types.hpp:34-39)."""
+
+    n_iters: int = 20
+    metric: str = "sqeuclidean"  # "sqeuclidean" | "inner_product"
+    seed: int = 0
+    # fraction of the average size below which a cluster is reseeded
+    # (analog of kAdjustCentersWeight pressure in detail/kmeans_balanced.cuh)
+    balancing_threshold: float = 0.25
+
+    def __post_init__(self):
+        if self.metric not in ("sqeuclidean", "inner_product"):
+            raise ValueError("kmeans_balanced supports sqeuclidean | inner_product")
+
+
+def _assign(X, centers, metric, res=None):
+    """E step → (score, labels). Score is d² for L2, -ip for inner product
+    (lower is always better, so downstream top-k logic is metric-agnostic)."""
+    if metric == "inner_product":
+        ip = matmul_t(X, centers)
+        labels = jnp.argmax(ip, axis=1).astype(jnp.int32)
+        return -jnp.max(ip, axis=1), labels
+    d2, labels = fused_l2_nn_argmin(X, centers, res=res)
+    return d2, labels
+
+
+def calc_centers_and_sizes(X, labels, n_clusters: int, old_centers=None):
+    """M step: per-cluster means + sizes (kmeans_balanced.cuh:337). Empty
+    clusters keep ``old_centers`` (or zeros)."""
+    X = jnp.asarray(X)
+    labels = jnp.asarray(labels)
+    sums = jax.ops.segment_sum(X, labels, num_segments=n_clusters)
+    sizes = jax.ops.segment_sum(jnp.ones(X.shape[0], jnp.float32), labels, num_segments=n_clusters)
+    means = sums / jnp.maximum(sizes, 1.0)[:, None]
+    if old_centers is not None:
+        means = jnp.where(sizes[:, None] > 0, means, jnp.asarray(old_centers))
+    return means, sizes.astype(jnp.int32)
+
+
+# center weight in the adjust step's weighted average — anomalously small
+# clusters jump most of the way to the donor, healthy-but-small ones drift
+# (kAdjustCentersWeight analog, detail/kmeans_balanced.cuh:474)
+_ADJUST_CENTERS_WEIGHT = 7.0
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters", "metric", "threshold"))
+def _balanced_em(X, centers0, key, n_clusters, n_iters, metric, threshold):
+    """balancing_em_iters analog (detail/kmeans_balanced.cuh:619): EM where each
+    iteration pulls underweight clusters toward random samples of over-average
+    clusters (adjust_centers, :456-483). Like the reference's
+    ``balancing_pullback`` (:651-654), the iteration budget extends while
+    rebalancing is still firing, capped at 5×n_iters.
+    """
+    n = X.shape[0]
+    average = n / n_clusters
+    max_iters = 5 * n_iters
+
+    def step(i, centers):
+        _, labels = _assign(X, centers, metric)
+        centers, sizes = calc_centers_and_sizes(X, labels, n_clusters, centers)
+        fsizes = sizes.astype(jnp.float32)
+        small = fsizes < threshold * average
+        # donors: n_clusters distinct points drawn uniformly from rows whose
+        # cluster is at least average-sized (the do/while at :462-465)
+        eligible = fsizes[labels] >= average
+        u = jax.random.uniform(jax.random.fold_in(key, i), (n,))
+        _, donors = lax.top_k(jnp.where(eligible, u, -1.0), n_clusters)
+        rank = jnp.clip(jnp.cumsum(small.astype(jnp.int32)) - 1, 0, n_clusters - 1)
+        donor_pts = X[donors[rank]]
+        # Deviation from the reference's weighted pull (wc=min(csize,7),
+        # :474-481): a 1/(wc+1) drift is undone by the next M-step snapping the
+        # center back to its members' mean — the reference compensates with its
+        # mesocluster-hierarchy init (density-proportional seeding,
+        # build_hierarchical :1000+). Without that host-side hierarchy we
+        # teleport instead: the relocated center's Voronoi cell lands inside
+        # the donor cluster, so the E/M steps keep it there.
+        centers = jnp.where(small[:, None], donor_pts, centers)
+        if metric == "inner_product":
+            # IP/cosine EM drifts toward zero centers without renormalization
+            # (detail/kmeans_balanced.cuh:656-668)
+            centers = centers / jnp.maximum(
+                jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30
+            )
+        return centers, jnp.any(small)
+
+    def cond(carry):
+        _, it, rebalancing = carry
+        return jnp.logical_or(it < n_iters, jnp.logical_and(rebalancing, it < max_iters))
+
+    def body(carry):
+        centers, it, _ = carry
+        centers, rebalancing = step(it, centers)
+        return centers, it + 1, rebalancing
+
+    centers, _, _ = lax.while_loop(cond, body, (centers0, jnp.int32(0), jnp.bool_(True)))
+    # final M step + re-predict so returned labels match returned centers
+    _, labels = _assign(X, centers, metric)
+    centers, _ = calc_centers_and_sizes(X, labels, n_clusters, centers)
+    if metric == "inner_product":
+        centers = centers / jnp.maximum(jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
+    _, labels = _assign(X, centers, metric)
+    sizes = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), labels, num_segments=n_clusters
+    )
+    return centers, labels, sizes
+
+
+def fit(
+    X,
+    n_clusters: int,
+    params: KMeansBalancedParams = KMeansBalancedParams(),
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Train balanced k-means centers (kmeans_balanced::fit,
+    cluster/kmeans_balanced.cuh:76). Returns (n_clusters, dim) centers."""
+    centers, _, _ = _fit_full(X, n_clusters, params, res)
+    return centers
+
+
+def fit_predict(
+    X,
+    n_clusters: int,
+    params: KMeansBalancedParams = KMeansBalancedParams(),
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(centers, labels) in one pass (kmeans_balanced.cuh:199)."""
+    centers, labels, _ = _fit_full(X, n_clusters, params, res)
+    return centers, labels
+
+
+def _fit_full(X, n_clusters, params, res):
+    res = res or current_resources()
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    if n_clusters > n:
+        raise ValueError(f"n_clusters={n_clusters} > n_samples={n}")
+    key = jax.random.key(params.seed)
+    k_init, k_adjust = jax.random.split(key)
+    rows = jax.random.choice(k_init, n, (n_clusters,), replace=False)
+    centers0 = X[rows].astype(jnp.float32)
+    return _balanced_em(
+        X.astype(jnp.float32),
+        centers0,
+        k_adjust,
+        int(n_clusters),
+        int(params.n_iters),
+        params.metric,
+        float(params.balancing_threshold),
+    )
+
+
+def predict(
+    X,
+    centers,
+    params: KMeansBalancedParams = KMeansBalancedParams(),
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Nearest-center labels under the params metric (kmeans_balanced.cuh:134)."""
+    _, labels = _assign(jnp.asarray(X), jnp.asarray(centers), params.metric, res)
+    return labels

@@ -88,23 +88,21 @@ def build(dataset, metric: str = "sqeuclidean", metric_arg: float = 2.0,
     dataset = jnp.asarray(dataset)
     norms = None
     if metric in ("sqeuclidean", "euclidean", "cosine"):
-        norms = jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1)
+        norms = dist_mod.sqnorm(dataset)
     return BruteForceIndex(dataset, norms, metric, metric_arg)
 
 
-def _tile_distances(queries, tile, tile_norms, metric, metric_arg, compute_dtype, precision=None):
+def _tile_distances(queries, qn, tile, tile_norms, metric, metric_arg, compute_dtype, precision=None):
     """Distances of all queries against one dataset tile, reusing precomputed
-    tile norms for the expanded metrics."""
+    query norms ``qn`` (hoisted out of the tile scan) and tile norms."""
     if metric in ("sqeuclidean", "euclidean"):
         ip = dist_mod.matmul_t(queries, tile, compute_dtype, precision)
-        qn = jnp.sum(queries * queries, axis=1, dtype=jnp.float32)
         d = jnp.maximum(qn[:, None] + tile_norms[None, :] - 2.0 * ip, 0.0)
         return jnp.sqrt(d) if metric == "euclidean" else d
     if metric == "cosine":
         ip = dist_mod.matmul_t(queries, tile, compute_dtype, precision)
-        qn = jnp.sqrt(jnp.sum(queries * queries, axis=1, dtype=jnp.float32))
         tn = jnp.sqrt(tile_norms)
-        return 1.0 - ip / jnp.maximum(qn[:, None] * tn[None, :], 1e-30)
+        return 1.0 - ip / jnp.maximum(jnp.sqrt(qn)[:, None] * tn[None, :], 1e-30)
     if metric == "inner_product":
         return dist_mod.matmul_t(queries, tile, compute_dtype, precision)
     if metric in dist_mod.EXPANDED_METRICS:
@@ -118,12 +116,17 @@ def _tile_distances(queries, tile, tile_norms, metric, metric_arg, compute_dtype
     jax.jit,
     static_argnames=("k", "metric", "metric_arg", "tile_rows", "select_algo", "compute_dtype"),
 )
-def _search_impl(queries, dataset, norms, filter_bits, k, metric, metric_arg,
+def _search_impl(queries, dataset, norms, filter, k, metric, metric_arg,
                  tile_rows, select_algo, compute_dtype):
     n, dim = dataset.shape
     q = queries.shape[0]
     select_min = metric not in _MAX_METRICS
     bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
+    needs_norms = metric in ("sqeuclidean", "euclidean", "cosine")
+    if needs_norms and norms is None:
+        # index built via the raw dataclass constructor rather than build()
+        norms = dist_mod.sqnorm(dataset)
+    qn = dist_mod.sqnorm(queries) if needs_norms else None
 
     tiles, n_tiles = pad_and_tile(dataset, tile_rows)
     tnorms = (
@@ -134,13 +137,11 @@ def _search_impl(queries, dataset, norms, filter_bits, k, metric, metric_arg,
 
     def step(_, inp):
         tile, tn, start = inp
-        d = _tile_distances(queries, tile, tn, metric, metric_arg, compute_dtype)
+        d = _tile_distances(queries, qn, tile, tn, metric, metric_arg, compute_dtype)
         ids = start + jnp.arange(tile_rows, dtype=jnp.int32)
         valid = ids < n
-        if filter_bits is not None:
-            word = filter_bits[jnp.clip(ids // 32, 0, filter_bits.shape[0] - 1)]
-            keep = ((word >> (ids % 32).astype(jnp.uint32)) & jnp.uint32(1)) == 1
-            valid = valid & keep
+        if filter is not None:
+            valid = valid & filter.test(ids)
         d = jnp.where(valid[None, :], d, bad)
         # per-tile top-k, fused with the distance gemm (never materializes the
         # full tile distance matrix to HBM)
@@ -193,12 +194,11 @@ def search(
             per_col = max(1, q * index.dim * 4)
         tile_rows = int(min(n, max(k, res.workspace_bytes // per_col)))
     tile_rows = max(min(tile_rows, n), min(n, k))
-    filter_bits = filter.bits if filter is not None else None
     return _search_impl(
         queries,
         index.dataset,
         index.norms,
-        filter_bits,
+        filter,
         int(k),
         index.metric,
         float(index.metric_arg),

@@ -92,6 +92,13 @@ def canonical_metric(metric: str) -> str:
     return m
 
 
+def sqnorm(x: jax.Array, axis: int = 1) -> jax.Array:
+    """Row squared-L2 norms, squaring in fp32: fp16 inputs overflow and int8
+    inputs wrap if squared in their own dtype before the fp32 accumulation."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    return jnp.sum(xf * xf, axis=axis)
+
+
 def matmul_t(x: jax.Array, y: jax.Array, compute_dtype=None, precision=None) -> jax.Array:
     """x @ y.T with fp32 accumulation; optionally bf16 MXU inputs.
 
@@ -126,14 +133,14 @@ def _expanded_distance(x, y, metric, compute_dtype, precision="highest"):
     if metric == "inner_product":
         return ip
     if metric in ("sqeuclidean", "euclidean"):
-        xn = jnp.sum(x * x, axis=1, dtype=jnp.float32)
-        yn = jnp.sum(y * y, axis=1, dtype=jnp.float32)
+        xn = sqnorm(x)
+        yn = sqnorm(y)
         d2 = xn[:, None] + yn[None, :] - 2.0 * ip
         d2 = jnp.maximum(d2, 0.0)
         return jnp.sqrt(d2) if metric == "euclidean" else d2
     if metric == "cosine":
-        xn = jnp.sqrt(jnp.sum(x * x, axis=1, dtype=jnp.float32))
-        yn = jnp.sqrt(jnp.sum(y * y, axis=1, dtype=jnp.float32))
+        xn = jnp.sqrt(sqnorm(x))
+        yn = jnp.sqrt(sqnorm(y))
         denom = jnp.maximum(xn[:, None] * yn[None, :], 1e-30)
         return 1.0 - ip / denom
     if metric == "correlation":
@@ -147,8 +154,8 @@ def _expanded_distance(x, y, metric, compute_dtype, precision="highest"):
         return jnp.sqrt(jnp.maximum(1.0 - sq_ip, 0.0))
     if metric == "jaccard":
         # Generalized (Tanimoto): 1 - <x,y> / (|x|^2 + |y|^2 - <x,y>)
-        xn = jnp.sum(x * x, axis=1, dtype=jnp.float32)
-        yn = jnp.sum(y * y, axis=1, dtype=jnp.float32)
+        xn = sqnorm(x)
+        yn = sqnorm(y)
         denom = xn[:, None] + yn[None, :] - ip
         return 1.0 - jnp.where(denom > 0, ip / jnp.maximum(denom, 1e-30), 1.0)
     if metric == "dice":
@@ -272,11 +279,11 @@ def pairwise_distance(
 @functools.partial(jax.jit, static_argnames=("sqrt", "tile_m", "precision"))
 def _fused_l2_nn_impl(x, y, sqrt, tile_m, precision):
     m, k = x.shape
-    yn = jnp.sum(y * y, axis=1, dtype=jnp.float32)
+    yn = sqnorm(y)
 
     def one_tile(xt):
         ip = matmul_t(xt, y, precision=precision)
-        xn = jnp.sum(xt * xt, axis=1, dtype=jnp.float32)
+        xn = sqnorm(xt)
         d2 = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * ip, 0.0)
         idx = jnp.argmin(d2, axis=1).astype(jnp.int32)
         val = jnp.min(d2, axis=1)

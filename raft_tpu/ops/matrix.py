@@ -46,9 +46,10 @@ def sort_cols_per_row(x, ascending: bool = True) -> Tuple[jax.Array, jax.Array]:
 
 def linewise_op(x, vec, along_rows: bool = True, op=jnp.multiply) -> jax.Array:
     """Apply op(x, vec) broadcasting vec along rows or columns
-    (matrix/linewise_op.cuh analog)."""
-    vec = jnp.asarray(vec)
-    return op(x, vec[None, :] if along_rows else vec[:, None])
+    (matrix/linewise_op.cuh analog). Delegates to linalg.matrix_vector_op."""
+    from raft_tpu.ops.linalg import matrix_vector_op
+
+    return matrix_vector_op(x, vec, axis=1 if along_rows else 0, op=op)
 
 
 def copy(x) -> jax.Array:

@@ -1,0 +1,120 @@
+"""Cluster tests — tier-2 oracle (numpy recomputation) + quality gates,
+mirroring cpp/test/cluster_kmeans.cu's score/convergence checks (SURVEY.md §4.3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.random import make_blobs
+
+
+def _blobs(n=1500, dim=16, k=5, seed=0, std=0.4):
+    X, labels, _ = make_blobs(seed, n, dim, n_clusters=k, cluster_std=std)
+    return np.asarray(X), np.asarray(labels)
+
+
+class TestKMeans:
+    def test_fit_recovers_blobs(self):
+        X, y = _blobs()
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=5, seed=1))
+        assert out.centroids.shape == (5, 16)
+        # each true cluster maps to exactly one learned center
+        labels, _ = kmeans.predict(X, out.centroids)
+        labels = np.asarray(labels)
+        mapping = {t: set(labels[y == t]) for t in range(5)}
+        assert all(len(v) == 1 for v in mapping.values())
+        assert len(set().union(*mapping.values())) == 5
+
+    def test_inertia_matches_numpy(self):
+        X, _ = _blobs(n=500, k=3)
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=3, seed=0))
+        C = np.asarray(out.centroids)
+        d2 = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(float(out.inertia), d2.min(1).sum(), rtol=1e-4)
+
+    def test_predict_labels_are_argmin(self):
+        X, _ = _blobs(n=300, k=4)
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=4, seed=0))
+        labels, _ = kmeans.predict(X, out.centroids)
+        C = np.asarray(out.centroids)
+        d2 = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(labels), d2.argmin(1))
+
+    def test_transform_and_cluster_cost(self):
+        X, _ = _blobs(n=200, k=3)
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=3, seed=0))
+        T = np.asarray(kmeans.transform(X, out.centroids))
+        assert T.shape == (200, 3)
+        cost = float(kmeans.cluster_cost(X, out.centroids))
+        np.testing.assert_allclose(cost, T.min(1).sum(), rtol=1e-4)
+
+    def test_init_array_and_random(self):
+        X, _ = _blobs(n=400, k=3)
+        c0 = X[:3].copy()
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=3, init="array"), centroids=c0)
+        assert float(out.inertia) > 0
+        out2 = kmeans.fit(X, kmeans.KMeansParams(n_clusters=3, init="random", n_init=5, seed=2))
+        # with restarts, random init should converge to comparable quality
+        assert float(out2.inertia) < 2.0 * float(out.inertia) + 1e-6
+
+    def test_n_init_picks_best(self):
+        X, _ = _blobs(n=400, k=4, std=1.0)
+        one = kmeans.fit(X, kmeans.KMeansParams(n_clusters=4, n_init=1, seed=3))
+        five = kmeans.fit(X, kmeans.KMeansParams(n_clusters=4, n_init=5, seed=3))
+        assert float(five.inertia) <= float(one.inertia) + 1e-3
+
+    def test_sample_weight(self):
+        X, _ = _blobs(n=300, k=2)
+        w = np.ones(300, np.float32)
+        out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=2, seed=0), sample_weight=w)
+        out_none = kmeans.fit(X, kmeans.KMeansParams(n_clusters=2, seed=0))
+        np.testing.assert_allclose(
+            np.sort(np.asarray(out.centroids), 0),
+            np.sort(np.asarray(out_none.centroids), 0),
+            rtol=1e-4,
+        )
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            kmeans.KMeansParams(init="bogus")
+        with pytest.raises(ValueError):
+            kmeans.fit(np.zeros((3, 2), np.float32), kmeans.KMeansParams(n_clusters=5))
+
+
+class TestKMeansBalanced:
+    def test_balance(self):
+        # skewed data: one dense blob + sparse halo; plain Lloyd would starve
+        rng = np.random.default_rng(0)
+        dense = rng.normal(0, 0.05, (1800, 8)).astype(np.float32)
+        halo = rng.normal(0, 3.0, (200, 8)).astype(np.float32)
+        X = np.vstack([dense, halo])
+        k = 16
+        centers, labels = kmeans_balanced.fit_predict(
+            X, k, kmeans_balanced.KMeansBalancedParams(n_iters=25, seed=0)
+        )
+        sizes = np.bincount(np.asarray(labels), minlength=k)
+        assert sizes.min() > 0, "balanced k-means must not produce empty clusters"
+        assert sizes.max() / max(sizes.mean(), 1) < 6.0, f"too skewed: {sizes}"
+
+    def test_labels_consistent_with_centers(self):
+        X, _ = _blobs(n=600, k=8)
+        centers, labels = kmeans_balanced.fit_predict(X, 8)
+        relabel = kmeans_balanced.predict(X, centers)
+        np.testing.assert_array_equal(np.asarray(labels), np.asarray(relabel))
+
+    def test_inner_product_metric(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 12)).astype(np.float32)
+        X /= np.linalg.norm(X, axis=1, keepdims=True)
+        p = kmeans_balanced.KMeansBalancedParams(metric="inner_product", n_iters=10)
+        centers, labels = kmeans_balanced.fit_predict(X, 6, p)
+        ip = X @ np.asarray(centers).T
+        np.testing.assert_array_equal(np.asarray(labels), ip.argmax(1))
+
+    def test_calc_centers_and_sizes(self):
+        X = np.arange(12, dtype=np.float32).reshape(6, 2)
+        labels = np.array([0, 0, 1, 1, 2, 2], np.int32)
+        centers, sizes = kmeans_balanced.calc_centers_and_sizes(X, labels, 4)
+        np.testing.assert_array_equal(np.asarray(sizes), [2, 2, 2, 0])
+        np.testing.assert_allclose(np.asarray(centers)[:3], [[1, 2], [5, 6], [9, 10]])
