@@ -27,8 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.core.resources import Resources, current_resources
-from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t, pairwise_distance
+from raft_tpu.core.resources import Resources, current_resources, use_resources
+from raft_tpu.ops.distance import fused_l2_nn_argmin, pairwise_distance
 
 
 @dataclass(frozen=True)
@@ -71,9 +71,14 @@ def _update_centers(X, labels, weights, n_clusters, old_centers):
     return jnp.where(counts[:, None] > 0, sums / safe, old_centers), counts
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "tol", "n_clusters"))
-def _lloyd(X, centers0, weights, max_iter, tol, n_clusters):
-    """Whole-fit-in-one-program Lloyd loop (fit_main analog, kmeans.cuh:617)."""
+@functools.partial(jax.jit, static_argnames=("max_iter", "tol", "n_clusters", "workspace_bytes"))
+def _lloyd(X, centers0, weights, max_iter, tol, n_clusters, workspace_bytes=None):
+    """Whole-fit-in-one-program Lloyd loop (fit_main analog, kmeans.cuh:617).
+
+    ``workspace_bytes`` only keys the jit cache: the inner fused_l2_nn_argmin
+    reads the scoped Resources at trace time for its tile budget, so a changed
+    budget must force a retrace."""
+    del workspace_bytes
 
     def em_step(centers):
         d2, labels = fused_l2_nn_argmin(X, centers)
@@ -179,16 +184,22 @@ def fit(
             centers0 = _init_random(kinit, X, params.n_clusters)
         else:
             centers0 = _init_plus_plus(kinit, X, weights, params.n_clusters)
-        out = KMeansOutput(
-            *_lloyd(X, centers0, weights, params.max_iter, float(params.tol), params.n_clusters)
-        )
+        with use_resources(res):
+            out = KMeansOutput(
+                *_lloyd(
+                    X, centers0, weights, params.max_iter, float(params.tol),
+                    params.n_clusters, int(res.workspace_bytes),
+                )
+            )
         if best is None or float(out.inertia) < float(best.inertia):
             best = out
         if params.init == "array":
             break  # deterministic start: n_init re-runs would be identical
     assert best is not None
     if params.metric == "euclidean":
-        best = best._replace(inertia=jnp.sqrt(best.inertia))
+        # euclidean objective = sum of distances, not sum of squares
+        d, _ = fused_l2_nn_argmin(X, best.centroids, sqrt=True, res=res)
+        best = best._replace(inertia=jnp.sum(d * weights))
     return best
 
 

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.resources import Resources, current_resources, use_resources
 from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t
 
 
@@ -75,20 +75,20 @@ def calc_centers_and_sizes(X, labels, n_clusters: int, old_centers=None):
     return means, sizes.astype(jnp.int32)
 
 
-# center weight in the adjust step's weighted average — anomalously small
-# clusters jump most of the way to the donor, healthy-but-small ones drift
-# (kAdjustCentersWeight analog, detail/kmeans_balanced.cuh:474)
-_ADJUST_CENTERS_WEIGHT = 7.0
-
-
-@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters", "metric", "threshold"))
-def _balanced_em(X, centers0, key, n_clusters, n_iters, metric, threshold):
+@functools.partial(
+    jax.jit, static_argnames=("n_clusters", "n_iters", "metric", "threshold", "workspace_bytes")
+)
+def _balanced_em(X, centers0, key, n_clusters, n_iters, metric, threshold, workspace_bytes=None):
     """balancing_em_iters analog (detail/kmeans_balanced.cuh:619): EM where each
     iteration pulls underweight clusters toward random samples of over-average
     clusters (adjust_centers, :456-483). Like the reference's
     ``balancing_pullback`` (:651-654), the iteration budget extends while
     rebalancing is still firing, capped at 5×n_iters.
+
+    ``workspace_bytes`` only keys the jit cache so a changed Resources budget
+    retraces the inner fused_l2_nn_argmin tiling.
     """
+    del workspace_bytes
     n = X.shape[0]
     average = n / n_clusters
     max_iters = 5 * n_iters
@@ -176,15 +176,17 @@ def _fit_full(X, n_clusters, params, res):
     k_init, k_adjust = jax.random.split(key)
     rows = jax.random.choice(k_init, n, (n_clusters,), replace=False)
     centers0 = X[rows].astype(jnp.float32)
-    return _balanced_em(
-        X.astype(jnp.float32),
-        centers0,
-        k_adjust,
-        int(n_clusters),
-        int(params.n_iters),
-        params.metric,
-        float(params.balancing_threshold),
-    )
+    with use_resources(res):
+        return _balanced_em(
+            X.astype(jnp.float32),
+            centers0,
+            k_adjust,
+            int(n_clusters),
+            int(params.n_iters),
+            params.metric,
+            float(params.balancing_threshold),
+            int(res.workspace_bytes),
+        )
 
 
 def predict(

@@ -1,0 +1,364 @@
+"""IVF-Flat: inverted-file index with uncompressed (flat) vectors.
+
+Reference surface: raft::neighbors::ivf_flat — build (ivf_flat-inl.cuh:65 →
+detail/ivf_flat_build.cuh, kmeans_balanced trainer at :384), search
+(ivf_flat-inl.cuh:516 → detail/ivf_flat_search-inl.cuh:38: coarse distance +
+select_k of n_probes lists :130 → interleaved list scan :149 → final select_k
+:194), extend, serialize (ivf_flat_serialize.cuh); params ivf_flat_types.hpp
+(n_lists, kmeans_n_iters, kmeans_trainset_fraction, adaptive_centers).
+
+TPU design. The reference stores each list as variable-length interleaved
+groups of 32 vectors (kIndexGroupSize, ivf_flat_types.hpp:47) and launches one
+CTA per (query, probe). Variable-length anything is hostile to XLA's static
+shapes, so lists here are **padded dense blocks**: one (n_lists, max_list_size,
+dim) array with per-entry validity given by ``list_ids >= 0``. Balanced
+k-means (cluster/kmeans_balanced.py) bounds the skew, so the padding overhead
+is a small constant factor; max_list_size is rounded up to a multiple of 32
+(the kIndexGroupSize analog — keeps the scan dimension MXU/VPU aligned).
+
+Search is two select_k stages around one gather+batched-matmul scan:
+coarse distances ride the MXU as a single (q, n_lists) gemm; the list scan
+gathers (q_tile, n_probes, max_list_size, dim) candidate blocks from HBM and
+reduces them with an einsum — HBM-bandwidth-bound, tiled over queries by the
+Resources workspace budget so the gather never blows past the budget.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops.select_k import select_k
+
+SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
+_GROUP_SIZE = 32  # kIndexGroupSize parity (ivf_flat_types.hpp:47)
+
+
+@dataclass(frozen=True)
+class IvfFlatParams:
+    """Build params (ivf_flat_types.hpp index_params analog)."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        m = dist_mod.canonical_metric(self.metric)
+        if m not in SUPPORTED_METRICS:
+            raise ValueError(f"ivf_flat supports {SUPPORTED_METRICS}, got {self.metric!r}")
+        object.__setattr__(self, "metric", m)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class IvfFlatIndex:
+    """Cluster centers + padded per-list vector blocks.
+
+    ``list_ids[l, j] == -1`` marks padding; valid entries hold the source row
+    id. ``list_norms`` caches per-entry squared L2 norms for the L2 scan.
+    For cosine, vectors and centers are stored L2-normalized and the scan runs
+    as inner product (the reference normalizes the same way for
+    CosineExpanded).
+    """
+
+    centers: jax.Array  # (n_lists, dim) fp32
+    list_data: jax.Array  # (n_lists, max_list_size, dim)
+    list_ids: jax.Array  # (n_lists, max_list_size) int32, -1 = padding
+    list_norms: Optional[jax.Array]  # (n_lists, max_list_size) fp32, L2 only
+    metric: str
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.list_data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_ids >= 0))
+
+    def list_sizes(self) -> jax.Array:
+        return jnp.sum(self.list_ids >= 0, axis=1).astype(jnp.int32)
+
+    def tree_flatten(self):
+        return (self.centers, self.list_data, self.list_ids, self.list_norms), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    # -- persistence (ivf_flat_serialize.cuh analog) -----------------------
+    def save(self, path) -> None:
+        arrays = {
+            "centers": self.centers,
+            "list_data": self.list_data,
+            "list_ids": self.list_ids,
+        }
+        if self.list_norms is not None:
+            arrays["list_norms"] = self.list_norms
+        save_arrays(path, {"kind": "ivf_flat", "metric": self.metric}, arrays)
+
+    @classmethod
+    def load(cls, path) -> "IvfFlatIndex":
+        meta, arrays = load_arrays(path)
+        if meta.get("kind") != "ivf_flat":
+            raise ValueError(f"not an ivf_flat index: {meta.get('kind')}")
+        return cls(
+            jnp.asarray(arrays["centers"]),
+            jnp.asarray(arrays["list_data"]),
+            jnp.asarray(arrays["list_ids"]),
+            jnp.asarray(arrays["list_norms"]) if "list_norms" in arrays else None,
+            meta["metric"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Build
+# ---------------------------------------------------------------------------
+
+
+def _pack_lists(dataset, row_ids, labels, n_lists: int):
+    """Scatter rows into padded per-list blocks (the ivf_list fill,
+    detail/ivf_flat_build.cuh build_index; group-of-32 rounding per
+    kIndexGroupSize)."""
+    n, dim = dataset.shape
+    sizes = jnp.bincount(labels, length=n_lists)
+    max_size = int(jnp.max(sizes))
+    max_size = max(_GROUP_SIZE, -(-max_size // _GROUP_SIZE) * _GROUP_SIZE)
+
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    offsets = jnp.cumsum(sizes) - sizes  # start offset of each list
+    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
+
+    list_data = jnp.zeros((n_lists, max_size, dim), dataset.dtype)
+    list_ids = jnp.full((n_lists, max_size), -1, jnp.int32)
+    list_data = list_data.at[sorted_labels, pos].set(dataset[order])
+    list_ids = list_ids.at[sorted_labels, pos].set(row_ids[order].astype(jnp.int32))
+    return list_data, list_ids
+
+
+def build(
+    dataset,
+    params: IvfFlatParams = IvfFlatParams(),
+    res: Optional[Resources] = None,
+) -> IvfFlatIndex:
+    """Train the coarse quantizer and fill the lists (ivf_flat-inl.cuh:65).
+
+    Trains balanced k-means on a ``kmeans_trainset_fraction`` subsample
+    (ivf_flat_types.hpp:55), then assigns every row to its nearest center.
+    """
+    res = res or current_resources()
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+
+    work = dataset.astype(jnp.float32)
+    if params.metric == "cosine":
+        work = work / jnp.maximum(jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+
+    km_metric = "inner_product" if params.metric in ("cosine", "inner_product") else "sqeuclidean"
+    km = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=km_metric, seed=params.seed
+    )
+
+    n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
+    if n_train < n:
+        key = jax.random.key(params.seed)
+        train_rows = jax.random.choice(key, n, (n_train,), replace=False)
+        centers = kmeans_balanced.fit(work[train_rows], params.n_lists, km, res=res)
+        labels = kmeans_balanced.predict(work, centers, km, res=res)
+    else:
+        centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    list_data, list_ids = _pack_lists(work, row_ids, labels, params.n_lists)
+    list_norms = None
+    if params.metric in ("sqeuclidean", "euclidean"):
+        list_norms = dist_mod.sqnorm(list_data, axis=2)
+    return IvfFlatIndex(centers, list_data, list_ids, list_norms, params.metric)
+
+
+def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resources] = None) -> IvfFlatIndex:
+    """Add vectors to an existing index (ivf_flat extend,
+    detail/ivf_flat_build.cuh extend). Assigns to the fixed centers and
+    repacks the lists (padded blocks are immutable, so extension is a repack
+    rather than the reference's in-place list append)."""
+    res = res or current_resources()
+    new_vectors = jnp.asarray(new_vectors).astype(jnp.float32)
+    if new_vectors.shape[1] != index.dim:
+        raise ValueError(f"dim mismatch: {new_vectors.shape[1]} != {index.dim}")
+    if index.metric == "cosine":
+        new_vectors = new_vectors / jnp.maximum(
+            jnp.linalg.norm(new_vectors, axis=1, keepdims=True), 1e-30
+        )
+
+    old_valid = index.list_ids.reshape(-1) >= 0
+    old_vecs = index.list_data.reshape(-1, index.dim)[old_valid]
+    old_ids = index.list_ids.reshape(-1)[old_valid]
+    old_labels = jnp.repeat(
+        jnp.arange(index.n_lists, dtype=jnp.int32), index.max_list_size
+    )[old_valid]
+
+    if new_ids is None:
+        start = int(jnp.max(old_ids) + 1) if old_ids.size else 0
+        new_ids = jnp.arange(start, start + new_vectors.shape[0], dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+
+    km_metric = (
+        "inner_product" if index.metric in ("cosine", "inner_product") else "sqeuclidean"
+    )
+    new_labels = kmeans_balanced.predict(
+        new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
+    )
+
+    all_vecs = jnp.concatenate([old_vecs, new_vectors])
+    all_ids = jnp.concatenate([old_ids, new_ids])
+    all_labels = jnp.concatenate([old_labels, new_labels])
+    list_data, list_ids = _pack_lists(all_vecs, all_ids, all_labels, index.n_lists)
+    list_norms = None
+    if index.metric in ("sqeuclidean", "euclidean"):
+        list_norms = dist_mod.sqnorm(list_data, axis=2)
+    return IvfFlatIndex(index.centers, list_data, list_ids, list_norms, index.metric)
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "q_tile", "select_algo", "compute_dtype"),
+)
+def _search_impl(
+    queries, centers, list_data, list_ids, list_norms, filter,
+    k, n_probes, metric, q_tile, select_algo, compute_dtype,
+):
+    q, dim = queries.shape
+    n_lists, max_size, _ = list_data.shape
+    select_min = metric != "inner_product"
+    bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
+
+    # ---- stage 1: coarse quantizer (one gemm over all centers) ------------
+    if metric in ("sqeuclidean", "euclidean"):
+        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+        qn = dist_mod.sqnorm(queries)
+    else:  # cosine (pre-normalized) and inner_product probe by max ip
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+        qn = None
+    _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)  # (q, p)
+
+    # ---- stage 2: tiled gather + scan + final select_k --------------------
+    def scan_tile(args):
+        q_blk, qn_blk, probe_blk = args
+        cand = list_data[probe_blk]  # (qt, p, m, d) gather
+        ids = list_ids[probe_blk]  # (qt, p, m)
+        ip = jnp.einsum(
+            "qd,qpmd->qpm", q_blk, cand, preferred_element_type=jnp.float32
+        )
+        if metric in ("sqeuclidean", "euclidean"):
+            norms = list_norms[probe_blk]
+            d = jnp.maximum(qn_blk[:, None, None] + norms - 2.0 * ip, 0.0)
+            if metric == "euclidean":
+                d = jnp.sqrt(d)
+        elif metric == "cosine":
+            d = 1.0 - ip  # inputs are pre-normalized
+        else:
+            d = ip  # inner_product: ranked by max
+        flat_ids = ids.reshape(ids.shape[0], -1)
+        d = d.reshape(flat_ids.shape)
+        valid = flat_ids >= 0
+        if filter is not None:
+            valid = valid & filter.test(flat_ids)
+        d = jnp.where(valid, d, bad)
+        vals, sel = select_k(d, k, select_min=select_min, algo=select_algo)
+        out_ids = jnp.where(vals == bad, -1, jnp.take_along_axis(flat_ids, sel, axis=1))
+        return vals, out_ids
+
+    if qn is None:
+        qn = jnp.zeros((q,), jnp.float32)  # unused, keeps the scan signature static
+    if q_tile >= q:
+        return scan_tile((queries, qn, probes))
+    n_tiles = -(-q // q_tile)
+    pad = n_tiles * q_tile - q
+    qp = jnp.pad(queries, ((0, pad), (0, 0)))
+    qnp = jnp.pad(qn, (0, pad))
+    pp = jnp.pad(probes, ((0, pad), (0, 0)))
+    vals, ids = lax.map(
+        scan_tile,
+        (
+            qp.reshape(n_tiles, q_tile, dim),
+            qnp.reshape(n_tiles, q_tile),
+            pp.reshape(n_tiles, q_tile, n_probes),
+        ),
+    )
+    return vals.reshape(-1, k)[:q], ids.reshape(-1, k)[:q]
+
+
+def search(
+    index: IvfFlatIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Probe ``n_probes`` lists per query and return the top-k
+    (ivf_flat-inl.cuh:516 / detail/ivf_flat_search-inl.cuh:38).
+
+    Returns ``(distances (q,k), indices (q,k))``; indices are source row ids,
+    ``-1`` where fewer than k valid candidates were found. ``filter`` excludes
+    rows by id (bitset_filter analog, sample_filter.cuh:31).
+    """
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, index.n_lists))
+    if not 0 < k <= n_probes * index.max_list_size:
+        raise ValueError(
+            f"k={k} out of range for n_probes={n_probes} x max_list_size={index.max_list_size}"
+        )
+    if index.metric == "cosine":
+        queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+
+    # query-tile size: the (qt, p, m, d) gather is the big intermediate
+    per_query = max(1, n_probes * index.max_list_size * (index.dim + 2) * 4)
+    q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
+    vals, ids = _search_impl(
+        queries,
+        index.centers,
+        index.list_data,
+        index.list_ids,
+        index.list_norms,
+        filter,
+        int(k),
+        n_probes,
+        index.metric,
+        q_tile,
+        select_algo,
+        res.compute_dtype,
+    )
+    return vals, ids

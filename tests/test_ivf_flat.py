@@ -1,0 +1,132 @@
+"""IVF-Flat tests — recall-threshold oracle vs exact brute force, mirroring
+the reference's ann_ivf_flat recall methodology (cpp/test/neighbors/
+ann_utils.cuh:129-218; build/extend/serialize flows ann_ivf_flat.cuh)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat
+
+
+def _recall(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    k = want.shape[1]
+    return np.mean([len(set(got[r]) & set(want[r])) / k for r in range(want.shape[0])])
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    ds = rng.normal(size=(20_000, 32)).astype(np.float32)
+    qs = rng.normal(size=(200, 32)).astype(np.float32)
+    return ds, qs
+
+
+class TestIvfFlat:
+    def test_recall_l2(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=64, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_flat.search(idx, qs, 10, n_probes=32)
+        assert _recall(got, exact) >= 0.94
+
+    def test_recall_improves_with_probes(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=64, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        r_lo = _recall(ivf_flat.search(idx, qs, 10, n_probes=2)[1], exact)
+        r_hi = _recall(ivf_flat.search(idx, qs, 10, n_probes=48)[1], exact)
+        assert r_hi >= r_lo
+        assert r_hi >= 0.98
+
+    def test_all_probes_is_exact(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=32, seed=0))
+        vex, exact = brute_force.knn(qs, ds, 5)
+        v, got = ivf_flat.search(idx, qs, 5, n_probes=32)
+        assert _recall(got, exact) == 1.0
+        np.testing.assert_allclose(np.asarray(v), np.asarray(vex), rtol=1e-4, atol=1e-3)
+
+    def test_inner_product(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=64, metric="inner_product"))
+        _, exact = brute_force.knn(qs, ds, 10, metric="inner_product")
+        _, got = ivf_flat.search(idx, qs, 10, n_probes=32)
+        assert _recall(got, exact) >= 0.85
+
+    def test_cosine(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=64, metric="cosine"))
+        vals, got = ivf_flat.search(idx, qs, 10, n_probes=32)
+        _, exact = brute_force.knn(qs, ds, 10, metric="cosine")
+        assert _recall(got, exact) >= 0.85
+        v = np.asarray(vals)
+        assert np.all(v >= -1e-4) and np.all(v <= 2.0001), "cosine distance range"
+
+    def test_extend(self, data):
+        ds, qs = data
+        half = ds.shape[0] // 2
+        idx = ivf_flat.build(ds[:half], ivf_flat.IvfFlatParams(n_lists=64, seed=0))
+        idx = ivf_flat.extend(idx, ds[half:])
+        assert idx.size == ds.shape[0]
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_flat.search(idx, qs, 10, n_probes=32)
+        assert _recall(got, exact) >= 0.94
+
+    def test_serialize_roundtrip(self, tmp_path, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds[:5000], ivf_flat.IvfFlatParams(n_lists=32, seed=0))
+        p = tmp_path / "ivf.raft"
+        idx.save(p)
+        idx2 = ivf_flat.IvfFlatIndex.load(p)
+        v1, i1 = ivf_flat.search(idx, qs, 5, n_probes=8)
+        v2, i2 = ivf_flat.search(idx2, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+    def test_filter(self, data):
+        ds, qs = data
+        n = 5000
+        idx = ivf_flat.build(ds[:n], ivf_flat.IvfFlatParams(n_lists=32, seed=0))
+        keep = Bitset.from_mask(np.arange(n) < n // 2)
+        _, got = ivf_flat.search(idx, qs, 10, n_probes=32, filter=keep)
+        got = np.asarray(got)
+        assert got.max() < n // 2
+        # compare against brute force over the kept half
+        _, exact = brute_force.knn(qs, ds[: n // 2], 10)
+        assert _recall(got, exact) >= 0.9
+
+    def test_all_filtered_returns_sentinel(self, data):
+        ds, qs = data
+        idx = ivf_flat.build(ds[:2000], ivf_flat.IvfFlatParams(n_lists=16, seed=0))
+        none = Bitset.create(2000, default=False)
+        vals, got = ivf_flat.search(idx, qs[:4], 3, n_probes=16, filter=none)
+        assert np.all(np.asarray(got) == -1)
+        assert np.all(np.isinf(np.asarray(vals)))
+
+    def test_list_packing_exact(self):
+        rng = np.random.default_rng(0)
+        ds = rng.normal(size=(500, 8)).astype(np.float32)
+        idx = ivf_flat.build(ds, ivf_flat.IvfFlatParams(n_lists=8, seed=0))
+        sizes = np.asarray(idx.list_sizes())
+        assert sizes.sum() == 500
+        assert idx.max_list_size % 32 == 0
+        # every stored vector matches its source row
+        ids = np.asarray(idx.list_ids)
+        data = np.asarray(idx.list_data)
+        for l in range(8):
+            for j in range(sizes[l]):
+                np.testing.assert_allclose(data[l, j], ds[ids[l, j]], rtol=1e-6)
+
+    def test_validation(self, data):
+        ds, qs = data
+        with pytest.raises(ValueError):
+            ivf_flat.IvfFlatParams(metric="l1")
+        with pytest.raises(ValueError):
+            ivf_flat.build(ds[:10], ivf_flat.IvfFlatParams(n_lists=100))
+        idx = ivf_flat.build(ds[:2000], ivf_flat.IvfFlatParams(n_lists=16))
+        with pytest.raises(ValueError):
+            ivf_flat.search(idx, qs[:, :16], 5)
+        with pytest.raises(ValueError):
+            ivf_flat.search(idx, qs, 0)
