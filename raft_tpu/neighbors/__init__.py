@@ -15,6 +15,6 @@ All share the filter protocol (`Bitset` prefilter, sample_filter.cuh:31) and
 container serialization (core/serialize.py).
 """
 
-from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
 
-__all__ = ["brute_force", "ivf_flat"]
+__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]

@@ -1,0 +1,49 @@
+"""Shared padded-list packing for IVF indexes.
+
+The TPU replacement for the reference's variable-length interleaved list
+containers (ivf_list.hpp, kIndexGroupSize grouping ivf_flat_types.hpp:47):
+rows are scattered into one dense (n_lists, max_list_size, ...) block, with
+``list_ids == -1`` marking padding. Used by ivf_flat (raw vectors) and
+ivf_pq (codes); both build and extend flows.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int) -> Tuple:
+    """Scatter rows into padded per-list blocks.
+
+    payload: (n, ...) per-row data; row_ids: (n,) source ids; labels: (n,)
+    list assignment. max_list_size = max cluster size rounded up to
+    ``group_size``. Returns (list_payload, list_ids).
+    """
+    n = payload.shape[0]
+    sizes = jnp.bincount(labels, length=n_lists)
+    max_size = int(jnp.max(sizes))
+    max_size = max(group_size, -(-max_size // group_size) * group_size)
+
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    offsets = jnp.cumsum(sizes) - sizes
+    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
+
+    list_payload = jnp.zeros((n_lists, max_size) + payload.shape[1:], payload.dtype)
+    list_ids = jnp.full((n_lists, max_size), -1, jnp.int32)
+    list_payload = list_payload.at[sorted_labels, pos].set(payload[order])
+    list_ids = list_ids.at[sorted_labels, pos].set(row_ids[order].astype(jnp.int32))
+    return list_payload, list_ids
+
+
+def unpack_lists(list_payload, list_ids) -> Tuple:
+    """Inverse of pack_lists: recover the valid (payload, ids, labels) rows
+    (used by extend to repack with additions)."""
+    n_lists, max_size = list_ids.shape
+    valid = list_ids.reshape(-1) >= 0
+    payload = list_payload.reshape((-1,) + list_payload.shape[2:])[valid]
+    ids = list_ids.reshape(-1)[valid]
+    labels = jnp.repeat(jnp.arange(n_lists, dtype=jnp.int32), max_size)[valid]
+    return payload, ids, labels

@@ -35,10 +35,12 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors._packing import pack_lists, unpack_lists
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.select_k import select_k
+from raft_tpu.utils.tiling import map_row_tiles
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
 _GROUP_SIZE = 32  # kIndexGroupSize parity (ivf_flat_types.hpp:47)
@@ -136,24 +138,9 @@ class IvfFlatIndex:
 
 
 def _pack_lists(dataset, row_ids, labels, n_lists: int):
-    """Scatter rows into padded per-list blocks (the ivf_list fill,
-    detail/ivf_flat_build.cuh build_index; group-of-32 rounding per
-    kIndexGroupSize)."""
-    n, dim = dataset.shape
-    sizes = jnp.bincount(labels, length=n_lists)
-    max_size = int(jnp.max(sizes))
-    max_size = max(_GROUP_SIZE, -(-max_size // _GROUP_SIZE) * _GROUP_SIZE)
-
-    order = jnp.argsort(labels)
-    sorted_labels = labels[order]
-    offsets = jnp.cumsum(sizes) - sizes  # start offset of each list
-    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
-
-    list_data = jnp.zeros((n_lists, max_size, dim), dataset.dtype)
-    list_ids = jnp.full((n_lists, max_size), -1, jnp.int32)
-    list_data = list_data.at[sorted_labels, pos].set(dataset[order])
-    list_ids = list_ids.at[sorted_labels, pos].set(row_ids[order].astype(jnp.int32))
-    return list_data, list_ids
+    """Padded per-list blocks (the ivf_list fill, detail/ivf_flat_build.cuh
+    build_index; group-of-32 rounding per kIndexGroupSize)."""
+    return pack_lists(dataset, row_ids, labels, n_lists, _GROUP_SIZE)
 
 
 def build(
@@ -212,12 +199,7 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
             jnp.linalg.norm(new_vectors, axis=1, keepdims=True), 1e-30
         )
 
-    old_valid = index.list_ids.reshape(-1) >= 0
-    old_vecs = index.list_data.reshape(-1, index.dim)[old_valid]
-    old_ids = index.list_ids.reshape(-1)[old_valid]
-    old_labels = jnp.repeat(
-        jnp.arange(index.n_lists, dtype=jnp.int32), index.max_list_size
-    )[old_valid]
+    old_vecs, old_ids, old_labels = unpack_lists(index.list_data, index.list_ids)
 
     if new_ids is None:
         start = int(jnp.max(old_ids) + 1) if old_ids.size else 0
@@ -298,22 +280,7 @@ def _search_impl(
 
     if qn is None:
         qn = jnp.zeros((q,), jnp.float32)  # unused, keeps the scan signature static
-    if q_tile >= q:
-        return scan_tile((queries, qn, probes))
-    n_tiles = -(-q // q_tile)
-    pad = n_tiles * q_tile - q
-    qp = jnp.pad(queries, ((0, pad), (0, 0)))
-    qnp = jnp.pad(qn, (0, pad))
-    pp = jnp.pad(probes, ((0, pad), (0, 0)))
-    vals, ids = lax.map(
-        scan_tile,
-        (
-            qp.reshape(n_tiles, q_tile, dim),
-            qnp.reshape(n_tiles, q_tile),
-            pp.reshape(n_tiles, q_tile, n_probes),
-        ),
-    )
-    return vals.reshape(-1, k)[:q], ids.reshape(-1, k)[:q]
+    return map_row_tiles(scan_tile, (queries, qn, probes), q_tile)
 
 
 def search(

@@ -1,0 +1,631 @@
+"""IVF-PQ: inverted-file index with product-quantized residual vectors.
+
+Reference surface: raft::neighbors::ivf_pq — build (ivf_pq-inl.cuh:273 →
+detail/ivf_pq_build.cuh:1729: kmeans_balanced coarse trainer :1828, random
+rotation make_rotation_matrix :119, codebook training train_per_subset :392,
+encode process_and_fill_codes :1319), search (detail/ivf_pq_search.cuh:731:
+select_clusters :69 → LUT-based scan ivfpq_search_worker :420 →
+select_k :586 → optional refine re-rank refine-inl.cuh:70); params
+ivf_pq_types.hpp:36-264 (pq_bits 4..8, pq_dim, codebook per-subspace).
+
+TPU design — the LUT scan rearranged so the per-probe work is additive
+constants plus a *per-query-only* table:
+
+    d²(q, x_j∈list l) ≈ |q - c_l|²                      (stage-1 coarse value)
+                      + Σ_s −2·(Rq)_s·cb[s, code_js]    (query-only LUT A)
+                      + Σ_s (2·(Rc_l)_s·cb[s, code_js]
+                             + |cb[s, code_js]|²)        (b_sum: baked at build)
+
+The reference rebuilds a LUT per (query, probe) from the rotated residual
+(ivf_pq_search.cuh:420); splitting the residual LUT into A (query half) and
+b_sum (list half, a per-entry scalar precomputed at build) removes the
+per-probe LUT entirely: search-time work is one gemm for A, the stage-1
+coarse gemm, and a code→A lookup. The lookup itself has two backends:
+
+  * jnp gather (`take_along_axis`) — correct everywhere, the CPU/test oracle;
+  * the Pallas list-centric kernel (ops/pq_scan.py) — queries batched as the
+    MXU N-dimension against in-VMEM one-hot code blocks (used on TPU).
+
+Codes are stored one byte per sub-dimension in padded dense lists like
+ivf_flat (XLA static shapes; kIndexGroupSize-aligned).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops.pq_scan import group_probed_pairs, pq_scan
+from raft_tpu.ops.select_k import select_k
+
+SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
+# lists padded to 128 (vs the reference kIndexGroupSize 32): the Pallas scan
+# kernel needs a 128-aligned minor dimension
+_GROUP_SIZE = 128
+
+
+@dataclass(frozen=True)
+class IvfPqParams:
+    """Build params (ivf_pq_types.hpp index_params analog)."""
+
+    n_lists: int = 1024
+    pq_dim: int = 0  # 0 = auto: dim/2 rounded up to a multiple of 8
+    pq_bits: int = 8  # codebook size = 2**pq_bits, 4..8 like the reference
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    codebook_n_iters: int = 25
+    seed: int = 0
+
+    def __post_init__(self):
+        m = dist_mod.canonical_metric(self.metric)
+        if m not in SUPPORTED_METRICS:
+            raise ValueError(f"ivf_pq supports {SUPPORTED_METRICS}, got {self.metric!r}")
+        object.__setattr__(self, "metric", m)
+        if not 4 <= self.pq_bits <= 8:
+            raise ValueError(f"pq_bits must be in [4, 8], got {self.pq_bits}")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class IvfPqIndex:
+    """Coarse centers + rotation + per-subspace codebooks + packed code lists.
+
+    ``b_sum`` carries the list-side half of the L2 LUT decomposition (zeros
+    for inner-product metrics). ``list_ids[l, j] == -1`` marks padding.
+    """
+
+    centers: jax.Array  # (n_lists, dim) fp32 — unrotated, for stage 1
+    rotation: jax.Array  # (rot_dim, rot_dim) orthogonal
+    codebooks: jax.Array  # (pq_dim, n_codes, dsub) fp32
+    list_codes: jax.Array  # (n_lists, max_list_size, pq_dim) uint8
+    list_ids: jax.Array  # (n_lists, max_list_size) int32
+    b_sum: jax.Array  # (n_lists, max_list_size) fp32
+    metric: str
+    pq_bits: int
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codebooks.shape[0]
+
+    @property
+    def n_codes(self) -> int:
+        return self.codebooks.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.list_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_ids >= 0))
+
+    def list_sizes(self) -> jax.Array:
+        return jnp.sum(self.list_ids >= 0, axis=1).astype(jnp.int32)
+
+    def tree_flatten(self):
+        return (
+            self.centers, self.rotation, self.codebooks,
+            self.list_codes, self.list_ids, self.b_sum,
+        ), (self.metric, self.pq_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0], aux[1])
+
+    # -- persistence (ivf_pq_serialize.cuh analog) -------------------------
+    def save(self, path) -> None:
+        save_arrays(
+            path,
+            {"kind": "ivf_pq", "metric": self.metric, "pq_bits": self.pq_bits},
+            {
+                "centers": self.centers,
+                "rotation": self.rotation,
+                "codebooks": self.codebooks,
+                "list_codes": self.list_codes,
+                "list_ids": self.list_ids,
+                "b_sum": self.b_sum,
+            },
+        )
+
+    @classmethod
+    def load(cls, path) -> "IvfPqIndex":
+        meta, arrays = load_arrays(path)
+        if meta.get("kind") != "ivf_pq":
+            raise ValueError(f"not an ivf_pq index: {meta.get('kind')}")
+        return cls(
+            jnp.asarray(arrays["centers"]),
+            jnp.asarray(arrays["rotation"]),
+            jnp.asarray(arrays["codebooks"]),
+            jnp.asarray(arrays["list_codes"]),
+            jnp.asarray(arrays["list_ids"]),
+            jnp.asarray(arrays["b_sum"]),
+            meta["metric"],
+            int(meta["pq_bits"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Build pieces
+# ---------------------------------------------------------------------------
+
+
+def _auto_pq_dim(dim: int) -> int:
+    pq = max(1, dim // 2)
+    return -(-pq // 8) * 8 if pq >= 8 else pq
+
+
+def make_rotation_matrix(key, rot_dim: int) -> jax.Array:
+    """Random orthogonal (rot_dim, rot_dim) via QR of a gaussian
+    (make_rotation_matrix analog, detail/ivf_pq_build.cuh:119)."""
+    g = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
+    q, r = jnp.linalg.qr(g)
+    return q * jnp.sign(jnp.diagonal(r))[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("n_codes", "n_iters"))
+def _train_codebooks(resid_sub, key, n_codes, n_iters):
+    """Per-subspace Lloyd k-means (train_per_subset analog,
+    detail/ivf_pq_build.cuh:392).
+
+    resid_sub: (pq_dim, n_train, dsub) rotated residuals. Sequential
+    `lax.map` over subspaces — each holds an (n_train, n_codes) distance
+    block; mapping (not vmapping) keeps only one block live at a time.
+    """
+    pq_dim, n_train, dsub = resid_sub.shape
+
+    def one_subspace(args):
+        X, key = args
+        rows = jax.random.choice(key, n_train, (n_codes,), replace=False)
+        centers0 = X[rows]
+
+        def step(_, centers):
+            d2 = (
+                dist_mod.sqnorm(X)[:, None]
+                + dist_mod.sqnorm(centers)[None, :]
+                - 2.0 * dist_mod.matmul_t(X, centers)
+            )
+            labels = jnp.argmin(d2, axis=1)
+            sums = jax.ops.segment_sum(X, labels, num_segments=n_codes)
+            counts = jax.ops.segment_sum(jnp.ones(n_train), labels, num_segments=n_codes)
+            return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
+
+        return lax.fori_loop(0, n_iters, step, centers0)
+
+    keys = jax.random.split(key, pq_dim)
+    return lax.map(one_subspace, (resid_sub, keys))
+
+
+def _encode(resid_rot, codebooks, chunk: int = 8192):
+    """resid_rot (n, pq_dim, dsub) → codes (n, pq_dim) uint8: per-subspace
+    nearest codebook entry (process_and_fill_codes analog,
+    detail/ivf_pq_build.cuh:1319). Chunked over rows so the (chunk, pq_dim,
+    n_codes) distance block stays bounded."""
+    n = resid_rot.shape[0]
+    cn = jnp.sum(codebooks * codebooks, axis=2)  # (s, c)
+
+    def enc(chunk_rows):
+        ip = jnp.einsum(
+            "nsd,scd->nsc", chunk_rows, codebooks, preferred_element_type=jnp.float32
+        )
+        return jnp.argmin(cn[None] - 2.0 * ip, axis=2).astype(jnp.uint8)
+
+    if n <= chunk:
+        return enc(resid_rot)
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    padded = jnp.pad(resid_rot, ((0, pad), (0, 0), (0, 0)))
+    out = lax.map(enc, padded.reshape(n_chunks, chunk, *resid_rot.shape[1:]))
+    return out.reshape(-1, resid_rot.shape[1])[:n]
+
+
+def _pack_lists(codes, row_ids, labels, n_lists: int):
+    n, pq_dim = codes.shape
+    sizes = jnp.bincount(labels, length=n_lists)
+    max_size = int(jnp.max(sizes))
+    max_size = max(_GROUP_SIZE, -(-max_size // _GROUP_SIZE) * _GROUP_SIZE)
+
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    offsets = jnp.cumsum(sizes) - sizes
+    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
+
+    list_codes = jnp.zeros((n_lists, max_size, pq_dim), jnp.uint8)
+    list_ids = jnp.full((n_lists, max_size), -1, jnp.int32)
+    list_codes = list_codes.at[sorted_labels, pos].set(codes[order])
+    list_ids = list_ids.at[sorted_labels, pos].set(row_ids[order].astype(jnp.int32))
+    return list_codes, list_ids
+
+
+def _pad_rot(x, rot_dim):
+    pad = rot_dim - x.shape[1]
+    return jnp.pad(x, ((0, 0), (0, pad))) if pad else x
+
+
+def build(
+    dataset,
+    params: IvfPqParams = IvfPqParams(),
+    res: Optional[Resources] = None,
+) -> IvfPqIndex:
+    """Train coarse centers, rotation, codebooks; encode and pack the lists
+    (ivf_pq-inl.cuh:273 / detail/ivf_pq_build.cuh:1729)."""
+    res = res or current_resources()
+    dataset = jnp.asarray(dataset).astype(jnp.float32)
+    n, dim = dataset.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+    pq_dim = params.pq_dim or _auto_pq_dim(dim)
+    if pq_dim > dim:
+        raise ValueError(f"pq_dim={pq_dim} > dim={dim}")
+    dsub = -(-dim // pq_dim)
+    rot_dim = pq_dim * dsub
+    n_codes = 1 << params.pq_bits
+
+    work = dataset
+    if params.metric == "cosine":
+        work = work / jnp.maximum(jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+
+    # --- coarse quantizer (ivf_pq_build.cuh:1828) --------------------------
+    km_metric = "inner_product" if params.metric in ("cosine", "inner_product") else "sqeuclidean"
+    km = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=km_metric, seed=params.seed
+    )
+    key = jax.random.key(params.seed)
+    k_train, k_rot, k_cb = jax.random.split(key, 3)
+    n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
+    if n_train < n:
+        train_rows = jax.random.choice(k_train, n, (n_train,), replace=False)
+        trainset = work[train_rows]
+        centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
+        labels = kmeans_balanced.predict(work, centers, km, res=res)
+    else:
+        trainset = work
+        centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+
+    # --- rotation + codebooks (ivf_pq_build.cuh:119,:392) ------------------
+    rotation = make_rotation_matrix(k_rot, rot_dim)
+    train_labels = kmeans_balanced.predict(trainset, centers, km, res=res)
+    resid = _pad_rot(trainset - centers[train_labels], rot_dim) @ rotation.T
+    cb_rows = min(resid.shape[0], 65536)
+    resid_cb = resid[:cb_rows].reshape(cb_rows, pq_dim, dsub).transpose(1, 0, 2)
+    codebooks = _train_codebooks(
+        resid_cb, k_cb, n_codes, params.codebook_n_iters
+    )
+
+    # --- encode + pack (ivf_pq_build.cuh:1319) -----------------------------
+    resid_all = _pad_rot(work - centers[labels], rot_dim) @ rotation.T
+    codes = _encode(resid_all.reshape(n, pq_dim, dsub), codebooks)
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    list_codes, list_ids = _pack_lists(codes, row_ids, labels, params.n_lists)
+
+    b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, params.metric)
+    return IvfPqIndex(
+        centers, rotation, codebooks, list_codes, list_ids, b_sum,
+        params.metric, params.pq_bits,
+    )
+
+
+def _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, metric):
+    """List-side LUT half, baked per entry: Σ_s (2·(Rc_l)_s·cb[s,code] +
+    |cb[s,code]|²) for L2; zeros for inner-product metrics (module docstring
+    derivation). Padding entries get +inf so the scan output self-masks."""
+    n_lists, max_size, pq_dim = list_codes.shape
+    pad_inf = jnp.where(list_ids >= 0, 0.0, jnp.inf).astype(jnp.float32)
+    if metric in ("inner_product", "cosine"):
+        return pad_inf
+    dsub = codebooks.shape[2]
+    n_codes = codebooks.shape[1]
+    rot_dim = pq_dim * dsub
+    rc = (_pad_rot(centers, rot_dim) @ rotation.T).reshape(n_lists, pq_dim, dsub)
+    # B[l, s, c] = 2 (Rc_l)_s · cb[s,c] + |cb[s,c]|²
+    B = 2.0 * jnp.einsum("lsd,scd->lsc", rc, codebooks, preferred_element_type=jnp.float32)
+    B = B + jnp.sum(codebooks * codebooks, axis=2)[None]
+    # per-list flat gather (take from a 1-d table per list — avoids the
+    # (l, m, s, n_codes) broadcast a take_along_axis would materialize)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+
+    def one_list(args):
+        B_l, codes_l = args  # (s, c), (m, s)
+        flat_idx = codes_l.astype(jnp.int32) + s_off
+        return jnp.sum(jnp.take(B_l.reshape(-1), flat_idx, axis=0), axis=1)
+
+    return lax.map(one_list, (B, list_codes)) + pad_inf
+
+
+def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources] = None) -> IvfPqIndex:
+    """Encode new vectors with the existing quantizers and repack
+    (ivf_pq extend analog)."""
+    res = res or current_resources()
+    new_vectors = jnp.asarray(new_vectors).astype(jnp.float32)
+    if new_vectors.shape[1] != index.dim:
+        raise ValueError(f"dim mismatch: {new_vectors.shape[1]} != {index.dim}")
+    if index.metric == "cosine":
+        new_vectors = new_vectors / jnp.maximum(
+            jnp.linalg.norm(new_vectors, axis=1, keepdims=True), 1e-30
+        )
+    km_metric = "inner_product" if index.metric in ("cosine", "inner_product") else "sqeuclidean"
+    labels = kmeans_balanced.predict(
+        new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
+    )
+    dsub = index.codebooks.shape[2]
+    resid = _pad_rot(new_vectors - index.centers[labels], index.rot_dim) @ index.rotation.T
+    codes = _encode(resid.reshape(new_vectors.shape[0], index.pq_dim, dsub), index.codebooks)
+
+    old_valid = index.list_ids.reshape(-1) >= 0
+    old_codes = index.list_codes.reshape(-1, index.pq_dim)[old_valid]
+    old_ids = index.list_ids.reshape(-1)[old_valid]
+    old_labels = jnp.repeat(
+        jnp.arange(index.n_lists, dtype=jnp.int32), index.max_list_size
+    )[old_valid]
+    if new_ids is None:
+        start = int(jnp.max(old_ids) + 1) if old_ids.size else 0
+        new_ids = jnp.arange(start, start + new_vectors.shape[0], dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+
+    all_codes = jnp.concatenate([old_codes, codes])
+    all_ids = jnp.concatenate([old_ids, new_ids])
+    all_labels = jnp.concatenate([old_labels, labels])
+    list_codes, list_ids = _pack_lists(all_codes, all_ids, all_labels, index.n_lists)
+    b_sum = _compute_b_sum(
+        index.centers, index.rotation, index.codebooks, list_codes, list_ids, index.metric
+    )
+    return IvfPqIndex(
+        index.centers, index.rotation, index.codebooks, list_codes, list_ids,
+        b_sum, index.metric, index.pq_bits,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+
+def _query_luts(queries, rotation, codebooks, metric, lut_dtype):
+    """Per-query LUT A (q, pq_dim, n_codes): the query-only half of the scan
+    (module docstring). One einsum — rides the MXU."""
+    q = queries.shape[0]
+    pq_dim, n_codes, dsub = codebooks.shape
+    rq = (_pad_rot(queries, pq_dim * dsub) @ rotation.T).reshape(q, pq_dim, dsub)
+    A = jnp.einsum("qsd,scd->qsc", rq, codebooks, preferred_element_type=jnp.float32)
+    if metric in ("sqeuclidean", "euclidean"):
+        A = -2.0 * A
+    else:  # inner product family: score = coarse_ip + Σ (Rq)·cb; negate → min
+        A = -A
+    return A.astype(lut_dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "q_tile", "select_algo", "compute_dtype"),
+)
+def _search_impl_jnp(
+    queries, centers, rotation, codebooks, list_codes, list_ids, b_sum, filter,
+    k, n_probes, metric, q_tile, select_algo, compute_dtype,
+):
+    """Gather-backend search: stage-1 coarse gemm + per-query LUT + code
+    lookup via take_along_axis, tiled over queries."""
+    q, dim = queries.shape
+    n_lists, max_size, pq_dim = list_codes.shape
+    l2 = metric in ("sqeuclidean", "euclidean")
+
+    # stage 1: coarse distances; keep probed values (they're the d² constant)
+    if l2:
+        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+    else:
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+    coarse_vals, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
+
+    luts = _query_luts(queries, rotation, codebooks, metric, jnp.float32)
+    luts = luts.reshape(q, -1)  # (q, s*nc) flat per-query tables
+
+    n_codes = codebooks.shape[1]
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, None, :]
+
+    def scan_tile(args):
+        q_lut, probe_blk, cvals_blk = args  # (qt, s*nc), (qt, p), (qt, p)
+        codes = list_codes[probe_blk].astype(jnp.int32)  # (qt, p, m, s)
+        ids = list_ids[probe_blk]  # (qt, p, m)
+        # LUT lookup: out[q,p,m] = Σ_s q_lut[q, s*nc + codes[q,p,m,s]]
+        # (per-query 1-d table take under vmap — no broadcast materialization)
+        flat_idx = codes + s_off[None]
+        picked = jax.vmap(lambda lut, idx: jnp.take(lut, idx, axis=0))(q_lut, flat_idx)
+        d = jnp.sum(picked, axis=3) + b_sum[probe_blk] + cvals_blk[:, :, None]
+        if l2:
+            d = jnp.maximum(d, 0.0)
+            if metric == "euclidean":
+                d = jnp.sqrt(d)
+        flat_ids = ids.reshape(ids.shape[0], -1)
+        d = d.reshape(flat_ids.shape)
+        valid = flat_ids >= 0
+        if filter is not None:
+            valid = valid & filter.test(flat_ids)
+        d = jnp.where(valid, d, jnp.inf)
+        vals, sel = select_k(d, k, select_min=True, algo=select_algo)
+        out_ids = jnp.where(jnp.isinf(vals), -1, jnp.take_along_axis(flat_ids, sel, axis=1))
+        return vals, out_ids
+
+    if q_tile >= q:
+        vals, ids = scan_tile((luts, probes, coarse_vals))
+    else:
+        n_tiles = -(-q // q_tile)
+        pad = n_tiles * q_tile - q
+        lp = jnp.pad(luts, ((0, pad), (0, 0)))
+        pp = jnp.pad(probes, ((0, pad), (0, 0)))
+        cp = jnp.pad(coarse_vals, ((0, pad), (0, 0)))
+        vals, ids = lax.map(
+            scan_tile,
+            (
+                lp.reshape(n_tiles, q_tile, luts.shape[1]),
+                pp.reshape(n_tiles, q_tile, n_probes),
+                cp.reshape(n_tiles, q_tile, n_probes),
+            ),
+        )
+        vals = vals.reshape(-1, k)[:q]
+        ids = ids.reshape(-1, k)[:q]
+    if not l2:
+        vals = -vals  # back to raw inner product (bigger = closer)
+    return vals, ids
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "n_probes", "metric", "q_tile", "qpl_cap", "select_algo",
+        "compute_dtype", "interpret",
+    ),
+)
+def _search_impl_pallas(
+    queries, centers, rotation, codebooks, list_codes, list_ids, b_sum, filter,
+    k, n_probes, metric, q_tile, qpl_cap, select_algo, compute_dtype, interpret,
+):
+    """Pallas-backend search: list-centric scan kernel (ops/pq_scan.py)."""
+    q, dim = queries.shape
+    n_lists, max_size, pq_dim = list_codes.shape
+    n_codes = codebooks.shape[1]
+    l2 = metric in ("sqeuclidean", "euclidean")
+
+    if l2:
+        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+    else:
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+    coarse_vals, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
+
+    luts = _query_luts(queries, rotation, codebooks, metric, jnp.bfloat16)
+    luts = luts.reshape(q, -1)  # (q, f)
+    codes_t = jnp.transpose(list_codes, (0, 2, 1))  # (L, s, m), list dim minor
+
+    def scan_tile(args):
+        luts_t, probe_blk, cvals_blk = args  # (qt, f), (qt, p), (qt, p)
+        qt = probe_blk.shape[0]
+        qids, slot = group_probed_pairs(probe_blk, n_lists, qpl_cap)
+        luts_g = jnp.where(
+            (qids >= 0)[:, :, None], luts_t[jnp.maximum(qids, 0)], jnp.bfloat16(0)
+        )
+        # kernel output already includes b_sum and +inf at padding entries
+        grouped = pq_scan(luts_g, codes_t, b_sum, n_codes, interpret)  # (L, qpl, m)
+        scores = grouped[probe_blk, jnp.maximum(slot, 0)]  # (qt, p, m)
+        # dropped pairs (slot -1) and the coarse constant in one fused pass
+        d = scores + jnp.where(slot >= 0, cvals_blk, jnp.inf)[:, :, None]
+        d = d.reshape(qt, -1)
+        if filter is not None:
+            ids_full = list_ids[probe_blk].reshape(qt, -1)
+            d = jnp.where(filter.test(ids_full), d, jnp.inf)
+        vals, sel = select_k(d, k, select_min=True, algo=select_algo)
+        # map only the k winners: flat pos -> (probe slot, in-list pos) -> id
+        win_list = jnp.take_along_axis(probe_blk, sel // max_size, axis=1)
+        out_ids = list_ids[win_list, sel % max_size]
+        out_ids = jnp.where(jnp.isinf(vals), -1, out_ids)
+        if l2:
+            vals = jnp.maximum(vals, 0.0)
+            if metric == "euclidean":
+                vals = jnp.sqrt(vals)
+        return vals, out_ids
+
+    if q_tile >= q:
+        vals, ids = scan_tile((luts, probes, coarse_vals))
+    else:
+        n_tiles = -(-q // q_tile)
+        pad = n_tiles * q_tile - q
+        lp = jnp.pad(luts, ((0, pad), (0, 0)))
+        pp = jnp.pad(probes, ((0, pad), (0, 0)))
+        cp = jnp.pad(coarse_vals, ((0, pad), (0, 0)))
+        vals, ids = lax.map(
+            scan_tile,
+            (
+                lp.reshape(n_tiles, q_tile, luts.shape[1]),
+                pp.reshape(n_tiles, q_tile, n_probes),
+                cp.reshape(n_tiles, q_tile, n_probes),
+            ),
+        )
+        vals = vals.reshape(-1, k)[:q]
+        ids = ids.reshape(-1, k)[:q]
+    if not l2:
+        vals = -vals
+    return vals, ids
+
+
+def search(
+    index: IvfPqIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    backend: str = "auto",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN over the PQ-compressed lists
+    (detail/ivf_pq_search.cuh:731). Returns (distances, indices); distances
+    are PQ approximations — pipe through :mod:`raft_tpu.neighbors.refine`
+    for exact re-ranking (the reference does the same, refine-inl.cuh:70).
+    """
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, index.n_lists))
+    if not 0 < k <= n_probes * index.max_list_size:
+        raise ValueError(f"k={k} out of range")
+    if index.metric == "cosine":
+        queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+
+    if backend == "auto":
+        # the take_along_axis gather path has crashed the TPU runtime on
+        # large shapes — on TPU always use the list-centric kernel (wide
+        # pq_bits=8 LUTs just get smaller query tiles via the budget below)
+        backend = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if backend == "pallas":
+        q, p = queries.shape[0], n_probes
+        # per-list query cap: 2x the mean load, 16-aligned (bf16 sublanes)
+        qpl_cap = -(-max(16, (2 * q * p) // index.n_lists) // 16) * 16
+        # tile so the (L, qpl, m) grouped scores block fits the budget
+        per_tile = index.n_lists * qpl_cap * index.max_list_size * 4
+        q_tile = queries.shape[0]
+        while per_tile > res.workspace_bytes and q_tile > 64:
+            q_tile //= 2
+            qpl_cap = -(-max(16, (2 * q_tile * p) // index.n_lists) // 16) * 16
+            per_tile = index.n_lists * qpl_cap * index.max_list_size * 4
+        vals, ids = _search_impl_pallas(
+            queries, index.centers, index.rotation, index.codebooks,
+            index.list_codes, index.list_ids, index.b_sum, filter,
+            int(k), n_probes, index.metric, int(q_tile), int(qpl_cap),
+            select_algo, res.compute_dtype, jax.default_backend() != "tpu",
+        )
+    elif backend == "gather":
+        # tile budget: the (qt, p, m, s) code gather dominates
+        per_query = max(1, n_probes * index.max_list_size * (index.pq_dim * 5 + 8))
+        q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
+        vals, ids = _search_impl_jnp(
+            queries, index.centers, index.rotation, index.codebooks,
+            index.list_codes, index.list_ids, index.b_sum, filter,
+            int(k), n_probes, index.metric, q_tile, select_algo,
+            res.compute_dtype,
+        )
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    if index.metric == "cosine":
+        vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
+    return vals, ids

@@ -1,0 +1,151 @@
+"""Pallas TPU kernel for the IVF-PQ list scan — the flagship kernel.
+
+Reference analog: the `compute_similarity` kernel family
+(neighbors/detail/ivf_pq_compute_similarity-inl.cuh) consumed by
+`ivfpq_search_worker` (detail/ivf_pq_search.cuh:420): one CTA per (query,
+probe) builds a LUT in shared memory and scans the list's packed codes.
+
+TPU redesign. A per-(query, probe) unit is a matvec — it starves the MXU's
+N dimension. Instead the scan is **list-centric**: queries probing the same
+list are batched as the N dimension of one matmul per list:
+
+    scores[l][j, i] = Σ_s LUT[q_i, s, codes[l, j, s]]
+                    = OH_l @ LUT_{q_i}          with OH_l the one-hot expansion
+                                                 of list l's codes
+
+  * grid over lists (× subspace chunks when the LUT is wide);
+  * the one-hot block OH_l (s_chunk·n_codes, m) is built **in VMEM** from the
+    uint8 codes (broadcast + iota compare) — it never touches HBM, which is
+    the entire trick: HBM reads stay at one byte per (entry, subspace);
+  * one MXU matmul (qpl, s_chunk·n_codes) @ (s_chunk·n_codes, m) per chunk,
+    fp32 accumulation across chunks into the output block;
+  * the per-entry list-side constant b_sum (see neighbors/ivf_pq.py's LUT
+    decomposition) is added on the first chunk.
+
+The query→list grouping (who probes what, padded to a static per-list query
+cap) is plain jnp around the kernel: `group_probed_pairs`. Pairs beyond the
+cap are dropped (slot -1 → +inf outside); the cap defaults to 2× the mean
+load so drops only occur under heavily skewed probe distributions.
+
+Intended for narrow LUTs (pq_bits ≤ 6, i.e. n_codes ≤ 64, where a query's
+LUT row is ≤ 8 KB and pre-gathering per-list LUT blocks is cheap). For
+pq_bits=8 the jnp gather path in neighbors/ivf_pq.py remains the backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "qpl_cap"))
+def group_probed_pairs(probes, n_lists: int, qpl_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Invert the (query, probe)→list relation.
+
+    probes: (q, p) int32 list ids. Returns:
+      qids (n_lists, qpl_cap) int32 — query ids probing each list, -1 pad;
+      slot (q, p) int32 — each pair's position in its list's row, -1 dropped.
+    """
+    q, p = probes.shape
+    flat = probes.reshape(-1)
+    order = jnp.argsort(flat, stable=True)
+    sorted_lists = flat[order]
+    sizes = jnp.bincount(flat, length=n_lists)
+    offsets = jnp.cumsum(sizes) - sizes
+    rank = (jnp.arange(q * p, dtype=jnp.int32) - offsets[sorted_lists]).astype(jnp.int32)
+    qid_of_pair = (order // p).astype(jnp.int32)
+    # rank >= qpl_cap scatters out of bounds and is dropped
+    qids = jnp.full((n_lists, qpl_cap), -1, jnp.int32)
+    qids = qids.at[sorted_lists, rank].set(qid_of_pair, mode="drop")
+    slot = jnp.full((q * p,), -1, jnp.int32)
+    slot = slot.at[order].set(jnp.where(rank < qpl_cap, rank, -1))
+    return qids, slot.reshape(q, p)
+
+
+def _pq_scan_kernel(luts_ref, codes_ref, bsum_ref, out_ref, *, nc, s_chunk):
+    sc = pl.program_id(1)
+    ck = s_chunk * nc
+    m = codes_ref.shape[2]
+    codes = codes_ref[0].astype(jnp.int32)  # (s_chunk, m)
+    # one-hot transpose OH_T[(s', c), j] = (codes[s', j] == c), built in VMEM
+    rep = jnp.broadcast_to(codes[:, None, :], (s_chunk, nc, m)).reshape(ck, m)
+    cidx = lax.broadcasted_iota(jnp.int32, (ck, m), 0) % nc
+    oh = (rep == cidx).astype(jnp.bfloat16)
+    lut = luts_ref[0]  # (qpl, ck) bf16
+    part = lax.dot_general(
+        lut, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (qpl, m)
+
+    @pl.when(sc == 0)
+    def _():
+        # b_sum carries +inf at padding entries, masking them for free
+        out_ref[0] = part + bsum_ref[0]
+
+    @pl.when(sc != 0)
+    def _():
+        out_ref[0] += part
+
+
+@functools.partial(jax.jit, static_argnames=("nc", "interpret"))
+def pq_scan(luts_grouped, codes_t, b_sum, nc: int, interpret: bool = False) -> jax.Array:
+    """Scan every list against its grouped queries.
+
+    luts_grouped: (L, qpl, s*nc) bf16 — per-list LUT rows (pre-gathered by
+      caller via qids from :func:`group_probed_pairs`; pad rows are zeros).
+    codes_t: (L, s, m) uint8 — codes transposed so the list dim is minor;
+      m must be a multiple of 128 (Mosaic minor-dim block constraint).
+    b_sum: (L, m) fp32 — per-entry list-side constant, +inf at padding
+      entries (sentinel flows through to the caller's top-k for free).
+    Returns (L, qpl, m) fp32 scores (still missing the per-(q,probe) coarse
+    constant, added by the caller).
+    """
+    L, qpl, f = luts_grouped.shape
+    _, s, m = codes_t.shape
+    assert f == s * nc, (f, s, nc)
+    assert m % 128 == 0, f"max_list_size {m} must be 128-aligned for the kernel"
+    # chunk subspaces so the in-VMEM one-hot block stays ~≤ 2048 wide
+    s_chunk = max(1, min(s, 2048 // nc))
+    while s % s_chunk:
+        s_chunk -= 1
+    n_sc = s // s_chunk
+    ck = s_chunk * nc
+
+    grid = (L, n_sc)
+    return pl.pallas_call(
+        functools.partial(_pq_scan_kernel, nc=nc, s_chunk=s_chunk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, qpl, ck), lambda l, sc: (l, 0, sc), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_chunk, m), lambda l, sc: (l, sc, 0), memory_space=pltpu.VMEM),
+            # (L, 1, m) so the block's last-two dims equal the array's
+            pl.BlockSpec((1, 1, m), lambda l, sc: (l, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, qpl, m), lambda l, sc: (l, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((L, qpl, m), jnp.float32),
+        interpret=interpret,
+    )(luts_grouped, codes_t, b_sum.reshape(L, 1, m))
+
+
+def pq_scan_reference(luts_grouped, codes_t, b_sum, nc: int) -> jax.Array:
+    """Pure-jnp oracle with the exact pq_scan contract (for kernel tests)."""
+    L, qpl, f = luts_grouped.shape
+    s = codes_t.shape[1]
+    codes = codes_t.astype(jnp.int32)  # (L, s, m)
+    flat_idx = codes + (jnp.arange(s, dtype=jnp.int32) * nc)[None, :, None]
+
+    def one_list(args):
+        luts_l, idx_l, b_l = args  # (qpl, f), (s, m), (m,)
+        picked = jnp.take(luts_l.astype(jnp.float32), idx_l, axis=1)  # (qpl, s, m)
+        return jnp.sum(picked, axis=1) + b_l[None, :]
+
+    return lax.map(one_list, (luts_grouped, flat_idx, b_sum))

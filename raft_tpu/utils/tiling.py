@@ -34,3 +34,27 @@ def pad_and_tile(x: jax.Array, tile: int, fill=0) -> Tuple[jax.Array, int]:
     xp = pad_rows(x, tile, fill)
     n_tiles = xp.shape[0] // tile
     return xp.reshape((n_tiles, tile) + x.shape[1:]), n_tiles
+
+
+def map_row_tiles(fn, args: Tuple, tile: int, fills: Tuple = None):
+    """Run ``fn`` over row tiles of several same-leading-dim arrays and
+    restitch the row dimension.
+
+    ``fn`` takes a tuple of (tile, ...) blocks and returns an array or tuple
+    of arrays with leading dim ``tile``. If the row count fits one tile, fn is
+    called directly (no pad/reshape). ``fills`` optionally gives the padding
+    value per arg (default 0 — searches that must ignore padded rows should
+    pass sentinel fills, e.g. -1 for id arrays).
+    """
+    n = args[0].shape[0]
+    if tile >= n:
+        return fn(args)
+    fills = fills or (0,) * len(args)
+    n_tiles = ceil_div(n, tile)
+    tiled = tuple(
+        pad_and_tile(a, tile, fill)[0] for a, fill in zip(args, fills)
+    )
+    out = jax.lax.map(fn, tiled)
+    def unstitch(o):
+        return o.reshape((n_tiles * tile,) + o.shape[2:])[:n]
+    return jax.tree.map(unstitch, out)

@@ -1,0 +1,158 @@
+"""IVF-PQ + refine tests — recall-threshold oracle vs exact brute force
+(reference methodology cpp/test/neighbors/ann_ivf_pq.cuh + ann_utils.cuh;
+refine flow mirrors test_refine / pylibraft neighbors.refine)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_pq, refine
+
+
+def _recall(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    k = want.shape[1]
+    return np.mean([len(set(got[r]) & set(want[r])) / k for r in range(want.shape[0])])
+
+
+@pytest.fixture(scope="module")
+def data():
+    # clustered data (what PQ residuals are designed for), SIFT-ish dims
+    rng = np.random.default_rng(3)
+    centers = rng.normal(scale=4.0, size=(50, 64)).astype(np.float32)
+    assign = rng.integers(0, 50, 20_000)
+    ds = centers[assign] + rng.normal(scale=1.0, size=(20_000, 64)).astype(np.float32)
+    qs = centers[rng.integers(0, 50, 200)] + rng.normal(scale=1.0, size=(200, 64)).astype(
+        np.float32
+    )
+    return ds.astype(np.float32), qs.astype(np.float32)
+
+
+class TestIvfPq:
+    def test_recall_l2(self, data):
+        ds, qs = data
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=64, pq_dim=32, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_pq.search(idx, qs, 10, n_probes=32)
+        assert _recall(got, exact) >= 0.8  # PQ is approximate even at full probes
+
+    def test_refine_recovers_recall(self, data):
+        ds, qs = data
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=64, pq_dim=32, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, cand = ivf_pq.search(idx, qs, 40, n_probes=32)  # over-fetch 4x
+        _, got = refine.refine(ds, qs, cand, 10)
+        r_plain = _recall(ivf_pq.search(idx, qs, 10, n_probes=32)[1], exact)
+        r_refined = _recall(got, exact)
+        assert r_refined >= r_plain
+        assert r_refined >= 0.95
+
+    def test_pq_distance_approximation(self, data):
+        """PQ distances approximate true distances (tier-2 tolerance oracle)."""
+        ds, qs = data
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=32, pq_dim=32, seed=0))
+        vals, ids = ivf_pq.search(idx, qs, 5, n_probes=32)
+        vals, ids = np.asarray(vals), np.asarray(ids)
+        true = ((qs[:, None, :] - ds[ids.clip(0)]) ** 2).sum(-1)
+        ok = ids >= 0
+        rel_err = np.abs(vals - true)[ok] / np.maximum(true[ok], 1e-6)
+        assert np.median(rel_err) < 0.25, f"median rel err {np.median(rel_err):.3f}"
+
+    def test_more_bits_better_approximation(self, data):
+        ds, qs = data
+        errs = []
+        for bits in (4, 8):
+            idx = ivf_pq.build(ds[:5000], ivf_pq.IvfPqParams(n_lists=16, pq_dim=32, pq_bits=bits))
+            vals, ids = ivf_pq.search(idx, qs, 5, n_probes=16)
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            true = ((qs[:, None, :] - ds[:5000][ids.clip(0)]) ** 2).sum(-1)
+            errs.append(np.median(np.abs(vals - true) / np.maximum(true, 1e-6)))
+        assert errs[1] < errs[0], f"8-bit {errs[1]:.3f} should beat 4-bit {errs[0]:.3f}"
+
+    def test_inner_product(self, data):
+        ds, qs = data
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=64, pq_dim=32, metric="inner_product"))
+        _, exact = brute_force.knn(qs, ds, 10, metric="inner_product")
+        _, cand = ivf_pq.search(idx, qs, 40, n_probes=32)
+        _, got = refine.refine(ds, qs, cand, 10, metric="inner_product")
+        assert _recall(got, exact) >= 0.85
+
+    def test_cosine(self, data):
+        ds, qs = data
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=64, pq_dim=32, metric="cosine"))
+        _, exact = brute_force.knn(qs, ds, 10, metric="cosine")
+        _, cand = ivf_pq.search(idx, qs, 40, n_probes=32)
+        _, got = refine.refine(ds, qs, cand, 10, metric="cosine")
+        assert _recall(got, exact) >= 0.85
+
+    def test_extend(self, data):
+        ds, qs = data
+        half = ds.shape[0] // 2
+        idx = ivf_pq.build(ds[:half], ivf_pq.IvfPqParams(n_lists=64, pq_dim=32, seed=0))
+        idx = ivf_pq.extend(idx, ds[half:])
+        assert idx.size == ds.shape[0]
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, cand = ivf_pq.search(idx, qs, 40, n_probes=32)
+        _, got = refine.refine(ds, qs, cand, 10)
+        assert _recall(got, exact) >= 0.9
+
+    def test_serialize_roundtrip(self, tmp_path, data):
+        ds, qs = data
+        idx = ivf_pq.build(ds[:4000], ivf_pq.IvfPqParams(n_lists=32, pq_dim=16, seed=0))
+        p = tmp_path / "pq.raft"
+        idx.save(p)
+        idx2 = ivf_pq.IvfPqIndex.load(p)
+        v1, i1 = ivf_pq.search(idx, qs, 5, n_probes=8)
+        v2, i2 = ivf_pq.search(idx2, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+    def test_filter(self, data):
+        ds, qs = data
+        n = 4000
+        idx = ivf_pq.build(ds[:n], ivf_pq.IvfPqParams(n_lists=32, pq_dim=16, seed=0))
+        keep = Bitset.from_mask(np.arange(n) < n // 2)
+        _, got = ivf_pq.search(idx, qs, 10, n_probes=32, filter=keep)
+        assert np.asarray(got).max() < n // 2
+
+    def test_validation(self, data):
+        ds, qs = data
+        with pytest.raises(ValueError):
+            ivf_pq.IvfPqParams(pq_bits=16)
+        with pytest.raises(ValueError):
+            ivf_pq.IvfPqParams(metric="l1")
+        with pytest.raises(ValueError):
+            ivf_pq.build(ds[:10], ivf_pq.IvfPqParams(n_lists=100))
+        idx = ivf_pq.build(ds[:2000], ivf_pq.IvfPqParams(n_lists=16, pq_dim=16))
+        with pytest.raises(ValueError):
+            ivf_pq.search(idx, qs[:, :10], 5)
+
+
+class TestRefine:
+    def test_refine_matches_brute_force_on_full_candidates(self, data):
+        ds, qs = data
+        n = 500
+        cands = np.tile(np.arange(n, dtype=np.int32), (qs.shape[0], 1))
+        v, i = refine.refine(ds[:n], qs, cands, 5)
+        vex, iex = brute_force.knn(qs, ds[:n], 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(iex))
+        np.testing.assert_allclose(np.asarray(v), np.asarray(vex), rtol=1e-4, atol=1e-3)
+
+    def test_refine_ignores_negative_ids(self, data):
+        ds, qs = data
+        cands = np.full((qs.shape[0], 8), -1, np.int32)
+        cands[:, 0] = 3
+        v, i = refine.refine(ds, qs, cands, 2)
+        i = np.asarray(i)
+        assert np.all(i[:, 0] == 3)
+        assert np.all(i[:, 1] == -1)
+        assert np.all(np.isinf(np.asarray(v)[:, 1]))
+
+    def test_refine_validation(self, data):
+        ds, qs = data
+        with pytest.raises(ValueError):
+            refine.refine(ds, qs, np.zeros((qs.shape[0], 4), np.int32), 10)
+        with pytest.raises(ValueError):
+            refine.refine(ds, qs[:, :5], np.zeros((qs.shape[0], 4), np.int32), 2)
+        with pytest.raises(ValueError):
+            refine.refine(ds, qs, np.zeros((3, 4), np.int32), 2)

@@ -1,0 +1,82 @@
+"""PQ list-scan kernel tests — tier-1 exact oracle: the Pallas kernel
+(interpret mode on CPU) must match the jnp reference bit-for-bit modulo bf16
+LUT rounding, and the pallas search backend must agree with the gather
+backend end-to-end."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.ops import pq_scan as ps
+
+
+class TestGrouping:
+    def test_group_probed_pairs_roundtrip(self):
+        rng = np.random.default_rng(0)
+        q, p, L, cap = 32, 4, 16, 32
+        probes = rng.integers(0, L, (q, p)).astype(np.int32)
+        qids, slot = ps.group_probed_pairs(jnp.asarray(probes), L, cap)
+        qids, slot = np.asarray(qids), np.asarray(slot)
+        # every non-dropped pair is findable at its (list, slot)
+        for qi in range(q):
+            for pi in range(p):
+                s = slot[qi, pi]
+                assert s >= 0  # cap is generous here, nothing dropped
+                assert qids[probes[qi, pi], s] == qi
+        # pad slots are -1
+        sizes = np.bincount(probes.reshape(-1), minlength=L)
+        for l in range(L):
+            assert np.all(qids[l, sizes[l]:] == -1)
+
+    def test_group_drops_beyond_cap(self):
+        probes = jnp.zeros((8, 2), jnp.int32)  # 16 pairs all probing list 0
+        qids, slot = ps.group_probed_pairs(probes, 4, 8)
+        assert int(jnp.sum(qids[0] >= 0)) == 8
+        assert int(jnp.sum(slot >= 0)) == 8
+
+
+class TestPqScanKernel:
+    @pytest.mark.parametrize("nc,s,m,qpl", [(16, 8, 128, 16), (16, 64, 256, 32), (64, 16, 128, 16)])
+    def test_kernel_matches_reference(self, nc, s, m, qpl):
+        rng = np.random.default_rng(1)
+        L = 8
+        luts = rng.normal(size=(L, qpl, s * nc)).astype(np.float32)
+        luts_bf = jnp.asarray(luts, jnp.bfloat16)
+        codes = rng.integers(0, nc, (L, s, m)).astype(np.uint8)
+        b_sum = rng.normal(size=(L, m)).astype(np.float32)
+        b_sum[:, -7:] = np.inf  # padding sentinel flows through
+        got = ps.pq_scan(luts_bf, jnp.asarray(codes), jnp.asarray(b_sum), nc, interpret=True)
+        want = ps.pq_scan_reference(luts_bf, jnp.asarray(codes), jnp.asarray(b_sum), nc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-4)
+
+
+class TestPallasSearchBackend:
+    def test_backends_agree(self):
+        rng = np.random.default_rng(5)
+        centers = rng.normal(scale=4.0, size=(20, 32)).astype(np.float32)
+        ds = (centers[rng.integers(0, 20, 4000)] + rng.normal(size=(4000, 32))).astype(np.float32)
+        qs = (centers[rng.integers(0, 20, 40)] + rng.normal(size=(40, 32))).astype(np.float32)
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=16, pq_dim=16, pq_bits=4, seed=0))
+        vg, ig = ivf_pq.search(idx, qs, 8, n_probes=8, backend="gather")
+        vp, ip_ = ivf_pq.search(idx, qs, 8, n_probes=8, backend="pallas")
+        # identical candidate sets; values equal to bf16-LUT rounding
+        overlap = np.mean(
+            [len(set(np.asarray(ig)[r]) & set(np.asarray(ip_)[r])) / 8 for r in range(40)]
+        )
+        assert overlap >= 0.95, f"backend agreement {overlap}"
+        np.testing.assert_allclose(np.asarray(vp), np.asarray(vg), rtol=0.05, atol=0.5)
+
+    def test_pallas_backend_filter_and_sentinels(self):
+        from raft_tpu.core.bitset import Bitset
+
+        rng = np.random.default_rng(6)
+        ds = rng.normal(size=(2000, 16)).astype(np.float32)
+        qs = rng.normal(size=(8, 16)).astype(np.float32)
+        idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8, pq_bits=4, seed=0))
+        none = Bitset.create(2000, default=False)
+        v, i = ivf_pq.search(idx, qs, 3, n_probes=8, backend="pallas", filter=none)
+        assert np.all(np.asarray(i) == -1)
+        assert np.all(np.isinf(np.asarray(v)))
