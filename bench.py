@@ -1,6 +1,6 @@
 """Headline benchmark — prints ONE JSON line for the driver.
 
-Round-1 metric: brute-force kNN throughput (QPS) on a synthetic SIFT-shaped
+Round-2 metric: brute-force kNN throughput (QPS) on a synthetic SIFT-shaped
 dataset (100K x 128 fp32, k=10, 10K queries), recall-gated at >=0.95 against
 the exact top-k path (the reference's QPS@recall methodology,
 docs/source/raft_ann_benchmarks.md:420-438). Uses the fused
@@ -12,23 +12,69 @@ vs_baseline anchors to the north-star throughput target in BASELINE.md
 Timing note: on the tunneled TPU platform, dispatch overhead is ~70ms/call and
 block_until_ready does not synchronize; we amortize by dispatching R calls
 back-to-back and forcing completion with a scalar host fetch.
+
+Failure hardening (round-2, VERDICT.md Weak#2): the TPU tunnel on this machine
+can wedge backend init indefinitely (observed: jax.devices() hanging at 0%
+CPU). The parent process therefore runs the measurement in a SUBPROCESS with
+a hard timeout; if the TPU attempt produces no JSON line, it retries on CPU
+(config-route platform selection — the env var alone hangs the axon plugin)
+so the driver always receives one parseable line, tagged with the platform
+that actually ran. A belt-and-braces watchdog thread hard-exits with a JSON
+error line if even orchestration wedges.
 """
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
+import traceback
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from raft_tpu.neighbors import brute_force
-
-N, DIM, Q, K = 100_000, 128, 10_000, 10
+WATCHDOG_SECONDS = float(os.environ.get("RAFT_TPU_BENCH_TIMEOUT", "1800"))
+TPU_ATTEMPT_SECONDS = float(os.environ.get("RAFT_TPU_BENCH_TPU_TIMEOUT", "900"))
+CPU_ATTEMPT_SECONDS = float(os.environ.get("RAFT_TPU_BENCH_CPU_TIMEOUT", "600"))
 NORTH_STAR_QPS = 1e6
-REPS = 10
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def main():
+def _emit(payload: dict) -> None:
+    sys.stdout.write(json.dumps(payload) + "\n")
+    sys.stdout.flush()
+
+
+def _fail(reason: str, code: int = 1) -> None:
+    _emit(
+        {
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "QPS",
+            "vs_baseline": 0.0,
+            "error": reason[-2000:],
+        }
+    )
+    # os._exit: safe from any thread, skips atexit/backends that may be wedged.
+    os._exit(code)
+
+
+# ---------------------------------------------------------------------------
+# Child mode: the actual measurement
+# ---------------------------------------------------------------------------
+
+def run_brute_force_bench():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.neighbors import brute_force
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        # fallback sizing: same pipeline, small enough to finish on host cores
+        N, DIM, Q, K, REPS = 50_000, 128, 2_000, 10, 3
+    else:
+        N, DIM, Q, K, REPS = 100_000, 128, 10_000, 10, 10
+
     key = jax.random.key(0)
     kd, kq = jax.random.split(key)
     dataset = jax.random.normal(kd, (N, DIM), jnp.float32)
@@ -58,16 +104,88 @@ def main():
     )
     assert recall >= 0.95, f"recall {recall:.3f} < 0.95"
 
-    print(
-        json.dumps(
-            {
-                "metric": "brute_force_knn_qps_100k_128_k10_recall>=0.95",
-                "value": round(qps, 1),
-                "unit": "QPS",
-                "vs_baseline": round(qps / NORTH_STAR_QPS, 4),
-            }
+    return {
+        "metric": f"brute_force_knn_qps_{N // 1000}k_{DIM}_k{K}_recall>=0.95",
+        "value": round(qps, 1),
+        "unit": "QPS",
+        "vs_baseline": round(qps / NORTH_STAR_QPS, 4),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def _child_main(platform: str) -> None:
+    try:
+        if platform == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        result = run_brute_force_bench()
+    except BaseException:
+        sys.stderr.write(traceback.format_exc())
+        sys.exit(1)
+    _emit(result)
+
+
+# ---------------------------------------------------------------------------
+# Parent mode: orchestration with timeouts + CPU fallback
+# ---------------------------------------------------------------------------
+
+def _attempt(platform: str, timeout: float):
+    """Run the measurement subprocess; returns (json_dict | None, err_text)."""
+    if platform == "cpu":
+        from raft_tpu.utils.subproc import clean_cpu_env
+
+        env = clean_cpu_env()  # config route selects cpu inside the child
+    else:
+        env = dict(os.environ)
+    env["RAFT_TPU_BENCH_CHILD"] = platform
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
         )
+    except subprocess.TimeoutExpired as e:
+        return None, f"{platform} attempt timed out after {timeout}s: {e.stderr or ''}"
+    for line in (proc.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    return None, (
+        f"{platform} attempt rc={proc.returncode}\n"
+        f"stdout: {(proc.stdout or '')[-1000:]}\nstderr: {(proc.stderr or '')[-2000:]}"
     )
+
+
+def main():
+    child = os.environ.get("RAFT_TPU_BENCH_CHILD")
+    if child:
+        _child_main(child)
+        return
+
+    t = threading.Timer(
+        WATCHDOG_SECONDS, _fail, args=(f"watchdog: exceeded {WATCHDOG_SECONDS}s", 3)
+    )
+    t.daemon = True
+    t.start()
+
+    result, err_tpu = _attempt("default", TPU_ATTEMPT_SECONDS)
+    if result is not None:
+        _emit(result)
+        return
+    result, err_cpu = _attempt("cpu", CPU_ATTEMPT_SECONDS)
+    if result is not None:
+        result["note"] = "tpu_attempt_failed; cpu fallback"
+        result["tpu_error"] = err_tpu[-500:]
+        _emit(result)
+        return
+    _fail(f"tpu: {err_tpu}\ncpu: {err_cpu}")
 
 
 if __name__ == "__main__":

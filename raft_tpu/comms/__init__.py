@@ -1,0 +1,46 @@
+"""Distributed communication layer — the TPU-native ``comms_t``.
+
+Reference: cpp/include/raft/core/comms.hpp:125-242 (``comms_iface``/``comms_t``),
+comms/detail/std_comms.hpp:57-109 (NCCL/UCX impl), comms/comms_test.hpp:34-144
+(per-collective verification harness), raft-dask bootstrap
+python/raft-dask/raft_dask/common/comms.py:40.
+
+TPU mapping (SURVEY.md §2.8): the communicator is a ``jax.sharding.Mesh`` axis;
+collectives are XLA collectives issued inside ``shard_map`` and compiled onto
+ICI/DCN — allreduce→psum, allgather→all_gather, reducescatter→psum_scatter,
+sendrecv→ppermute, comm_split→sub-mesh axes. Bootstrap is
+``jax.distributed.initialize`` instead of an NCCL-uid rendezvous.
+"""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    allgather,
+    allreduce,
+    barrier,
+    bcast,
+    gather,
+    get_rank,
+    get_size,
+    reduce,
+    reducescatter,
+    sendrecv,
+)
+from raft_tpu.comms.bootstrap import init_distributed, local_mesh
+from raft_tpu.comms.self_test import comms_self_test
+
+__all__ = [
+    "Comms",
+    "allreduce",
+    "allgather",
+    "barrier",
+    "bcast",
+    "gather",
+    "get_rank",
+    "get_size",
+    "reduce",
+    "reducescatter",
+    "sendrecv",
+    "comms_self_test",
+    "init_distributed",
+    "local_mesh",
+]

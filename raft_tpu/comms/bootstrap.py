@@ -1,0 +1,81 @@
+"""Cluster bootstrap — the raft-dask ``Comms.init()`` analog.
+
+Reference: python/raft-dask/raft_dask/common/comms.py:40-140 generates an NCCL
+uniqueId at the root, broadcasts it over Dask, and every worker runs
+``ncclCommInitRank`` + installs a ``comms_t`` into its ``device_resources``.
+
+On TPU the runtime owns rendezvous: ``jax.distributed.initialize`` performs
+the coordinator handshake (the uid-rendezvous analog), after which
+``jax.devices()`` spans every chip in the slice and a global ``Mesh`` is the
+installed communicator. Single-process multi-device (the LocalCUDACluster
+test analog, SURVEY.md §4.3) needs no bootstrap at all — just a mesh over
+``jax.local_devices()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto: bool = False,
+) -> bool:
+    """Initialize multi-host JAX (ncclCommInitRank rendezvous analog).
+
+    Rendezvous sources, in order: explicit args, the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``),
+    or — when ``auto=True`` — ``jax.distributed.initialize()`` with no args,
+    which self-detects cloud-TPU pod metadata. ``auto`` is opt-in because on
+    a non-pod machine the no-arg call can block looking for a coordinator.
+    Returns False (no-op) when no source is available and ``auto`` is off.
+    Idempotent: a second call returns True without re-initializing.
+    """
+    if getattr(init_distributed, "_done", False):
+        return True
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else os.environ.get("JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID")
+    if addr is None and nproc is None:
+        if not auto:
+            return False
+        jax.distributed.initialize()
+        init_distributed._done = True
+        return True
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(nproc) if nproc is not None else None,
+        process_id=int(pid) if pid is not None else None,
+    )
+    init_distributed._done = True
+    return True
+
+
+def local_mesh(
+    n_devices: Optional[int] = None, axis_names: Tuple[str, ...] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """A mesh over this process's devices (LocalCUDACluster fixture analog).
+
+    ``shape`` reshapes the device list for multi-axis meshes; defaults to 1-D
+    over the first ``n_devices`` local devices.
+    """
+    devs = jax.local_devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    import numpy as np
+
+    grid = np.array(devs, dtype=object)
+    if shape is not None:
+        grid = grid.reshape(tuple(shape))
+    if grid.ndim != len(axis_names):
+        raise ValueError(f"mesh shape {grid.shape} vs axis_names {axis_names}")
+    return Mesh(grid, axis_names)

@@ -1,0 +1,230 @@
+"""``comms_t``-shaped collectives over ``shard_map``.
+
+Reference surface being mirrored (cpp/include/raft/core/comms.hpp:143-230):
+``get_size/get_rank/comm_split/barrier``, device collectives
+``allreduce/bcast/reduce/allgather/gather/reducescatter``, and p2p
+``device_send/device_recv/device_sendrecv``. The reference injects a
+``comms_t`` into ``resources`` (core/resource/comms.hpp:64); here the analog
+is a :class:`Comms` bound to a mesh axis, installable on
+``Resources.mesh``.
+
+Two layers:
+
+* **In-SPMD functions** (module level): usable inside any ``shard_map``-ed
+  function, addressing the communicator by axis name exactly like the
+  reference addresses ``comms_t`` methods — these are thin, typed wrappers
+  over ``lax`` collectives so MNMG algorithm code reads like the reference's.
+* **:class:`Comms`**: the host-side handle — knows the mesh + axis, launches
+  SPMD regions (``run``), and supports ``split`` into row/col
+  sub-communicators (comm_split analog, 2-D mesh).
+
+Semantics notes (documented deviations, by design):
+
+* ``reduce``/``gather`` deliver the true result on ``root`` and the same
+  value on all ranks (XLA collectives are symmetric; there is no cheaper
+  root-only variant on ICI). Callers that need root-only semantics mask on
+  ``get_rank() == root``.
+* There is no ``allgatherv`` — XLA requires static shapes. Variable-length
+  gathers are expressed as pad-to-max + validity mask by callers (the same
+  padded-dense convention used throughout this framework).
+* ``device_send``/``device_recv`` pairs collapse into :func:`sendrecv`
+  (``lax.ppermute``), which only supports static permutations — sufficient
+  for every algorithm in the reference (SURVEY.md §7 hard-parts note 5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_REDUCE_OPS = ("sum", "max", "min")
+
+
+# ---------------------------------------------------------------------------
+# In-SPMD collectives (call inside shard_map, addressed by axis name)
+# ---------------------------------------------------------------------------
+
+def get_size(axis: str = "data") -> int:
+    """Communicator size (reference comms_t::get_size, core/comms.hpp:254)."""
+    return lax.axis_size(axis)
+
+
+def get_rank(axis: str = "data") -> jax.Array:
+    """This shard's rank along ``axis`` (comms_t::get_rank)."""
+    return lax.axis_index(axis)
+
+
+def allreduce(x, op: str = "sum", axis: str = "data") -> jax.Array:
+    """All-reduce ``x`` with ``op`` in {sum,max,min} (comms_t::allreduce,
+    core/comms.hpp:143; NCCL ncclAllReduce → psum/pmax/pmin on ICI)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"allreduce op must be one of {_REDUCE_OPS}, got {op!r}")
+
+
+def reduce(x, root: int = 0, op: str = "sum", axis: str = "data") -> jax.Array:
+    """Reduce to ``root`` (comms_t::reduce). See module docstring: the reduced
+    value is computed on all ranks; only ``root``'s copy is meaningful by
+    contract."""
+    return allreduce(x, op=op, axis=axis)
+
+
+def bcast(x, root: int = 0, axis: str = "data") -> jax.Array:
+    """Broadcast ``root``'s shard value to all ranks (comms_t::bcast,
+    core/comms.hpp:151). Implemented as mask + psum (one ICI collective)."""
+    rank = lax.axis_index(axis)
+    masked = jnp.where(rank == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def allgather(x, axis: str = "data", tiled: bool = False, gather_axis: int = 0):
+    """Concatenate shards along ``gather_axis`` (comms_t::allgather,
+    core/comms.hpp:159). ``tiled=False`` stacks a new leading axis."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
+    """Gather to ``root`` (comms_t::gather, core/comms.hpp:173). The gathered
+    array materializes on all ranks; ``root``'s copy is the contract."""
+    return lax.all_gather(x, axis, axis=0, tiled=tiled)
+
+
+def reducescatter(x, op: str = "sum", axis: str = "data", scatter_axis: int = 0):
+    """Reduce-scatter (comms_t::reducescatter, core/comms.hpp:195 →
+    lax.psum_scatter). ``x``'s ``scatter_axis`` must divide by axis size."""
+    if op != "sum":
+        raise ValueError("reducescatter supports op='sum' (ncclSum analog) only")
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def sendrecv(x, perm: Sequence[Tuple[int, int]], axis: str = "data") -> jax.Array:
+    """Static-pattern point-to-point exchange (comms_t::device_sendrecv,
+    core/comms.hpp:216 → lax.ppermute). ``perm`` is (src, dst) pairs; ranks
+    that receive nothing get zeros."""
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift(x, offset: int = 1, axis: str = "data") -> jax.Array:
+    """Ring shift by ``offset`` (the ring-pass building block for
+    ring-allreduce-style algorithms and ring attention)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def barrier(axis: str = "data") -> jax.Array:
+    """Synchronization point (comms_t::barrier, core/comms.hpp:137): a psum
+    of ones — every rank must arrive before any proceeds past the collective.
+    Returns the communicator size (useful as a data dependency)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+# ---------------------------------------------------------------------------
+# Host-side communicator handle
+# ---------------------------------------------------------------------------
+
+class Comms:
+    """Host-side communicator: a mesh axis + SPMD launcher.
+
+    The analog of ``comms_t`` held by ``resources`` (core/resource/comms.hpp:64).
+    ``run`` plays the role of "issue collectives on the stream": it wraps a
+    function containing in-SPMD collectives with ``shard_map`` over this
+    communicator's mesh.
+    """
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        self.mesh = mesh
+        if axis is None:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    f"mesh has axes {mesh.axis_names}; pass axis= explicitly"
+                )
+            axis = mesh.axis_names[0]
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.axis = axis
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding over this mesh for the given PartitionSpec entries."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def shard_rows(self, x) -> jax.Array:
+        """Place ``x`` row-sharded over the communicator axis."""
+        return jax.device_put(x, self.sharding(self.axis, *([None] * (jnp.ndim(x) - 1))))
+
+    def replicate(self, x) -> jax.Array:
+        """Place ``x`` replicated over the mesh."""
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    def run(
+        self,
+        fn: Callable,
+        *args,
+        in_specs,
+        out_specs,
+        check_vma: bool = False,
+    ):
+        """Launch ``fn`` as an SPMD region over this communicator's mesh.
+
+        ``fn`` sees per-shard views and may call the module-level collectives
+        with ``axis=self.axis``.
+        """
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )(*args)
+
+    def split(self, rows: int, cols: int, names: Tuple[str, str] = ("row", "col")) -> Tuple["Comms", "Comms"]:
+        """comm_split analog (core/comms.hpp:131): reshape this 1-D
+        communicator into a (rows, cols) 2-D mesh and return the row- and
+        col-axis sub-communicators. Every device belongs to one row comm and
+        one col comm, like NCCL comm_split by color."""
+        if rows * cols != self.size:
+            raise ValueError(f"rows*cols = {rows * cols} != communicator size {self.size}")
+        devs = list(self.mesh.devices.reshape(-1))
+        import numpy as np
+
+        grid = np.array(devs, dtype=object).reshape(rows, cols)
+        mesh2 = Mesh(grid, names)
+        return Comms(mesh2, names[0]), Comms(mesh2, names[1])
+
+
+def shard_padded(x, comms: Comms, fill=0.0) -> Tuple[jax.Array, int]:
+    """Pad ``x`` rows to a multiple of the communicator size and place it
+    row-sharded over the mesh axis. Returns ``(sharded_x, n_padded)``. The
+    single padding convention shared by every MNMG algorithm (callers mask
+    pad rows by global id or zero weight)."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    world = comms.size
+    n_padded = -(-n // world) * world
+    if n_padded != n:
+        pad_shape = (n_padded - n,) + x.shape[1:]
+        x = jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)], axis=0)
+    spec = (comms.axis,) + (None,) * (x.ndim - 1)
+    return jax.device_put(x, comms.sharding(*spec)), n_padded
+
+
+def make_comms(res=None, axis: str = "data") -> Comms:
+    """Build a Comms from the current Resources' mesh (set_comms/get_comms
+    analog: the mesh slot on Resources is the installed communicator)."""
+    from raft_tpu.core.resources import current_resources
+
+    res = res or current_resources()
+    return Comms(res.default_mesh(axis), axis)

@@ -1,0 +1,145 @@
+"""Data-sharded k-means: the MNMG Lloyd loop over psum collectives.
+
+Reference analog: the comms pattern cuML's MNMG KMeans builds on raft's
+``comms_t`` (docs/source/using_raft_comms.rst — per-rank local labeling +
+``allreduce`` of per-cluster sums/counts), with the single-device EM semantics
+of cluster/kmeans.cuh:88/617 (fused distance+argmin assignment, weighted
+update, empty clusters keep their center, relative-tol inertia stopping).
+
+TPU design: ONE ``shard_map`` region containing the whole ``while_loop`` —
+each EM iteration is a shard-local fused_l2_nn_argmin plus two ``psum``s
+(cluster sums, cluster counts), so the entire fit compiles to a single XLA
+program with ICI collectives inside the loop body.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, make_comms, shard_padded
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.cluster.kmeans import (
+    KMeansOutput,
+    KMeansParams,
+    _init_plus_plus,
+    _init_random,
+)
+from raft_tpu.ops.distance import fused_l2_nn_argmin
+
+
+@functools.lru_cache(maxsize=32)
+def _make_fit_fn(mesh, axis, n_clusters, max_iter, tol):
+    def spmd_fit(shard_X, shard_w, centers0):
+        def em_step(centers):
+            d2, labels = fused_l2_nn_argmin(shard_X, centers)
+            onehot = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+            w = shard_w[:, None]
+            sums = lax.psum(onehot.T @ (shard_X * w), axis)
+            counts = lax.psum(onehot.T @ w, axis)[:, 0]
+            safe = jnp.maximum(counts, 1e-12)[:, None]
+            new_centers = jnp.where(counts[:, None] > 0, sums / safe, centers)
+            inertia = lax.psum(jnp.sum(d2 * shard_w), axis)
+            return new_centers, inertia
+
+        def cond(carry):
+            _, inertia, prev, it = carry
+            return jnp.logical_and(it < max_iter, inertia < prev * (1.0 - tol))
+
+        def body(carry):
+            centers, inertia, _, it = carry
+            nc, ni = em_step(centers)
+            return nc, ni, inertia, it + 1
+
+        c1, i1 = em_step(centers0)
+        centers, inertia, _, n_iter = lax.while_loop(
+            cond, body, (c1, i1, jnp.float32(jnp.inf), jnp.int32(1))
+        )
+        d2, labels = fused_l2_nn_argmin(shard_X, centers)
+        inertia = lax.psum(jnp.sum(d2 * shard_w), axis)
+        return centers, inertia, n_iter, labels
+
+    fn = jax.shard_map(
+        spmd_fit,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P()),
+        out_specs=(P(), P(), P(), P(axis)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _seed_centers(kinit, X, weights, params: KMeansParams, centroids):
+    """Initial centers, honoring ``params.init`` like single-device fit.
+
+    kmeans++ runs on a bounded weighted random subsample (the reference
+    trains coarse centers on a sampled trainset for the same scalability
+    reason, ivf_flat_types.hpp:55 kmeans_trainset_fraction); the subsample is
+    replicated — O(max(4k, 2048)·dim) — while the full X stays sharded.
+    """
+    k = params.n_clusters
+    n = X.shape[0]
+    if params.init == "array":
+        if centroids is None:
+            raise ValueError('init="array" requires centroids')
+        return jnp.asarray(centroids)
+    if params.init == "random":
+        return _init_random(kinit, X, k)
+    ks, kpp = jax.random.split(kinit)
+    n_sample = min(n, max(4 * k, 2048))
+    rows = jax.random.choice(ks, n, (n_sample,), replace=False)
+    return _init_plus_plus(kpp, jnp.asarray(X[rows]), weights[rows], k)
+
+
+def fit(
+    X,
+    params: KMeansParams = KMeansParams(),
+    sample_weight=None,
+    centroids=None,
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[KMeansOutput, jax.Array]:
+    """Distributed k-means fit; returns ``(KMeansOutput, labels)``.
+
+    Mirrors ``cluster.kmeans.fit`` semantics (params.seed/init/n_init all
+    honored; ``centroids`` seeds ``init="array"``), with ``X`` padded to a
+    multiple of the communicator size and row-sharded (padding rows get
+    weight 0 so they never influence centers or inertia).
+    """
+    res = res or current_resources()
+    comms = comms or make_comms(res)
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    k = params.n_clusters
+    if not 0 < k <= n:
+        raise ValueError(f"n_clusters={k} out of range for n={n}")
+
+    w = (
+        jnp.ones((n,), jnp.float32)
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
+    Xs, _ = shard_padded(X, comms)
+    ws, _ = shard_padded(w, comms, fill=0.0)
+    fn = _make_fit_fn(
+        comms.mesh, comms.axis, int(k), int(params.max_iter), float(params.tol)
+    )
+
+    key = jax.random.key(params.seed)
+    best = None
+    best_labels = None
+    for _ in range(max(1, params.n_init)):
+        kinit, key = jax.random.split(key)
+        centers0 = _seed_centers(kinit, X, w, params, centroids)
+        centers, inertia, n_iter, labels = fn(Xs, ws, centers0)
+        out = KMeansOutput(centers, inertia, n_iter)
+        if best is None or float(out.inertia) < float(best.inertia):
+            best, best_labels = out, labels
+        if params.init == "array":
+            break  # deterministic start: n_init re-runs would be identical
+    return best, best_labels[:n]

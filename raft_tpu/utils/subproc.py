@@ -1,0 +1,36 @@
+"""Clean-subprocess environment construction for CPU-forced child runs.
+
+The single home of the axon-plugin wedge workaround (VERDICT.md Weak#1/2):
+on this machine the TPU tunnel plugin can hang backend init when a platform
+is requested via the ``JAX_PLATFORMS`` env var, so child processes that must
+run on CPU (the multichip dryrun, bench's CPU fallback) scrub that var and
+select the platform via ``jax.config.update('jax_platforms', 'cpu')`` inside
+the child instead. Used by ``__graft_entry__.dryrun_multichip`` and
+``bench.py``. Import-light on purpose: no jax import here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def clean_cpu_env(n_devices: Optional[int] = None) -> dict:
+    """A copy of os.environ prepared for a CPU-forced jax child process.
+
+    Scrubs ``JAX_PLATFORMS`` (the child must use the config route) and, when
+    ``n_devices`` is given, pins ``--xla_force_host_platform_device_count``
+    in ``XLA_FLAGS`` (replacing any ambient setting of that flag).
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    if n_devices is not None:
+        flags = " ".join(
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env

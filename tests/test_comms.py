@@ -1,0 +1,90 @@
+"""Multi-device comms-layer tests over the 8-virtual-device CPU mesh.
+
+Mirrors the reference strategy (SURVEY.md §4.2): raft-dask validates every
+collective through the C++ boolean self-test harness
+(comms/comms_test.hpp:34-144) under a LocalCUDACluster; here the same
+per-collective self-tests run under the conftest 8-virtual-device fixture.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms import (
+    Comms,
+    comms_self_test,
+    local_mesh,
+)
+from raft_tpu.comms import comms as C
+from raft_tpu.comms.self_test import _ALL_TESTS
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 virtual devices"
+    return local_mesh(8)
+
+
+def test_self_test_all_pass(mesh):
+    results = comms_self_test(mesh)
+    assert results == {name: True for name in _ALL_TESTS}
+
+
+def test_comms_handle_size_and_sharding(mesh):
+    comm = Comms(mesh)
+    assert comm.size == 8
+    assert comm.axis == "data"
+    x = jnp.arange(16.0).reshape(16, 1)
+    xs = comm.shard_rows(x)
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(x))
+
+
+def test_comms_run_allreduce(mesh):
+    comm = Comms(mesh)
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = comm.run(
+        lambda s: C.allreduce(s, "sum", comm.axis),
+        x,
+        in_specs=(P("data"),),
+        out_specs=P("data"),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_comm_split_shapes(mesh):
+    comm = Comms(mesh)
+    row, col = comm.split(2, 4)
+    assert row.size == 2 and col.size == 4
+    assert row.mesh is col.mesh
+    with pytest.raises(ValueError):
+        comm.split(3, 3)
+
+
+def test_sendrecv_ring(mesh):
+    comm = Comms(mesh)
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = comm.run(
+        lambda s: C.shift(s, -1, comm.axis),  # receive from right neighbor
+        x,
+        in_specs=(P("data"),),
+        out_specs=P("data"),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), -1))
+
+
+def test_allreduce_bad_op(mesh):
+    comm = Comms(mesh)
+    with pytest.raises(ValueError, match="allreduce op"):
+        comm.run(
+            lambda s: C.allreduce(s, "prod", comm.axis),
+            jnp.arange(8.0),
+            in_specs=(P("data"),),
+            out_specs=P("data"),
+        )
+
+
+def test_comms_axis_validation(mesh):
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        Comms(mesh, axis="model")

@@ -1,0 +1,149 @@
+"""MNMG algorithm tests on the 8-virtual-device mesh: sharded results must
+match the single-device library path (tier-1 oracle, SURVEY.md §4.3 — the
+LocalCUDACluster-analog fixture is the conftest virtual CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import kmeans as kmeans_sd
+from raft_tpu.comms import Comms, local_mesh
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.distributed import brute_force as dbf
+from raft_tpu.distributed import kmeans as dkm
+from raft_tpu.neighbors import brute_force as bf
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return Comms(local_mesh(8))
+
+
+def _data(rng, n=500, dim=16, q=20):
+    X = rng.standard_normal((n, dim)).astype(np.float32)
+    Q = rng.standard_normal((q, dim)).astype(np.float32)
+    return X, Q
+
+
+class TestShardedBruteForce:
+    def test_matches_single_device(self, rng, comms):
+        X, Q = _data(rng)
+        idx_s = dbf.build(X, comms=comms)
+        vd, vi = dbf.search(idx_s, Q, 10)
+        ed, ei = bf.search(bf.build(X), Q, 10)
+        np.testing.assert_allclose(np.asarray(vd), np.asarray(ed), rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(vi), np.asarray(ei))
+
+    def test_unpadded_rows_never_returned(self, rng, comms):
+        # n not divisible by 8 → padding rows at the global tail must not
+        # appear even though pad rows are all-zeros (nearest to the origin)
+        X, Q = _data(rng, n=501)
+        origin_query = np.zeros((1, X.shape[1]), np.float32)
+        idx_s = dbf.build(X, comms=comms)
+        _, vi = dbf.search(idx_s, origin_query, 10)
+        assert np.asarray(vi).max() < 501
+        ed, ei = bf.search(bf.build(X), origin_query, 10)
+        np.testing.assert_array_equal(np.asarray(vi), np.asarray(ei))
+
+    def test_inner_product_metric(self, rng, comms):
+        X, Q = _data(rng)
+        idx_s = dbf.build(X, metric="inner_product", comms=comms)
+        vd, vi = dbf.search(idx_s, Q, 5)
+        ed, ei = bf.search(bf.build(X, metric="inner_product"), Q, 5)
+        np.testing.assert_array_equal(np.asarray(vi), np.asarray(ei))
+
+    def test_filter(self, rng, comms):
+        X, Q = _data(rng, n=256)
+        keep = np.zeros(256, bool)
+        keep[::2] = True  # only even ids allowed
+        filt = Bitset.from_mask(keep)
+        idx_s = dbf.build(X, comms=comms)
+        _, vi = dbf.search(idx_s, Q, 8, filter=filt)
+        got = np.asarray(vi)
+        assert (got % 2 == 0).all() and (got >= 0).all()
+        _, ei = bf.search(bf.build(X), Q, 8, filter=filt)
+        np.testing.assert_array_equal(got, np.asarray(ei))
+
+    def test_validation(self, rng, comms):
+        X, Q = _data(rng)
+        idx_s = dbf.build(X, comms=comms)
+        with pytest.raises(ValueError, match="out of range"):
+            dbf.search(idx_s, Q, 0)
+        with pytest.raises(ValueError, match="query dim"):
+            dbf.search(idx_s, Q[:, :3], 5)
+        with pytest.raises(ValueError, match="filter covers"):
+            dbf.search(idx_s, Q, 5, filter=Bitset.create(7))
+
+
+class TestDistributedKMeans:
+    def test_converges_on_blobs(self, rng, comms):
+        # well-separated blobs: distributed fit must recover the centers
+        centers_true = np.array(
+            [[10.0, 0.0, 0.0, 0.0], [0.0, 10.0, 0.0, 0.0],
+             [0.0, 0.0, 10.0, 0.0], [0.0, 0.0, 0.0, 10.0]], np.float32
+        )
+        X = np.concatenate(
+            [c + 0.1 * rng.standard_normal((100, 4)).astype(np.float32)
+             for c in centers_true]
+        )
+        params = kmeans_sd.KMeansParams(n_clusters=4, max_iter=50)
+        out, labels = dkm.fit(X, params, comms=comms)
+        got = np.asarray(out.centroids)
+        # match centers up to permutation
+        d = np.linalg.norm(got[:, None, :] - centers_true[None], axis=-1)
+        assert (d.min(axis=1) < 0.5).all()
+        assert labels.shape == (400,)
+        # all members of one blob share a label
+        lab = np.asarray(labels)
+        for b in range(4):
+            assert len(np.unique(lab[b * 100:(b + 1) * 100])) == 1
+
+    def test_matches_single_device_inertia(self, rng, comms):
+        X, _ = _data(rng, n=512, dim=8)
+        params = kmeans_sd.KMeansParams(n_clusters=8, max_iter=100, init="random", seed=3)
+        out_d, labels = dkm.fit(X, params, comms=comms)
+        out_s = kmeans_sd.fit(X, params)
+        # different inits → different local minima; inertias must be in the
+        # same ballpark and labels consistent with returned centers
+        assert float(out_d.inertia) <= float(out_s.inertia) * 1.3
+        d = np.linalg.norm(
+            X[:, None, :] - np.asarray(out_d.centroids)[None], axis=-1
+        )
+        np.testing.assert_array_equal(np.asarray(labels), d.argmin(axis=1))
+
+    def test_weighted_and_padding(self, rng, comms):
+        # n=333 not divisible by 8; zero-weight rows must not attract centers
+        X = np.concatenate(
+            [np.full((300, 2), 5.0, np.float32),
+             rng.standard_normal((33, 2)).astype(np.float32) + 100.0]
+        )
+        w = np.concatenate([np.ones(300, np.float32), np.zeros(33, np.float32)])
+        params = kmeans_sd.KMeansParams(n_clusters=1, max_iter=20)
+        out, _ = dkm.fit(X, params, sample_weight=w, comms=comms)
+        np.testing.assert_allclose(
+            np.asarray(out.centroids)[0], [5.0, 5.0], atol=1e-3
+        )
+
+    def test_seed_reproducible(self, rng, comms):
+        X, _ = _data(rng, n=200, dim=4)
+        params = kmeans_sd.KMeansParams(n_clusters=5, max_iter=10, seed=7)
+        out_a, _ = dkm.fit(X, params, comms=comms)
+        out_b, _ = dkm.fit(X, params, comms=comms)
+        np.testing.assert_array_equal(
+            np.asarray(out_a.centroids), np.asarray(out_b.centroids)
+        )
+
+    def test_array_init(self, rng, comms):
+        X, _ = _data(rng, n=100, dim=4)
+        c0 = X[:3]
+        params = kmeans_sd.KMeansParams(n_clusters=3, max_iter=10, init="array")
+        out, _ = dkm.fit(X, params, centroids=c0, comms=comms)
+        assert out.centroids.shape == (3, 4)
+        with pytest.raises(ValueError, match="requires centroids"):
+            dkm.fit(X, params, comms=comms)
+
+    def test_validation(self, comms):
+        with pytest.raises(ValueError, match="n_clusters"):
+            dkm.fit(np.zeros((4, 2), np.float32),
+                    kmeans_sd.KMeansParams(n_clusters=10), comms=comms)
