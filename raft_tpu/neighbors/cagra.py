@@ -1,0 +1,409 @@
+"""CAGRA-class graph ANN index: NN-descent build, detour pruning, best-first search.
+
+Reference: raft::neighbors::cagra — build (cagra.cuh:274 →
+detail/cagra/cagra_build.cuh:296: kNN graph via IVF-PQ+refine or NN-descent,
+then graph::optimize = detour-count pruning + reverse-edge add,
+detail/cagra/graph_core.cuh:320, rev-graph kernel :191); search
+(cagra.cuh:299 → detail/cagra/cagra_search.cuh:104, single-CTA persistent
+best-first kernel detail/cagra/search_single_cta_kernel-inl.cuh:466 with
+pickup_next_parents :51, bitonic top-k merge :405, visited hashmap
+detail/cagra/hashmap.hpp). Params mirror cagra_types.hpp:55-134
+(intermediate_graph_degree=128, graph_degree=64, itopk_size=64,
+search_width=1, max/min_iterations, num_random_samplings).
+
+TPU redesign (SURVEY.md §7 hard-part 2 — data-dependent traversal vs XLA
+static shapes):
+
+* **Build**: NN-descent (nn_descent.py) gives the intermediate graph with
+  distances; pruning streams the detour-count computation as a
+  ``lax.scan`` over rank positions (K² comparisons per node per step)
+  instead of the GPU's per-edge bitwise kernel — everything static-shape.
+* **Search**: a fixed-capacity itopk candidate buffer per query, advanced by
+  a ``lax.while_loop``; each step expands the best ``search_width``
+  unvisited entries, gathers their graph rows, computes distances with one
+  batched einsum across the whole query batch (MXU-friendly: the per-query
+  matvec becomes a (Q, w·deg, dim) batched contraction), and merges via
+  sort-based dedup (``merge_topk_dedup``) — the hashmap+bitonic-sort
+  replacement. Termination: all itopk entries visited, or max_iterations.
+* The visited set is the buffer's per-slot flag (the single-CTA parent bit);
+  a node evicted and later re-inserted may be re-expanded — a bounded waste
+  the GPU hashmap avoids, accepted here to keep shapes static.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.neighbors import nn_descent as nnd
+from raft_tpu.ops.segment import merge_topk_dedup, segment_take
+from raft_tpu.utils.tiling import ceil_div
+
+
+@dataclass(frozen=True)
+class CagraParams:
+    """cagra::index_params analog (cagra_types.hpp:55-63)."""
+
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: str = "nn_descent"  # "nn_descent" | "brute" (exact, small n)
+    nn_descent_niter: int = 20
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.graph_degree <= 0:
+            raise ValueError("graph_degree must be positive")
+        if self.intermediate_graph_degree < self.graph_degree:
+            raise ValueError("intermediate_graph_degree < graph_degree")
+        if self.build_algo not in ("nn_descent", "brute"):
+            raise ValueError(f"unknown build_algo {self.build_algo!r}")
+
+
+@dataclass(frozen=True)
+class CagraSearchParams:
+    """cagra::search_params analog (cagra_types.hpp:77-118)."""
+
+    itopk_size: int = 64
+    max_iterations: int = 0  # 0 = auto-sized from itopk/search_width
+    min_iterations: int = 0
+    search_width: int = 1
+    num_random_samplings: int = 1
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.itopk_size <= 0 or self.search_width <= 0:
+            raise ValueError("itopk_size and search_width must be positive")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CagraIndex:
+    """Graph index: dataset + fixed-degree kNN graph (cagra_types.hpp:55-134)."""
+
+    dataset: jax.Array  # (n, dim) fp32
+    graph: jax.Array  # (n, graph_degree) int32 neighbor ids
+    norms: jax.Array  # (n,) squared L2 norms
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+    def tree_flatten(self):
+        return (self.dataset, self.graph, self.norms), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- persistence (cagra_serialize.cuh analog) ---------------------------
+    def save(self, path) -> None:
+        save_arrays(
+            path,
+            {"kind": "cagra", "metric": "sqeuclidean"},
+            {"dataset": self.dataset, "graph": self.graph, "norms": self.norms},
+        )
+
+    @classmethod
+    def load(cls, path) -> "CagraIndex":
+        meta, arrays = load_arrays(path)
+        if meta.get("kind") != "cagra":
+            raise ValueError(f"not a cagra index: {meta.get('kind')}")
+        return cls(
+            jnp.asarray(arrays["dataset"]),
+            jnp.asarray(arrays["graph"]),
+            jnp.asarray(arrays["norms"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Build: kNN graph + optimize (prune)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("out_degree", "n_blocks"))
+def optimize(graph: jax.Array, out_degree: int, n_blocks: int = 1) -> jax.Array:
+    """Prune an intermediate kNN graph to ``out_degree`` (graph::optimize,
+    detail/cagra/graph_core.cuh:320).
+
+    Two stages, mirroring the reference:
+
+    1. **Detour-count pruning**: edge (s→t) at rank j is detourable through
+       u at rank i<j when t appears in u's list at rank m<j (a 2-hop path of
+       strictly better-ranked edges). Keep the ``out_degree`` edges with the
+       fewest detours (rank as tie-break). Computed as a ``lax.scan`` over
+       rank position j with K² membership tests per node — static shapes,
+       streamed memory.
+    2. **Reverse-edge add** (rev-graph kernel analog, graph_core.cuh:191):
+       the final list interleaves the best half of the pruned forward edges
+       with up to degree/2 reverse edges (dedup'd, forward edges fill any
+       remainder) so that every node stays reachable.
+    """
+    n, K = graph.shape
+    block = ceil_div(n, n_blocks)
+    pad = n_blocks * block - n
+    g_pad = jnp.pad(graph, ((0, pad), (0, 0)), constant_values=-1)
+
+    def count_block(_, gb):
+        # gb: (B, K) neighbor ids of this node block
+        two_hop = graph[jnp.maximum(gb, 0)]  # (B, K, K): neighbors of neighbors
+
+        def step(j, counts):
+            t = gb[:, j]  # (B,) target id at rank j
+            # membership of t among each better-ranked neighbor's prefix:
+            # hit[b, i, m] = (two_hop[b, i, m] == t[b]) & (i < j) & (m < j)
+            hit = two_hop == t[:, None, None]
+            ii = jnp.arange(K)[None, :, None] < j
+            mm = jnp.arange(K)[None, None, :] < j
+            c = jnp.sum(hit & ii & mm, axis=(1, 2)).astype(jnp.int32)
+            return counts.at[:, j].set(c)
+
+        counts = lax.fori_loop(0, K, step, jnp.zeros(gb.shape, jnp.int32))
+        return None, counts
+
+    _, counts = lax.scan(
+        count_block, None, g_pad.reshape(n_blocks, block, K)
+    )
+    counts = counts.reshape(-1, K)[:n]
+
+    # keep out_degree edges with fewest detours (rank breaks ties);
+    # invalid (-1) entries sort last
+    rank = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], graph.shape)
+    key = jnp.where(graph >= 0, counts * K + rank, jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(key, axis=1)[:, :out_degree]
+    fwd = jnp.take_along_axis(graph, order, axis=1)  # (n, out_degree)
+
+    # reverse candidates of the pruned graph, capped at out_degree per node,
+    # better-ranked sources first
+    half = max(1, out_degree // 2)
+    src = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], fwd.shape
+    ).reshape(-1)
+    tgt = fwd.reshape(-1)
+    rnk = jnp.broadcast_to(
+        jnp.arange(out_degree, dtype=jnp.int32)[None, :], fwd.shape
+    ).reshape(-1)
+    keys = jnp.where(tgt >= 0, tgt, n).astype(jnp.int32)
+    order = jnp.lexsort((rnk, keys))
+    valid, rev = segment_take(keys[order], n, half, src[order])
+    rev = jnp.where(valid, rev, -1)
+
+    # interleave: forward first-half at priority 0..half-1, reverse at
+    # half..half+half-1, forward second-half last; dedup by id keeps the
+    # best priority
+    prio_fwd = jnp.where(
+        jnp.arange(out_degree)[None, :] < half,
+        jnp.arange(out_degree, dtype=jnp.int32)[None, :],
+        (jnp.arange(out_degree, dtype=jnp.int32) + 2 * half)[None, :],
+    ).astype(jnp.float32)
+    prio_fwd = jnp.broadcast_to(prio_fwd, fwd.shape)
+    prio_fwd = jnp.where(fwd >= 0, prio_fwd, jnp.inf)
+    prio_rev = jnp.broadcast_to(
+        (jnp.arange(half, dtype=jnp.int32) + half)[None, :].astype(jnp.float32),
+        rev.shape,
+    )
+    prio_rev = jnp.where(rev >= 0, prio_rev, jnp.inf)
+    out_ids, _, _ = merge_topk_dedup(
+        fwd, prio_fwd, rev, prio_rev, out_degree,
+        exclude_self=jnp.arange(n, dtype=jnp.int32),
+    )
+    return out_ids
+
+
+def build(
+    dataset,
+    params: CagraParams = CagraParams(),
+    res: Optional[Resources] = None,
+) -> CagraIndex:
+    """Build a CAGRA index (cagra.cuh:274 → cagra_build.cuh:296): kNN graph
+    via NN-descent (or exact for small n), then optimize to graph_degree."""
+    res = res or current_resources()
+    X = jnp.asarray(dataset, jnp.float32)
+    n, dim = X.shape
+    ideg = int(min(params.intermediate_graph_degree, n - 1))
+    deg = int(min(params.graph_degree, ideg))
+
+    if params.build_algo == "brute" or n <= 2048:
+        # exact graph for small datasets (the reference uses ivf_pq+refine;
+        # at this scale one tiled exact pass is cheaper than training IVF)
+        from raft_tpu.neighbors.brute_force import knn
+
+        _, ids = knn(X, X, ideg + 1, metric="sqeuclidean", res=res)
+        # drop self-matches (first column after exact sort)
+        self_col = ids == jnp.arange(n, dtype=jnp.int32)[:, None]
+        ids = jnp.where(self_col, -1, ids)
+        order = jnp.argsort(jnp.where(ids < 0, 2, 0), axis=1, stable=True)[:, :ideg]
+        graph = jnp.take_along_axis(ids, order, axis=1)
+    else:
+        graph = nnd.build(
+            X,
+            nnd.NNDescentParams(
+                graph_degree=ideg,
+                intermediate_graph_degree=min(int(1.5 * ideg), n - 1),
+                max_iterations=params.nn_descent_niter,
+                seed=params.seed,
+            ),
+            res=res,
+        )
+
+    # detour-prune in blocks bounded by workspace: scan materializes
+    # (block, K, K) two-hop ids (int32)
+    per_node = ideg * ideg * 4 * 2
+    block = max(128, int(res.workspace_bytes // max(per_node, 1) // 2))
+    n_blocks = max(1, ceil_div(n, block))
+    pruned = optimize(graph, deg, n_blocks=n_blocks)
+    norms = jnp.sum(X * X, axis=1)
+    return CagraIndex(X, pruned, norms)
+
+
+def build_from_graph(dataset, graph) -> CagraIndex:
+    """Wrap a prebuilt kNN graph (the from-serialized / interop path)."""
+    X = jnp.asarray(dataset, jnp.float32)
+    return CagraIndex(X, jnp.asarray(graph, jnp.int32), jnp.sum(X * X, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "n_rand"),
+)
+def _search_impl(
+    dataset, norms, graph, queries, key, filter_bits, n_bits,
+    k, itopk, width, max_iter, min_iter, n_rand,
+):
+    n, dim = dataset.shape
+    q = queries.shape[0]
+    deg = graph.shape[1]
+    qn = jnp.sum(queries * queries, axis=1)  # (q,)
+    inf = jnp.float32(jnp.inf)
+
+    def batch_dists(ids):
+        """(q, m) distances of each query to dataset[ids] (q, m)."""
+        xv = dataset[jnp.maximum(ids, 0)]  # (q, m, dim)
+        ip = jnp.einsum("qmd,qd->qm", xv, queries)
+        d = qn[:, None] + norms[jnp.maximum(ids, 0)] - 2.0 * ip
+        return jnp.where(ids >= 0, jnp.maximum(d, 0.0), inf)
+
+    # ---- init: random seeds (num_random_samplings analog) -----------------
+    n_seed = min(itopk * n_rand, n)
+    seed_ids = jax.random.randint(key, (q, n_seed), 0, n, dtype=jnp.int32)
+    seed_d = batch_dists(seed_ids)
+    buf_ids, buf_d, _, buf_vis = merge_topk_dedup(
+        jnp.full((q, itopk), -1, jnp.int32),
+        jnp.full((q, itopk), inf, jnp.float32),
+        seed_ids,
+        seed_d,
+        itopk,
+        payload=jnp.ones((q, itopk), jnp.bool_),
+        cand_payload=jnp.zeros(seed_ids.shape, jnp.bool_),
+    )
+
+    def cond(state):
+        ids_b, _, vis, it = state
+        frontier_open = jnp.any(~vis & (ids_b >= 0))
+        return (it < max_iter) & (frontier_open | (it < min_iter))
+
+    def body(state):
+        ids_b, d_b, vis, it = state
+        # pickup_next_parents (:51): best `width` unvisited buffer entries
+        pkey = jnp.where(vis | (ids_b < 0), inf, d_b)
+        _, ppos = lax.top_k(-pkey, width)  # positions of best unvisited
+        parent_ids = jnp.take_along_axis(ids_b, ppos, axis=1)  # (q, w)
+        parent_ok = jnp.take_along_axis(pkey, ppos, axis=1) < inf
+        # mark them visited
+        vis = vis | jnp.zeros_like(vis).at[
+            jnp.arange(q)[:, None], ppos
+        ].set(True)
+        # expand: gather graph rows → (q, w*deg) candidates
+        nbrs = graph[jnp.maximum(parent_ids, 0)].reshape(q, width * deg)
+        nbrs = jnp.where(
+            (parent_ok[:, :, None] & (graph[jnp.maximum(parent_ids, 0)] >= 0)).reshape(
+                q, width * deg
+            ),
+            nbrs,
+            -1,
+        )
+        nd = batch_dists(nbrs)
+        ids2, d2, _, vis2 = merge_topk_dedup(
+            ids_b, d_b, nbrs, nd, itopk,
+            payload=vis, cand_payload=jnp.zeros(nbrs.shape, jnp.bool_),
+        )
+        return ids2, d2, vis2, it + 1
+
+    buf_ids, buf_d, _, _ = lax.while_loop(
+        cond, body, (buf_ids, buf_d, buf_vis, jnp.int32(0))
+    )
+
+    # ---- output: filter + top-k from the buffer ---------------------------
+    if filter_bits is not None:
+        allowed = Bitset(filter_bits, n_bits).test(buf_ids)
+        buf_d = jnp.where(allowed, buf_d, inf)
+        order = jnp.argsort(buf_d, axis=1)
+        buf_d = jnp.take_along_axis(buf_d, order, axis=1)
+        buf_ids = jnp.take_along_axis(buf_ids, order, axis=1)
+    out_d = buf_d[:, :k]
+    out_ids = jnp.where(jnp.isinf(out_d), -1, buf_ids[:, :k])
+    return out_d, out_ids
+
+
+def search(
+    index: CagraIndex,
+    queries,
+    k: int,
+    params: CagraSearchParams = CagraSearchParams(),
+    filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Best-first graph search (cagra.cuh:299); returns (distances, indices).
+
+    Graph traversal visits filtered-out nodes (they route) but never returns
+    them (the reference applies its sample filter the same way). Internal
+    buffer = itopk_size candidates per query; k must not exceed it.
+    """
+    res = res or current_resources()
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim})")
+    itopk = int(min(params.itopk_size, index.size))
+    if not 0 < k <= itopk:
+        raise ValueError(f"k={k} must be in (0, itopk_size={itopk}]")
+    if filter is not None and filter.n_bits != index.size:
+        raise ValueError(
+            f"filter covers {filter.n_bits} bits but index has {index.size} rows"
+        )
+    width = int(params.search_width)
+    max_iter = int(params.max_iterations) or max(16, itopk // width)
+    min_iter = int(min(params.min_iterations, max_iter))
+    key = jax.random.key(params.seed)
+    return _search_impl(
+        index.dataset,
+        index.norms,
+        index.graph,
+        queries,
+        key,
+        filter.bits if filter is not None else None,
+        index.size,
+        int(k),
+        itopk,
+        width,
+        max_iter,
+        min_iter,
+        int(max(1, params.num_random_samplings)),
+    )

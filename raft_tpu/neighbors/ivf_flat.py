@@ -244,10 +244,14 @@ def _search_impl(
 
     # ---- stage 1: coarse quantizer (one gemm over all centers) ------------
     if metric in ("sqeuclidean", "euclidean"):
-        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+        # explicit full precision for probe ranking (ADVICE.md: backend-
+        # default bf16 coarse distances can mis-rank probe lists)
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
         qn = dist_mod.sqnorm(queries)
     else:  # cosine (pre-normalized) and inner_product probe by max ip
-        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
         qn = None
     _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)  # (q, p)
 

@@ -42,11 +42,14 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.logger import get_logger
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.pq_scan import group_probed_pairs, pq_scan
 from raft_tpu.ops.select_k import select_k
+
+_log = get_logger()
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
 # lists padded to 128 (vs the reference kIndexGroupSize 32): the Pallas scan
@@ -433,9 +436,11 @@ def _search_impl_jnp(
 
     # stage 1: coarse distances; keep probed values (they're the d² constant)
     if l2:
-        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
     else:
-        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
     coarse_vals, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
 
     luts = _query_luts(queries, rotation, codebooks, metric, jnp.float32)
@@ -508,9 +513,11 @@ def _search_impl_pallas(
     l2 = metric in ("sqeuclidean", "euclidean")
 
     if l2:
-        coarse = dist_mod._expanded_distance(queries, centers, "sqeuclidean", compute_dtype, None)
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
     else:
-        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype)
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
     coarse_vals, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
 
     luts = _query_luts(queries, rotation, codebooks, metric, jnp.bfloat16)
@@ -518,9 +525,13 @@ def _search_impl_pallas(
     codes_t = jnp.transpose(list_codes, (0, 2, 1))  # (L, s, m), list dim minor
 
     def scan_tile(args):
-        luts_t, probe_blk, cvals_blk = args  # (qt, f), (qt, p), (qt, p)
+        luts_t, probe_blk, cvals_blk, qmask = args  # (qt, f), (qt, p), (qt, p), (qt,)
         qt = probe_blk.shape[0]
         qids, slot = group_probed_pairs(probe_blk, n_lists, qpl_cap)
+        # count real (query, probe) pairs beyond the per-list cap (ADVICE.md:
+        # silent drops degrade recall under probe skew; surfaced to search()
+        # which retries with a larger cap or falls back to the gather path)
+        n_dropped = jnp.sum((slot < 0) & qmask[:, None])
         luts_g = jnp.where(
             (qids >= 0)[:, :, None], luts_t[jnp.maximum(qids, 0)], jnp.bfloat16(0)
         )
@@ -542,29 +553,34 @@ def _search_impl_pallas(
             vals = jnp.maximum(vals, 0.0)
             if metric == "euclidean":
                 vals = jnp.sqrt(vals)
-        return vals, out_ids
+        return vals, out_ids, n_dropped
 
     if q_tile >= q:
-        vals, ids = scan_tile((luts, probes, coarse_vals))
+        vals, ids, dropped = scan_tile(
+            (luts, probes, coarse_vals, jnp.ones((q,), jnp.bool_))
+        )
     else:
         n_tiles = -(-q // q_tile)
         pad = n_tiles * q_tile - q
         lp = jnp.pad(luts, ((0, pad), (0, 0)))
         pp = jnp.pad(probes, ((0, pad), (0, 0)))
         cp = jnp.pad(coarse_vals, ((0, pad), (0, 0)))
-        vals, ids = lax.map(
+        qm = jnp.pad(jnp.ones((q,), jnp.bool_), (0, pad))
+        vals, ids, dropped = lax.map(
             scan_tile,
             (
                 lp.reshape(n_tiles, q_tile, luts.shape[1]),
                 pp.reshape(n_tiles, q_tile, n_probes),
                 cp.reshape(n_tiles, q_tile, n_probes),
+                qm.reshape(n_tiles, q_tile),
             ),
         )
         vals = vals.reshape(-1, k)[:q]
         ids = ids.reshape(-1, k)[:q]
+        dropped = jnp.sum(dropped)
     if not l2:
         vals = -vals
-    return vals, ids
+    return vals, ids, dropped
 
 
 def search(
@@ -597,24 +613,53 @@ def search(
         # large shapes — on TPU always use the list-centric kernel (wide
         # pq_bits=8 LUTs just get smaller query tiles via the budget below)
         backend = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if backend not in ("pallas", "gather"):
+        raise ValueError(f"unknown backend {backend!r}")
     if backend == "pallas":
-        q, p = queries.shape[0], n_probes
-        # per-list query cap: 2x the mean load, 16-aligned (bf16 sublanes)
-        qpl_cap = -(-max(16, (2 * q * p) // index.n_lists) // 16) * 16
-        # tile so the (L, qpl, m) grouped scores block fits the budget
-        per_tile = index.n_lists * qpl_cap * index.max_list_size * 4
-        q_tile = queries.shape[0]
-        while per_tile > res.workspace_bytes and q_tile > 64:
-            q_tile //= 2
-            qpl_cap = -(-max(16, (2 * q_tile * p) // index.n_lists) // 16) * 16
-            per_tile = index.n_lists * qpl_cap * index.max_list_size * 4
-        vals, ids = _search_impl_pallas(
-            queries, index.centers, index.rotation, index.codebooks,
-            index.list_codes, index.list_ids, index.b_sum, filter,
-            int(k), n_probes, index.metric, int(q_tile), int(qpl_cap),
-            select_algo, res.compute_dtype, jax.default_backend() != "tpu",
-        )
-    elif backend == "gather":
+        p = n_probes
+        n_codes = index.codebooks.shape[1]
+        # per (list, slot): fp32 scores row + the bf16 gathered LUT row
+        # (ADVICE.md: the luts_g block dominates at pq_bits=8 and must be
+        # part of the budget)
+        per_slot = index.max_list_size * 4 + index.pq_dim * n_codes * 2
+
+        def _sizes(cap_mult):
+            # per-list query cap: cap_mult x the mean load, 16-aligned
+            q_tile = queries.shape[0]
+            qpl_cap = -(-max(16, (cap_mult * q_tile * p) // index.n_lists) // 16) * 16
+            while index.n_lists * qpl_cap * per_slot > res.workspace_bytes and q_tile > 64:
+                q_tile //= 2
+                qpl_cap = -(-max(16, (cap_mult * q_tile * p) // index.n_lists) // 16) * 16
+            return int(q_tile), int(qpl_cap)
+
+        # drop-detect + escalate: start at 2x mean; a skewed probe
+        # distribution that still drops pairs doubles the cap (one retrace),
+        # and persistent drops fall back to the exact gather backend
+        # (ADVICE.md medium finding — drops silently degraded recall)
+        cap_mult, dropped = 2, 0
+        for attempt in range(3):
+            q_tile, qpl_cap = _sizes(cap_mult)
+            vals, ids, dropped = _search_impl_pallas(
+                queries, index.centers, index.rotation, index.codebooks,
+                index.list_codes, index.list_ids, index.b_sum, filter,
+                int(k), n_probes, index.metric, q_tile, qpl_cap,
+                select_algo, res.compute_dtype, jax.default_backend() != "tpu",
+            )
+            dropped = int(dropped)
+            if dropped == 0:
+                break
+            cap_mult *= 2
+            _log.warning(
+                "ivf_pq pallas scan dropped %d probed pairs at qpl_cap=%d "
+                "(skewed probes); retrying with a larger cap", dropped, qpl_cap,
+            )
+        if dropped > 0:
+            _log.warning(
+                "ivf_pq pallas scan still dropping %d pairs; falling back "
+                "to the gather backend for this call", dropped,
+            )
+            backend = "gather"
+    if backend == "gather":
         # tile budget: the (qt, p, m, s) code gather dominates
         per_query = max(1, n_probes * index.max_list_size * (index.pq_dim * 5 + 8))
         q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
@@ -624,8 +669,6 @@ def search(
             int(k), n_probes, index.metric, q_tile, select_algo,
             res.compute_dtype,
         )
-    else:
-        raise ValueError(f"unknown backend {backend!r}")
     if index.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
     return vals, ids

@@ -1,0 +1,105 @@
+"""Static-shape segment/scatter utilities for graph algorithms.
+
+The GPU reference scatters candidate edges into per-node lists with atomics
+(e.g. NN-descent's update loop, neighbors/detail/nn_descent.cuh:1215, and
+CAGRA's hashmap dedup, detail/cagra/hashmap.hpp). TPUs have no scatter
+atomics; the idiomatic replacement is sort-based distribution: sort the edge
+list by target segment, locate each segment's span with ``searchsorted``, and
+gather a *capped* number of entries per segment — every shape static, every
+step a vectorized sort/gather that XLA maps onto the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_take(
+    keys_sorted: jax.Array,
+    n_segments: int,
+    cap: int,
+    *values: jax.Array,
+) -> Tuple[jax.Array, ...]:
+    """Per-segment capped gather from a key-sorted flat array.
+
+    ``keys_sorted`` is an ascending (m,) int array of segment ids (invalid
+    entries must be sorted to the end with key >= n_segments). For each
+    segment s, gathers the first ``cap`` positions of its span. Returns
+    ``(valid (n_segments, cap) bool, *gathered values)``.
+
+    This is the TPU replacement for "atomic append to per-node buffer":
+    entries beyond ``cap`` per segment are dropped — callers bound the loss
+    (it mirrors the reference's fixed-size per-node buffers).
+    """
+    m = keys_sorted.shape[0]
+    starts = jnp.searchsorted(keys_sorted, jnp.arange(n_segments, dtype=keys_sorted.dtype))
+    pos = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    in_range = pos < m
+    posc = jnp.minimum(pos, m - 1)
+    valid = in_range & (keys_sorted[posc] == jnp.arange(n_segments)[:, None])
+    return (valid,) + tuple(v[posc] for v in values)
+
+
+def merge_topk_dedup(
+    ids: jax.Array,
+    dists: jax.Array,
+    cand_ids: jax.Array,
+    cand_dists: jax.Array,
+    k: int,
+    exclude_self: jax.Array = None,
+    payload: jax.Array = None,
+    cand_payload: jax.Array = None,
+):
+    """Row-wise merge of a neighbor list with candidates, dedup by id, top-k.
+
+    Inputs are (n, a) current lists and (n, b) candidates; invalid entries
+    are id=-1 / dist=+inf. ``exclude_self`` (n,) optionally removes each
+    row's own id. Returns ``(ids (n,k), dists (n,k), from_cand (n,k))`` —
+    ``from_cand`` marks entries that came from the candidate side (the
+    update counter NN-descent's termination test needs). If ``payload`` /
+    ``cand_payload`` (same shapes as the id arrays) are given, the surviving
+    entries' payload is returned as a fourth output (used to carry
+    NN-descent's new/old flags through the merge).
+
+    This is the sort-based replacement for the reference's bitonic
+    merge-and-dedup (nn_descent.cuh local_join / cagra search's
+    topk_by_bitonic_sort + hashmap): one lexsort by (id, dist) marks
+    duplicates, one value sort restores distance order.
+    """
+    inf = jnp.float32(jnp.inf)
+    all_ids = jnp.concatenate([ids, cand_ids], axis=1)
+    all_d = jnp.concatenate([dists, cand_dists], axis=1)
+    all_c = jnp.concatenate(
+        [jnp.zeros(ids.shape, jnp.bool_), jnp.ones(cand_ids.shape, jnp.bool_)],
+        axis=1,
+    )
+    has_payload = payload is not None
+    if has_payload:
+        all_p = jnp.concatenate([payload, cand_payload], axis=1)
+    # primary key id, secondary dist: first occurrence of each id is its best
+    order = jnp.lexsort((all_d, all_ids), axis=-1)
+    sid = jnp.take_along_axis(all_ids, order, axis=1)
+    sd = jnp.take_along_axis(all_d, order, axis=1)
+    sc = jnp.take_along_axis(all_c, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((sid.shape[0], 1), jnp.bool_), sid[:, 1:] == sid[:, :-1]], axis=1
+    )
+    bad = dup | (sid < 0)
+    if exclude_self is not None:
+        bad = bad | (sid == exclude_self[:, None])
+    sd = jnp.where(bad, inf, sd)
+    # restore distance order, take k
+    order2 = jnp.argsort(sd, axis=1)[:, :k]
+    out_ids = jnp.take_along_axis(sid, order2, axis=1)
+    out_d = jnp.take_along_axis(sd, order2, axis=1)
+    out_c = jnp.take_along_axis(sc, order2, axis=1)
+    out_ids = jnp.where(jnp.isinf(out_d), -1, out_ids)
+    out_c = out_c & ~jnp.isinf(out_d)
+    if has_payload:
+        sp = jnp.take_along_axis(all_p, order, axis=1)
+        out_p = jnp.take_along_axis(sp, order2, axis=1)
+        return out_ids, out_d, out_c, out_p
+    return out_ids, out_d, out_c

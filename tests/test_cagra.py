@@ -1,0 +1,172 @@
+"""CAGRA + NN-descent tests: recall-gated vs the exact oracle (tier-3,
+SURVEY.md §4.3 — mirrors cpp/test/neighbors/ann_cagra recall thresholds)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, cagra, nn_descent
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    n, dim, q = 1500, 24, 64
+    X = rng.standard_normal((n, dim)).astype(np.float32)
+    Q = rng.standard_normal((q, dim)).astype(np.float32)
+    return X, Q
+
+
+def _recall(got, want):
+    k = want.shape[1]
+    return np.mean(
+        [len(set(got[i]) & set(want[i])) / k for i in range(want.shape[0])]
+    )
+
+
+class TestNNDescent:
+    def test_graph_recall(self, data):
+        X, _ = data
+        n = X.shape[0]
+        k = 16
+        ids = nn_descent.build(
+            X,
+            nn_descent.NNDescentParams(
+                graph_degree=k, intermediate_graph_degree=32,
+                max_iterations=12, sample_size=8,
+            ),
+        )
+        ids = np.asarray(ids)
+        _, exact = brute_force.knn(X, X, k + 1)
+        exact = np.asarray(exact)[:, 1:]  # drop self
+        assert _recall(ids, exact) >= 0.9
+
+    def test_distances_match_ids(self, data):
+        X, _ = data
+        ids, d = nn_descent.build(
+            X,
+            nn_descent.NNDescentParams(
+                graph_degree=8, intermediate_graph_degree=16,
+                max_iterations=6, sample_size=8,
+            ),
+            return_distances=True,
+        )
+        ids, d = np.asarray(ids), np.asarray(d)
+        i = 17
+        expect = ((X[i] - X[ids[i]]) ** 2).sum(axis=1)
+        np.testing.assert_allclose(d[i], expect, rtol=1e-4, atol=1e-4)
+        # sorted ascending, no self, no dups
+        assert (np.diff(d[i]) >= -1e-6).all()
+        assert i not in ids[i]
+        assert len(np.unique(ids[i])) == len(ids[i])
+
+    def test_params_validation(self):
+        with pytest.raises(ValueError, match="graph_degree"):
+            nn_descent.NNDescentParams(graph_degree=64, intermediate_graph_degree=32)
+        with pytest.raises(ValueError, match="sample_size"):
+            nn_descent.NNDescentParams(sample_size=0)
+        with pytest.raises(ValueError, match="at least 2 rows"):
+            nn_descent.build(np.zeros((1, 4), np.float32))
+
+
+class TestCagraBuild:
+    def test_optimize_degree_and_no_self(self, data):
+        X, _ = data
+        idx = cagra.build(X, cagra.CagraParams(graph_degree=16, intermediate_graph_degree=32))
+        g = np.asarray(idx.graph)
+        assert g.shape == (X.shape[0], 16)
+        assert (g != np.arange(X.shape[0])[:, None]).all()
+        # rows fully populated (connected graph region) and deduped
+        assert (g >= 0).all()
+        for r in [0, 100, 777]:
+            assert len(np.unique(g[r])) == 16
+
+    def test_detour_pruning_prefers_diverse_edges(self):
+        # a tight cluster + far point: pruning must keep the far point
+        # reachable (reverse edges guarantee in-edges to every node)
+        rng = np.random.default_rng(0)
+        X = np.concatenate(
+            [rng.standard_normal((200, 8)).astype(np.float32),
+             np.full((1, 8), 50.0, np.float32)]
+        )
+        idx = cagra.build(X, cagra.CagraParams(graph_degree=8, intermediate_graph_degree=16))
+        g = np.asarray(idx.graph)
+        assert (g == 200).any(), "far point must appear as someone's neighbor"
+
+    def test_build_from_graph_roundtrip(self, data, tmp_path):
+        X, Q = data
+        idx = cagra.build(X, cagra.CagraParams(graph_degree=16, intermediate_graph_degree=32))
+        p = tmp_path / "cagra.bin"
+        idx.save(p)
+        idx2 = cagra.CagraIndex.load(p)
+        np.testing.assert_array_equal(np.asarray(idx.graph), np.asarray(idx2.graph))
+        vd1, vi1 = cagra.search(idx, Q, 5)
+        vd2, vi2 = cagra.search(idx2, Q, 5)
+        np.testing.assert_array_equal(np.asarray(vi1), np.asarray(vi2))
+
+    def test_load_wrong_kind(self, tmp_path, data):
+        X, _ = data
+        bf_idx = brute_force.build(X)
+        p = tmp_path / "bf.bin"
+        bf_idx.save(p)
+        with pytest.raises(ValueError, match="not a cagra index"):
+            cagra.CagraIndex.load(p)
+
+
+class TestCagraSearch:
+    @pytest.fixture(scope="class")
+    def index(self, data):
+        X, _ = data
+        return cagra.build(
+            X, cagra.CagraParams(graph_degree=16, intermediate_graph_degree=32)
+        )
+
+    def test_recall_vs_exact(self, data, index):
+        X, Q = data
+        k = 10
+        _, vi = cagra.search(index, Q, k, cagra.CagraSearchParams(itopk_size=64))
+        _, ei = brute_force.knn(Q, X, k)
+        assert _recall(np.asarray(vi), np.asarray(ei)) >= 0.9
+
+    def test_recall_improves_with_itopk(self, data, index):
+        X, Q = data
+        k = 10
+        _, ei = brute_force.knn(Q, X, k)
+        ei = np.asarray(ei)
+        _, vi_small = cagra.search(index, Q, k, cagra.CagraSearchParams(itopk_size=16))
+        _, vi_big = cagra.search(index, Q, k, cagra.CagraSearchParams(itopk_size=128))
+        assert _recall(np.asarray(vi_big), ei) >= _recall(np.asarray(vi_small), ei)
+        assert _recall(np.asarray(vi_big), ei) >= 0.95
+
+    def test_filter(self, data, index):
+        X, Q = data
+        n = X.shape[0]
+        keep = np.zeros(n, bool)
+        keep[: n // 2] = True
+        filt = Bitset.from_mask(keep)
+        _, vi = cagra.search(index, Q, 5, filter=filt)
+        got = np.asarray(vi)
+        assert ((got < n // 2) | (got == -1)).all()
+        # oracle on the allowed half
+        _, ei = brute_force.search(brute_force.build(X), Q, 5, filter=filt)
+        assert _recall(got, np.asarray(ei)) >= 0.85
+
+    def test_search_width_batching(self, data, index):
+        X, Q = data
+        _, vi = cagra.search(
+            index, Q, 10,
+            cagra.CagraSearchParams(itopk_size=64, search_width=4),
+        )
+        _, ei = brute_force.knn(Q, X, 10)
+        assert _recall(np.asarray(vi), np.asarray(ei)) >= 0.9
+
+    def test_validation(self, data, index):
+        X, Q = data
+        with pytest.raises(ValueError, match="must be in"):
+            cagra.search(index, Q, 100, cagra.CagraSearchParams(itopk_size=32))
+        with pytest.raises(ValueError, match="queries must be"):
+            cagra.search(index, Q[:, :3], 5)
+        with pytest.raises(ValueError, match="filter covers"):
+            cagra.search(index, Q, 5, filter=Bitset.create(10))
+        with pytest.raises(ValueError, match="unknown build_algo"):
+            cagra.CagraParams(build_algo="hnsw")

@@ -80,3 +80,33 @@ class TestPallasSearchBackend:
         v, i = ivf_pq.search(idx, qs, 3, n_probes=8, backend="pallas", filter=none)
         assert np.all(np.asarray(i) == -1)
         assert np.all(np.isinf(np.asarray(v)))
+
+
+class TestProbeSkewDrops:
+    """ADVICE.md medium finding: pairs beyond qpl_cap must not silently
+    degrade recall — search() detects drops and escalates the cap (or falls
+    back to the gather backend)."""
+
+    def test_adversarial_skew_matches_gather(self):
+        # every query probes the SAME hot lists → per-list load = q,
+        # far above the 2x-mean cap → the pallas path must escalate
+        import numpy as np
+
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(11)
+        # one dominant cluster so all queries rank the same lists first
+        hot = rng.normal(scale=0.05, size=(3000, 16)).astype(np.float32)
+        cold = rng.normal(loc=30.0, scale=4.0, size=(1000, 16)).astype(np.float32)
+        ds = np.concatenate([hot, cold])
+        qs = rng.normal(scale=0.05, size=(128, 16)).astype(np.float32)
+        idx = ivf_pq.build(
+            ds, ivf_pq.IvfPqParams(n_lists=64, pq_dim=8, pq_bits=6, seed=0)
+        )
+        vp, ip_ = ivf_pq.search(idx, qs, 10, n_probes=8, backend="pallas")
+        vg, ig = ivf_pq.search(idx, qs, 10, n_probes=8, backend="gather")
+        # identical results: no silently-lost candidates under skew
+        np.testing.assert_array_equal(np.asarray(ip_), np.asarray(ig))
+        np.testing.assert_allclose(
+            np.asarray(vp), np.asarray(vg), rtol=1e-3, atol=1e-3
+        )

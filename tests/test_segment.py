@@ -1,0 +1,77 @@
+"""Unit tests for the sort-based segment/scatter utilities (the TPU
+replacement for GPU atomic list appends — ops/segment.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops.segment import merge_topk_dedup, segment_take
+
+
+class TestSegmentTake:
+    def test_basic_spans(self):
+        keys = jnp.asarray([0, 0, 1, 3, 3, 3], jnp.int32)
+        vals = jnp.asarray([10, 11, 20, 30, 31, 32], jnp.int32)
+        valid, got = segment_take(keys, 4, 2, vals)
+        valid, got = np.asarray(valid), np.asarray(got)
+        assert got[0, 0] == 10 and got[0, 1] == 11 and valid[0].all()
+        assert got[1, 0] == 20 and valid[1].tolist() == [True, False]
+        assert not valid[2].any()  # empty segment
+        assert got[3].tolist() == [30, 31] and valid[3].all()  # capped at 2
+
+    def test_invalid_keys_sorted_to_end(self):
+        keys = jnp.asarray([1, 5, 5], jnp.int32)  # 5 = n_segments → invalid
+        vals = jnp.asarray([7, 8, 9], jnp.int32)
+        valid, got = segment_take(keys, 5, 2, vals)
+        assert np.asarray(valid).sum() == 1
+        assert np.asarray(got)[1, 0] == 7
+
+    def test_multiple_values(self):
+        keys = jnp.asarray([2, 2], jnp.int32)
+        a = jnp.asarray([1, 2], jnp.int32)
+        b = jnp.asarray([0.5, 0.25], jnp.float32)
+        valid, ga, gb = segment_take(keys, 3, 2, a, b)
+        assert np.asarray(ga)[2].tolist() == [1, 2]
+        np.testing.assert_allclose(np.asarray(gb)[2], [0.5, 0.25])
+
+
+class TestMergeTopkDedup:
+    def test_dedup_keeps_best(self):
+        ids = jnp.asarray([[3, 5, -1]], jnp.int32)
+        d = jnp.asarray([[1.0, 2.0, np.inf]], jnp.float32)
+        cids = jnp.asarray([[5, 7]], jnp.int32)
+        cd = jnp.asarray([[0.5, 3.0]], jnp.float32)
+        out_ids, out_d, from_cand = merge_topk_dedup(ids, d, cids, cd, 3)
+        assert np.asarray(out_ids)[0].tolist() == [5, 3, 7]
+        np.testing.assert_allclose(np.asarray(out_d)[0], [0.5, 1.0, 3.0])
+        assert np.asarray(from_cand)[0].tolist() == [True, False, True]
+
+    def test_exclude_self(self):
+        ids = jnp.asarray([[0, 2]], jnp.int32)
+        d = jnp.asarray([[0.1, 0.2]], jnp.float32)
+        cids = jnp.asarray([[1]], jnp.int32)
+        cd = jnp.asarray([[0.05]], jnp.float32)
+        out_ids, _, _ = merge_topk_dedup(
+            ids, d, cids, cd, 2, exclude_self=jnp.asarray([0], jnp.int32)
+        )
+        got = np.asarray(out_ids)[0]
+        assert 0 not in got and got.tolist() == [1, 2]
+
+    def test_payload_carried(self):
+        ids = jnp.asarray([[4, 6]], jnp.int32)
+        d = jnp.asarray([[1.0, 2.0]], jnp.float32)
+        p = jnp.asarray([[True, False]], jnp.bool_)
+        cids = jnp.asarray([[8]], jnp.int32)
+        cd = jnp.asarray([[1.5]], jnp.float32)
+        cp = jnp.asarray([[True]], jnp.bool_)
+        out_ids, _, _, out_p = merge_topk_dedup(
+            ids, d, cids, cd, 3, payload=p, cand_payload=cp
+        )
+        assert np.asarray(out_ids)[0].tolist() == [4, 8, 6]
+        assert np.asarray(out_p)[0].tolist() == [True, True, False]
+
+    def test_all_invalid(self):
+        ids = jnp.full((2, 3), -1, jnp.int32)
+        d = jnp.full((2, 3), np.inf, jnp.float32)
+        out_ids, out_d, _ = merge_topk_dedup(ids, d, ids, d, 2)
+        assert (np.asarray(out_ids) == -1).all()
+        assert np.isinf(np.asarray(out_d)).all()
