@@ -1,0 +1,44 @@
+"""Statistics & metrics (reference cpp/include/raft/stats/)."""
+
+from raft_tpu.stats.metrics import (
+    accuracy,
+    adjusted_rand_index,
+    completeness_score,
+    contingency_matrix,
+    homogeneity_score,
+    mutual_info_score,
+    neighborhood_recall,
+    r2_score,
+    rand_index,
+    regression_metrics,
+    silhouette_score,
+    trustworthiness_score,
+    v_measure,
+)
+from raft_tpu.stats.summary import (
+    cov,
+    dispersion,
+    entropy,
+    histogram,
+    information_criterion,
+    kl_divergence,
+    mean,
+    mean_add,
+    mean_center,
+    meanvar,
+    minmax,
+    stddev,
+    sum_,
+    vars_,
+    weighted_mean,
+)
+
+__all__ = [
+    "accuracy", "adjusted_rand_index", "completeness_score",
+    "contingency_matrix", "homogeneity_score", "mutual_info_score",
+    "neighborhood_recall", "r2_score", "rand_index", "regression_metrics",
+    "silhouette_score", "trustworthiness_score", "v_measure",
+    "cov", "dispersion", "entropy", "histogram", "information_criterion",
+    "kl_divergence", "mean", "mean_add", "mean_center", "meanvar", "minmax",
+    "stddev", "sum_", "vars_", "weighted_mean",
+]

@@ -1,0 +1,233 @@
+"""ML evaluation metrics (reference cpp/include/raft/stats/).
+
+Clustering-comparison metrics are all derived from one contingency matrix,
+computed as a one-hot matmul so the scatter runs on the MXU
+(stats/contingency_matrix.cuh builds it with atomics; here it is
+``onehot(true).T @ onehot(pred)``). Silhouette tiles the pairwise-distance
+matrix through cluster-indicator matmuls (stats/silhouette_score.cuh);
+trustworthiness ranks original-space neighbors of the embedding
+(stats/trustworthiness_score.cuh); neighborhood_recall reproduces the
+eps-relative distance-tie matching of stats/detail/neighborhood_recall.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops.linalg import gemm
+from raft_tpu.utils.tiling import ceil_div
+
+
+def accuracy(predictions, references) -> jax.Array:
+    """Fraction of exact matches (stats/accuracy.cuh)."""
+    p = jnp.asarray(predictions)
+    r = jnp.asarray(references)
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def contingency_matrix(
+    labels_true, labels_pred,
+    n_classes_true: Optional[int] = None,
+    n_classes_pred: Optional[int] = None,
+) -> jax.Array:
+    """(n_classes_true, n_classes_pred) int32 co-occurrence counts
+    (stats/contingency_matrix.cuh). Labels must be in [0, n_classes)."""
+    t = jnp.asarray(labels_true).ravel()
+    p = jnp.asarray(labels_pred).ravel()
+    nt = int(n_classes_true) if n_classes_true else int(jnp.max(t)) + 1
+    np_ = int(n_classes_pred) if n_classes_pred else int(jnp.max(p)) + 1
+    oh_t = (t[:, None] == jnp.arange(nt)[None, :]).astype(jnp.float32)
+    oh_p = (p[:, None] == jnp.arange(np_)[None, :]).astype(jnp.float32)
+    return gemm(oh_t, oh_p, transpose_a=True).astype(jnp.int32)
+
+
+def rand_index(labels_true, labels_pred) -> jax.Array:
+    """Rand index: fraction of concordant pairs (stats/rand_index.cuh)."""
+    c = contingency_matrix(labels_true, labels_pred).astype(jnp.float32)
+    n = jnp.sum(c)
+    sum_sq = jnp.sum(c * c)
+    sum_rows = jnp.sum(jnp.sum(c, axis=1) ** 2)
+    sum_cols = jnp.sum(jnp.sum(c, axis=0) ** 2)
+    # pairs: a = agreements-in-both, b = disagreements-in-both
+    a = (sum_sq - n) / 2.0
+    b = (n * n + sum_sq - sum_rows - sum_cols) / 2.0
+    total = n * (n - 1.0) / 2.0
+    return ((a + b) / total).astype(jnp.float32)
+
+
+def adjusted_rand_index(labels_true, labels_pred) -> jax.Array:
+    """Chance-adjusted Rand index (stats/adjusted_rand_index.cuh)."""
+    c = contingency_matrix(labels_true, labels_pred).astype(jnp.float32)
+    n = jnp.sum(c)
+
+    def comb2(x):
+        return x * (x - 1.0) / 2.0
+
+    sum_comb = jnp.sum(comb2(c))
+    sum_a = jnp.sum(comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(comb2(jnp.sum(c, axis=0)))
+    expected = sum_a * sum_b / comb2(n)
+    max_index = (sum_a + sum_b) / 2.0
+    denom = max_index - expected
+    return jnp.where(
+        denom == 0, 1.0, (sum_comb - expected) / denom
+    ).astype(jnp.float32)
+
+
+def mutual_info_score(labels_true, labels_pred) -> jax.Array:
+    """Mutual information (nats) between two labelings
+    (stats/mutual_info_score.cuh)."""
+    c = contingency_matrix(labels_true, labels_pred).astype(jnp.float32)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    terms = jnp.where(pij > 0, pij * jnp.log(pij / (pi * pj)), 0.0)
+    return jnp.sum(terms).astype(jnp.float32)
+
+
+def _cluster_entropy(counts) -> jax.Array:
+    n = jnp.sum(counts)
+    p = counts / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def homogeneity_score(labels_true, labels_pred) -> jax.Array:
+    """1 - H(C|K)/H(C) (stats/homogeneity_score.cuh)."""
+    c = contingency_matrix(labels_true, labels_pred).astype(jnp.float32)
+    h_c = _cluster_entropy(jnp.sum(c, axis=1))
+    mi = mutual_info_score(labels_true, labels_pred)
+    return jnp.where(h_c == 0, 1.0, mi / h_c).astype(jnp.float32)
+
+
+def completeness_score(labels_true, labels_pred) -> jax.Array:
+    """1 - H(K|C)/H(K) (stats/completeness_score.cuh)."""
+    return homogeneity_score(labels_pred, labels_true)
+
+
+def v_measure(labels_true, labels_pred, beta: float = 1.0) -> jax.Array:
+    """Weighted harmonic mean of homogeneity and completeness
+    (stats/v_measure.cuh)."""
+    h = homogeneity_score(labels_true, labels_pred)
+    c = completeness_score(labels_true, labels_pred)
+    denom = beta * h + c
+    return jnp.where(denom == 0, 0.0, (1 + beta) * h * c / denom)
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """Coefficient of determination (stats/r2_score.cuh)."""
+    y = jnp.asarray(y, jnp.float32)
+    y_hat = jnp.asarray(y_hat, jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, references):
+    """(mean_abs_error, mean_squared_error, median_abs_error)
+    (stats/regression_metrics.cuh)."""
+    p = jnp.asarray(predictions, jnp.float32)
+    r = jnp.asarray(references, jnp.float32)
+    err = p - r
+    return (
+        jnp.mean(jnp.abs(err)),
+        jnp.mean(err * err),
+        jnp.median(jnp.abs(err)),
+    )
+
+
+def silhouette_score(
+    x, labels, n_classes: int, metric: str = "sqeuclidean",
+    tile_rows: int = 2048,
+) -> jax.Array:
+    """Mean silhouette coefficient (stats/silhouette_score.cuh).
+
+    Tiled: for each row block, pairwise distances to the full dataset are
+    reduced against the cluster one-hot matrix (one matmul) into per-cluster
+    distance sums; a = own-cluster mean (self excluded), b = best
+    other-cluster mean, s = (b - a) / max(a, b). Singleton clusters score 0
+    (sklearn/reference convention).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    lab = jnp.asarray(labels).ravel()
+    n = x.shape[0]
+    onehot = (lab[:, None] == jnp.arange(n_classes)[None, :]).astype(jnp.float32)
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+
+    scores = []
+    for start in range(0, n, tile_rows):
+        xb = x[start : start + tile_rows]
+        lb = lab[start : start + tile_rows]
+        d = dist_mod.pairwise_distance(xb, x, metric=metric)  # (b, n)
+        csum = gemm(d, onehot)  # (b, k): per-cluster distance sums
+        own = counts[lb]  # (b,)
+        a = csum[jnp.arange(xb.shape[0]), lb] / jnp.maximum(own - 1, 1)
+        other = jnp.where(
+            (jnp.arange(n_classes)[None, :] == lb[:, None]) | (counts[None, :] == 0),
+            jnp.inf,
+            csum / jnp.maximum(counts[None, :], 1),
+        )
+        b = jnp.min(other, axis=1)
+        s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+        scores.append(s)
+    return jnp.mean(jnp.concatenate(scores))
+
+
+def trustworthiness_score(
+    x, x_embedded, n_neighbors: int, metric: str = "sqeuclidean",
+    batch_size: int = 512,
+) -> jax.Array:
+    """How much the embedding preserves local structure
+    (stats/trustworthiness_score.cuh): 1 - 2/(n*k*(2n-3k-1)) *
+    sum over embedded-kNN intruders of (rank_in_original_space - k)."""
+    x = jnp.asarray(x, jnp.float32)
+    e = jnp.asarray(x_embedded, jnp.float32)
+    n = x.shape[0]
+    k = int(n_neighbors)
+    penalty = jnp.float32(0.0)
+    for start in range(0, n, batch_size):
+        xb = x[start : start + batch_size]
+        eb = e[start : start + batch_size]
+        b = xb.shape[0]
+        rows = jnp.arange(b)
+        d_orig = dist_mod.pairwise_distance(xb, x, metric=metric)
+        d_orig = d_orig.at[rows, start + rows].set(jnp.inf)  # exclude self
+        # rank of every point in original space (0 = nearest)
+        order = jnp.argsort(d_orig, axis=1)
+        ranks = jnp.zeros_like(order).at[rows[:, None], order].set(
+            jnp.arange(n, dtype=order.dtype)[None, :]
+        )
+        d_emb = dist_mod.pairwise_distance(eb, e, metric=metric)
+        d_emb = d_emb.at[rows, start + rows].set(jnp.inf)
+        _, knn_emb = jax.lax.top_k(-d_emb, k)
+        r = ranks[rows[:, None], knn_emb]  # original ranks of embedded kNN
+        penalty = penalty + jnp.sum(jnp.maximum(r - k + 1, 0).astype(jnp.float32))
+    return 1.0 - penalty * (2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0)))
+
+
+def neighborhood_recall(
+    indices, ref_indices,
+    distances=None, ref_distances=None,
+    eps: float = 0.001,
+) -> jax.Array:
+    """Recall of ANN results vs ground truth with eps-relative distance-tie
+    matching (stats/detail/neighborhood_recall.cuh): a column matches if its
+    id appears in the reference row, or (when distances are given) some
+    reference distance is within relative eps."""
+    idx = jnp.asarray(indices)
+    ref = jnp.asarray(ref_indices)
+    if idx.shape[0] != ref.shape[0]:
+        raise ValueError("indices and ref_indices must have the same row count")
+    match = jnp.any(idx[:, :, None] == ref[:, None, :], axis=2)
+    if distances is not None:
+        d = jnp.asarray(distances)[:, :, None]
+        rd = jnp.asarray(ref_distances)[:, None, :]
+        diff = jnp.abs(d - rd)
+        m = jnp.maximum(jnp.abs(d), jnp.abs(rd))
+        ratio = jnp.where(diff > eps, diff / jnp.maximum(m, 1e-30), diff)
+        match = match | jnp.any(ratio <= eps, axis=2)
+    return jnp.mean(match.astype(jnp.float32))

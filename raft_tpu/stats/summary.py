@@ -1,0 +1,169 @@
+"""Summary statistics (reference cpp/include/raft/stats/).
+
+On TPU every reduction here is a single XLA-fused jnp expression; the design
+work is (a) matching the reference's semantics exactly (sample vs population
+variance, rowMajor axis conventions, weighted means) and (b) keeping the
+key'd / masked variants matmul-shaped so they run on the MXU.
+
+Reference headers: mean.cuh, sum.cuh, stddev.cuh, meanvar.cuh, mean_center.cuh,
+cov.cuh, minmax.cuh, histogram.cuh, weighted_mean.cuh, dispersion.cuh,
+entropy.cuh, kl_divergence.cuh, information_criterion.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.linalg import gemm
+
+
+def sum_(x, axis: int = 0) -> jax.Array:
+    """Column (axis=0) / row (axis=1) sums (stats/sum.cuh)."""
+    return jnp.sum(jnp.asarray(x), axis=axis)
+
+
+def mean(x, axis: int = 0) -> jax.Array:
+    """Column/row means (stats/mean.cuh)."""
+    return jnp.mean(jnp.asarray(x), axis=axis)
+
+
+def mean_center(x, mu=None, axis: int = 0) -> jax.Array:
+    """Subtract per-column (axis=0) / per-row (axis=1) means
+    (stats/mean_center.cuh)."""
+    x = jnp.asarray(x)
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    return x - jnp.expand_dims(mu, axis)
+
+
+def mean_add(x, mu, axis: int = 0) -> jax.Array:
+    """Inverse of :func:`mean_center` (stats/mean_center.cuh meanAdd)."""
+    return jnp.asarray(x) + jnp.expand_dims(jnp.asarray(mu), axis)
+
+
+def vars_(x, mu=None, sample: bool = True, axis: int = 0) -> jax.Array:
+    """Per-column/row variance; ``sample`` selects the n-1 denominator
+    (stats/stddev.cuh vars)."""
+    x = jnp.asarray(x)
+    n = x.shape[axis]
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    d = x - jnp.expand_dims(mu, axis)
+    denom = max(n - 1, 1) if sample else n
+    return jnp.sum(d * d, axis=axis) / denom
+
+
+def stddev(x, mu=None, sample: bool = True, axis: int = 0) -> jax.Array:
+    """Per-column/row standard deviation (stats/stddev.cuh)."""
+    return jnp.sqrt(vars_(x, mu, sample, axis))
+
+
+def meanvar(x, sample: bool = True, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Mean and variance in one pass (stats/meanvar.cuh)."""
+    x = jnp.asarray(x)
+    mu = jnp.mean(x, axis=axis)
+    return mu, vars_(x, mu, sample, axis)
+
+
+def cov(x, mu=None, sample: bool = True, stable: bool = True) -> jax.Array:
+    """Covariance matrix of row-sample data ``(n, d) -> (d, d)``
+    (stats/cov.cuh). ``stable`` mean-centers first (the reference's non-stable
+    path uses E[xy]-E[x]E[y]); the gemm accumulates in fp32 on the MXU."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    denom = max(n - 1, 1) if sample else n
+    if mu is None:
+        mu = jnp.mean(x, axis=0)
+    if stable:
+        xc = x - mu[None, :]
+        return gemm(xc, xc, transpose_a=True) / denom
+    exy = gemm(x, x, transpose_a=True) / denom
+    return exy - jnp.outer(mu, mu) * (n / denom)
+
+
+def minmax(x, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Per-column/row (min, max) (stats/minmax.cuh)."""
+    x = jnp.asarray(x)
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def histogram(x, n_bins: int, lower: float, upper: float) -> jax.Array:
+    """Per-column histograms over ``(n, d)`` data -> ``(n_bins, d)`` int32
+    (stats/histogram.cuh). Fixed [lower, upper) range, equal-width bins,
+    out-of-range samples are clamped into the edge bins (the reference's
+    binner uses the same saturating convention). Computed as a one-hot
+    matmul so the MXU does the scatter."""
+    x = jnp.asarray(x)
+    if x.ndim == 1:
+        x = x[:, None]
+    if n_bins <= 0:
+        raise ValueError("n_bins must be positive")
+    width = (upper - lower) / n_bins
+    b = jnp.clip(((x - lower) / width).astype(jnp.int32), 0, n_bins - 1)
+    onehot = (b[:, :, None] == jnp.arange(n_bins)[None, None, :]).astype(jnp.int32)
+    return jnp.sum(onehot, axis=0).T  # (n_bins, d)
+
+
+def weighted_mean(x, weights, axis: int = 0) -> jax.Array:
+    """Weighted column (axis=0) / row (axis=1) means (stats/weighted_mean.cuh).
+    ``weights`` has length ``x.shape[axis]`` and is normalized by its sum."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(weights)
+    if w.shape != (x.shape[axis],):
+        raise ValueError(f"weights must be ({x.shape[axis]},), got {w.shape}")
+    wsum = jnp.sum(w)
+    return jnp.tensordot(w, x, axes=([0], [axis])) / wsum
+
+
+def dispersion(
+    centroids, cluster_sizes, global_centroid: Optional[jax.Array] = None
+) -> jax.Array:
+    """Cluster dispersion: sqrt(sum_i size_i * ||c_i - mu||^2) where mu is the
+    size-weighted global centroid (stats/detail/dispersion.cuh:133)."""
+    c = jnp.asarray(centroids, jnp.float32)
+    sizes = jnp.asarray(cluster_sizes)
+    n_points = jnp.sum(sizes)
+    mu = (
+        jnp.asarray(global_centroid)
+        if global_centroid is not None
+        else jnp.sum(c * sizes[:, None], axis=0) / jnp.maximum(n_points, 1)
+    )
+    d = c - mu[None, :]
+    return jnp.sqrt(jnp.sum(jnp.sum(d * d, axis=1) * sizes))
+
+
+def entropy(labels, n_classes: int) -> jax.Array:
+    """Shannon entropy (nats) of an integer label distribution
+    (stats/entropy.cuh)."""
+    counts = jnp.bincount(jnp.asarray(labels).ravel(), length=n_classes)
+    p = counts / jnp.maximum(jnp.sum(counts), 1)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def kl_divergence(p, q) -> jax.Array:
+    """KL(p || q) = sum p * log(p/q) over matched modeled/candidate
+    distributions (stats/kl_divergence.cuh; terms with p<=0 contribute 0)."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(p / q), 0.0))
+
+
+def information_criterion(
+    log_likelihood, ic_type: str, n_params: int, n_samples: int
+) -> jax.Array:
+    """AIC / AICc / BIC from per-series log-likelihood
+    (stats/detail/batched/information_criterion.cuh: ic = base - 2*loglike)."""
+    ll = jnp.asarray(log_likelihood)
+    n, t = float(n_params), float(n_samples)
+    if ic_type == "aic":
+        base = 2.0 * n
+    elif ic_type == "aicc":
+        base = 2.0 * (n + (n * (n + 1.0)) / (t - n - 1.0))
+    elif ic_type == "bic":
+        base = float(jnp.log(t)) * n
+    else:
+        raise ValueError(f"unknown ic_type {ic_type!r} (aic|aicc|bic)")
+    return base - 2.0 * ll

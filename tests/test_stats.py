@@ -1,0 +1,213 @@
+"""stats module vs numpy/scipy/sklearn oracles (SURVEY.md §4 tier-2)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+class TestSummary:
+    def test_mean_stddev_vars(self, rng):
+        x = rng.standard_normal((200, 8)).astype(np.float32)
+        np.testing.assert_allclose(stats.mean(x), x.mean(axis=0), atol=1e-5)
+        np.testing.assert_allclose(
+            stats.stddev(x), x.std(axis=0, ddof=1), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            stats.vars_(x, sample=False), x.var(axis=0), rtol=1e-4
+        )
+        mu, v = stats.meanvar(x, sample=True)
+        np.testing.assert_allclose(v, x.var(axis=0, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(
+            stats.mean(x, axis=1), x.mean(axis=1), atol=1e-5
+        )
+
+    def test_mean_center_roundtrip(self, rng):
+        x = rng.standard_normal((50, 4)).astype(np.float32)
+        c = stats.mean_center(x)
+        np.testing.assert_allclose(np.asarray(c).mean(axis=0), 0, atol=1e-5)
+        back = stats.mean_add(c, x.mean(axis=0))
+        np.testing.assert_allclose(back, x, atol=1e-5)
+
+    def test_cov(self, rng):
+        x = rng.standard_normal((300, 6)).astype(np.float32)
+        want = np.cov(x, rowvar=False)
+        np.testing.assert_allclose(stats.cov(x), want, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            stats.cov(x, stable=False), want, rtol=1e-2, atol=1e-3
+        )
+
+    def test_minmax_histogram(self, rng):
+        x = rng.uniform(-1, 1, (500, 3)).astype(np.float32)
+        lo, hi = stats.minmax(x)
+        np.testing.assert_allclose(lo, x.min(axis=0))
+        np.testing.assert_allclose(hi, x.max(axis=0))
+        h = np.asarray(stats.histogram(x, 10, -1.0, 1.0))
+        assert h.shape == (10, 3)
+        assert h.sum(axis=0).tolist() == [500, 500, 500]
+        want = np.histogram(x[:, 0], bins=10, range=(-1, 1))[0]
+        np.testing.assert_array_equal(h[:, 0], want)
+
+    def test_weighted_mean(self, rng):
+        x = rng.standard_normal((40, 5)).astype(np.float32)
+        w = rng.uniform(0.1, 1.0, 40).astype(np.float32)
+        np.testing.assert_allclose(
+            stats.weighted_mean(x, w), np.average(x, axis=0, weights=w),
+            rtol=1e-4,
+        )
+
+    def test_dispersion(self, rng):
+        c = rng.standard_normal((4, 3)).astype(np.float32)
+        sizes = np.array([10, 20, 5, 15])
+        mu = (c * sizes[:, None]).sum(axis=0) / sizes.sum()
+        want = np.sqrt((((c - mu) ** 2).sum(axis=1) * sizes).sum())
+        np.testing.assert_allclose(stats.dispersion(c, sizes), want, rtol=1e-5)
+
+    def test_entropy_kl(self, rng):
+        from scipy.stats import entropy as sp_entropy
+
+        labels = rng.integers(0, 5, 1000)
+        counts = np.bincount(labels, minlength=5)
+        np.testing.assert_allclose(
+            stats.entropy(labels, 5), sp_entropy(counts / counts.sum()),
+            rtol=1e-5,
+        )
+        p = rng.uniform(0.1, 1, 8); p /= p.sum()
+        q = rng.uniform(0.1, 1, 8); q /= q.sum()
+        np.testing.assert_allclose(
+            stats.kl_divergence(p, q), sp_entropy(p, q), rtol=1e-4
+        )
+
+    def test_information_criterion(self):
+        ll = np.array([-120.0, -98.5])
+        np.testing.assert_allclose(
+            stats.information_criterion(ll, "aic", 3, 50), 2 * 3 - 2 * ll
+        )
+        np.testing.assert_allclose(
+            stats.information_criterion(ll, "bic", 3, 50),
+            np.log(50) * 3 - 2 * ll,
+            rtol=1e-6,
+        )
+
+
+class TestClusteringMetrics:
+    def test_contingency(self, rng):
+        t = rng.integers(0, 4, 300)
+        p = rng.integers(0, 5, 300)
+        c = np.asarray(stats.contingency_matrix(t, p, 4, 5))
+        from sklearn.metrics.cluster import contingency_matrix as sk_cm
+
+        np.testing.assert_array_equal(c, sk_cm(t, p))
+
+    @pytest.mark.parametrize("noise", [0.0, 0.3, 1.0])
+    def test_vs_sklearn(self, rng, noise):
+        import sklearn.metrics as skm
+
+        n = 400
+        t = rng.integers(0, 5, n)
+        p = np.where(rng.uniform(size=n) < noise, rng.integers(0, 5, n), t)
+        np.testing.assert_allclose(
+            stats.adjusted_rand_index(t, p), skm.adjusted_rand_score(t, p),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            stats.mutual_info_score(t, p), skm.mutual_info_score(t, p),
+            atol=1e-5,
+        )
+        h, c, v = (
+            stats.homogeneity_score(t, p),
+            stats.completeness_score(t, p),
+            stats.v_measure(t, p),
+        )
+        hs, cs, vs = skm.homogeneity_completeness_v_measure(t, p)
+        np.testing.assert_allclose([h, c, v], [hs, cs, vs], atol=1e-5)
+
+    def test_rand_index(self, rng):
+        # oracle: pair-counting definition
+        t = rng.integers(0, 3, 60)
+        p = rng.integers(0, 4, 60)
+        same_t = t[:, None] == t[None, :]
+        same_p = p[:, None] == p[None, :]
+        iu = np.triu_indices(60, 1)
+        want = np.mean(same_t[iu] == same_p[iu])
+        np.testing.assert_allclose(stats.rand_index(t, p), want, atol=1e-5)
+
+    def test_silhouette(self, rng):
+        import sklearn.metrics as skm
+
+        x = np.concatenate(
+            [rng.normal(loc=c, scale=0.4, size=(80, 6)) for c in (0, 4, 9)]
+        ).astype(np.float32)
+        lab = np.repeat([0, 1, 2], 80)
+        got = float(stats.silhouette_score(x, lab, 3, metric="euclidean"))
+        want = skm.silhouette_score(x, lab, metric="euclidean")
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_trustworthiness(self, rng):
+        import sklearn.manifold as skman
+
+        x = rng.standard_normal((150, 10)).astype(np.float32)
+        e = x[:, :2] + 0.01 * rng.standard_normal((150, 2)).astype(np.float32)
+        got = float(stats.trustworthiness_score(x, e, 5, metric="euclidean"))
+        want = skman.trustworthiness(x, e, n_neighbors=5)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+        # identity embedding is perfectly trustworthy
+        assert float(stats.trustworthiness_score(x, x, 5)) >= 0.999
+
+
+class TestRegressionMetrics:
+    def test_r2_and_errors(self, rng):
+        import sklearn.metrics as skm
+
+        y = rng.standard_normal(200).astype(np.float32)
+        yh = y + 0.1 * rng.standard_normal(200).astype(np.float32)
+        np.testing.assert_allclose(
+            stats.r2_score(y, yh), skm.r2_score(y, yh), atol=1e-4
+        )
+        mae, mse, medae = stats.regression_metrics(yh, y)
+        np.testing.assert_allclose(mae, skm.mean_absolute_error(y, yh), atol=1e-5)
+        np.testing.assert_allclose(mse, skm.mean_squared_error(y, yh), atol=1e-5)
+        np.testing.assert_allclose(
+            medae, skm.median_absolute_error(y, yh), atol=1e-5
+        )
+
+    def test_accuracy(self, rng):
+        p = rng.integers(0, 2, 100)
+        r = rng.integers(0, 2, 100)
+        np.testing.assert_allclose(stats.accuracy(p, r), np.mean(p == r))
+
+
+class TestNeighborhoodRecall:
+    def test_exact_match(self):
+        ref = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
+        got = np.array([[0, 2, 9], [5, 4, 3]], np.int32)
+        # row0: 2/3 match, row1: 3/3
+        np.testing.assert_allclose(
+            stats.neighborhood_recall(got, ref), (2 + 3) / 6
+        )
+
+    def test_distance_ties_count(self):
+        ref = np.array([[0, 1]], np.int32)
+        got = np.array([[0, 9]], np.int32)
+        rd = np.array([[1.0, 2.0]], np.float32)
+        # id 9 missing, but its distance ties ref id 1 within eps
+        d = np.array([[1.0, 2.0 + 1e-5]], np.float32)
+        r_no = float(stats.neighborhood_recall(got, ref))
+        r_tie = float(stats.neighborhood_recall(got, ref, d, rd, eps=1e-3))
+        assert r_no == 0.5 and r_tie == 1.0
+
+    def test_used_on_real_ann(self, rng):
+        from raft_tpu.neighbors import brute_force, ivf_flat
+
+        x = rng.standard_normal((2000, 16)).astype(np.float32)
+        q = rng.standard_normal((64, 16)).astype(np.float32)
+        _, ref = brute_force.search(brute_force.build(x), q, 10)
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatParams(n_lists=16, seed=1))
+        _, got = ivf_flat.search(idx, q, 10, n_probes=8)
+        r = float(stats.neighborhood_recall(np.asarray(got), np.asarray(ref)))
+        assert r >= 0.9
