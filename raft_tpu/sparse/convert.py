@@ -1,0 +1,43 @@
+"""COO <-> CSR <-> dense conversions (reference sparse/convert/).
+
+All conversions are jit-safe (static capacity in, static capacity out); the
+only host-side entry points are the ``*_from_dense`` constructors in
+:mod:`raft_tpu.sparse.types`, where nnz is data-dependent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort entries by (row, col), padding to the end (sparse/op/sort.h
+    analog). Stable, fully vectorized (one key sort on the VPU)."""
+    # two-key lexsort (row-major, padding last) — avoids a fused int64 key,
+    # which would need x64 mode for large shapes
+    prim = jnp.where(coo.valid, coo.rows, jnp.iinfo(jnp.int32).max)
+    order = jnp.lexsort((coo.cols, prim))
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """COO → CSR of the same capacity (sparse/convert/csr.cuh analog)."""
+    n, _ = coo.shape
+    s = coo_sort(coo)
+    counts = jnp.zeros(n, jnp.int32).at[jnp.clip(s.rows, 0, n - 1)].add(
+        s.valid.astype(jnp.int32)
+    )
+    indptr = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)])
+    return CSR(indptr, jnp.maximum(s.cols, 0), jnp.where(s.valid, s.vals, 0),
+               coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """CSR → COO of the same capacity (sparse/convert/coo.cuh analog)."""
+    rid = csr.row_ids()
+    valid = rid < csr.shape[0]
+    rows = jnp.where(valid, rid, -1).astype(jnp.int32)
+    return COO(rows, jnp.where(valid, csr.indices, 0),
+               jnp.where(valid, csr.data, 0), csr.shape)

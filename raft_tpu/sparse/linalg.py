@@ -1,0 +1,153 @@
+"""Sparse linear algebra (reference sparse/linalg/).
+
+TPU design — every kernel is a segment reduction keyed on the CSR row-expand
+(``CSR.row_ids``), lowered by XLA to vectorized scatter-adds, plus dense
+gathers from the operand. The reference's cuSPARSE SpMM/SpMV calls
+(sparse/linalg/spmm.hpp) become ``segment_sum`` over gathered dense rows —
+the multiply itself stays elementwise on the VPU; for matmul-dominant mixes
+callers can densify tiles instead (see sparse/distance.py, which deliberately
+routes through the MXU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.convert import coo_sort, coo_to_csr, csr_to_coo
+from raft_tpu.sparse.types import COO, CSR
+
+
+def spmv(csr: CSR, x) -> jax.Array:
+    """y = A @ x for CSR A and dense (m,) x (sparse/linalg/spmv wrapper)."""
+    return spmm(csr, x[:, None])[:, 0]
+
+
+def spmm(csr: CSR, B) -> jax.Array:
+    """C = A @ B for CSR A (n,m) and dense B (m,k) (sparse/linalg/spmm.hpp).
+
+    gather-rows + segment_sum formulation: padding entries key to segment n
+    (dropped by num_segments) and carry zero data.
+    """
+    B = jnp.asarray(B)
+    n, m = csr.shape
+    if B.shape[0] != m:
+        raise ValueError(f"B rows {B.shape[0]} != A cols {m}")
+    rid = csr.row_ids()
+    contrib = csr.data[:, None] * B[jnp.clip(csr.indices, 0, m - 1)]
+    return jax.ops.segment_sum(contrib, rid, num_segments=n)
+
+
+def transpose(coo: COO) -> COO:
+    """A^T as COO (sparse/linalg/transpose.h analog)."""
+    return coo_sort(COO(jnp.where(coo.valid, coo.cols, -1), jnp.maximum(coo.rows, 0),
+                        coo.vals, (coo.shape[1], coo.shape[0])))
+
+
+def add(a: COO, b: COO) -> COO:
+    """A + B as COO with capacity ``a.capacity + b.capacity``; duplicate
+    coordinates are kept (they sum in spmm/to_dense — scatter-add semantics,
+    sparse/linalg/add.cuh analog)."""
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    return coo_sort(COO(
+        jnp.concatenate([a.rows, b.rows]),
+        jnp.concatenate([a.cols, b.cols]),
+        jnp.concatenate([a.vals, b.vals]),
+        a.shape,
+    ))
+
+
+def symmetrize(coo: COO, mode: str = "max") -> COO:
+    """Make A symmetric over the union pattern (sparse/linalg/symmetrize.cuh).
+
+    mode 'max': S = elementwise max(A, A^T) — duplicate-free by construction:
+    both directed copies of each edge are emitted with the max weight, and
+    exact duplicates within the input are collapsed via a sorted-run mask.
+    mode 'sum' / 'mean': S = A + A^T (/2), duplicates kept (scatter-add).
+    """
+    at = transpose(coo)
+    if mode in ("sum", "mean"):
+        out = add(coo, at)
+        if mode == "mean":
+            out = COO(out.rows, out.cols, out.vals * 0.5, out.shape)
+        return out
+    if mode != "max":
+        raise ValueError(f"unknown mode {mode!r}")
+    s = coo_sort(COO(
+        jnp.concatenate([coo.rows, at.rows]),
+        jnp.concatenate([coo.cols, at.cols]),
+        jnp.concatenate([coo.vals, at.vals]),
+        coo.shape,
+    ))
+    # collapse equal-coordinate runs to a single max-valued entry
+    same_prev = (
+        (s.rows == jnp.roll(s.rows, 1)) & (s.cols == jnp.roll(s.cols, 1))
+    ).at[0].set(False)
+    # run max via parallel segmented scan: (m, start) o (m', start') =
+    # (start' ? m' : max(m, m'), start | start') — associative, O(log nnz)
+    # depth instead of a sequential lax.scan
+    def seg_op(a, b):
+        return (jnp.where(b[1], b[0], jnp.maximum(a[0], b[0])), a[1] | b[1])
+
+    run_max, _ = jax.lax.associative_scan(seg_op, (s.vals, ~same_prev))
+    is_last = jnp.concatenate([~same_prev[1:], jnp.array([True])])
+    keep = is_last & s.valid
+    rows = jnp.where(keep, s.rows, -1)
+    return coo_sort(COO(rows, jnp.maximum(s.cols, 0),
+                        jnp.where(keep, run_max, 0), s.shape))
+
+
+def degree(coo: COO) -> jax.Array:
+    """Per-row non-zero count (sparse/linalg/degree.cuh analog)."""
+    n = coo.shape[0]
+    return jnp.zeros(n, jnp.int32).at[jnp.clip(coo.rows, 0, n - 1)].add(
+        coo.valid.astype(jnp.int32)
+    )
+
+
+def row_norm(csr: CSR, norm: str = "l2") -> jax.Array:
+    """Per-row L1/L2/Linf norms (sparse/linalg/norm.cuh analog)."""
+    n = csr.shape[0]
+    rid = csr.row_ids()
+    if norm == "l1":
+        return jax.ops.segment_sum(jnp.abs(csr.data), rid, num_segments=n)
+    if norm == "l2":
+        return jax.ops.segment_sum(csr.data * csr.data, rid, num_segments=n)
+    if norm == "linf":
+        # empty segments reduce to -inf; an all-zero row's Linf norm is 0
+        return jnp.maximum(
+            jax.ops.segment_max(jnp.abs(csr.data), rid, num_segments=n), 0
+        )
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+def laplacian(coo: COO, normalized: bool = False) -> COO:
+    """Graph Laplacian L = D - A (or sym-normalized I - D^-1/2 A D^-1/2) as
+    COO with capacity nnz + n (sparse/linalg/laplacian analog, feeds
+    spectral/)."""
+    n, m = coo.shape
+    if n != m:
+        raise ValueError("laplacian needs a square adjacency")
+    deg_w = jnp.zeros(n, coo.vals.dtype).at[jnp.clip(coo.rows, 0, n - 1)].add(
+        jnp.where(coo.valid, coo.vals, 0)
+    )
+    diag_r = jnp.arange(n, dtype=jnp.int32)
+    if not normalized:
+        off = COO(coo.rows, coo.cols, -coo.vals, coo.shape)
+        dia = COO(diag_r, diag_r, deg_w, coo.shape)
+    else:
+        inv_sqrt = jnp.where(deg_w > 0, 1.0 / jnp.sqrt(jnp.maximum(deg_w, 1e-30)), 0.0)
+        r = jnp.clip(coo.rows, 0, n - 1)
+        c = jnp.clip(coo.cols, 0, n - 1)
+        off = COO(coo.rows, coo.cols, -coo.vals * inv_sqrt[r] * inv_sqrt[c],
+                  coo.shape)
+        dia = COO(diag_r, diag_r, jnp.where(deg_w > 0, 1.0, 0.0).astype(coo.vals.dtype),
+                  coo.shape)
+    return add(off, dia)
+
+
+__all__ = [
+    "spmv", "spmm", "transpose", "add", "symmetrize", "degree", "row_norm",
+    "laplacian", "coo_to_csr", "csr_to_coo",
+]

@@ -1,0 +1,79 @@
+"""Sparse nearest neighbors: brute-force kNN over CSR + kNN-graph builder
+(reference sparse/neighbors/brute_force.cuh, sparse/neighbors/knn_graph.cuh,
+sparse/neighbors/cross_component_nn.cuh).
+
+Search composes sparse/distance.py's densify-by-tiles MXU path with the
+shared ``select_k`` primitive — the same two-stage tile/merge structure as
+dense brute force (neighbors/detail/knn_brute_force.cuh:61 analog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.ops.select_k import select_k
+from raft_tpu.sparse import distance as sp_distance
+from raft_tpu.sparse.types import COO, CSR
+
+
+def brute_force_knn(
+    index: CSR,
+    queries: CSR,
+    k: int,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN of sparse queries against a sparse index
+    (sparse/neighbors/brute_force.cuh analog). Returns (dists, ids) (q, k)."""
+    res = res or current_resources()
+    if not 0 < k <= index.shape[0]:
+        raise ValueError(f"k={k} out of range for {index.shape[0]} index rows")
+    d = sp_distance.pairwise_distance(queries, index, metric, res=res)
+    return select_k(d, k)
+
+
+def knn_graph(
+    dataset,
+    k: int,
+    metric: str = "sqeuclidean",
+    res: Optional[Resources] = None,
+) -> COO:
+    """Dense dataset → symmetric kNN adjacency as COO
+    (sparse/neighbors/knn_graph.cuh analog; feeds MST/single-linkage).
+
+    Each row contributes its k nearest *other* rows (self-edge excluded, like
+    the reference); the directed edge list is then symmetrized with max-dedup
+    (sparse/linalg/symmetrize.cuh analog) so downstream Borůvka sees an
+    undirected, duplicate-free graph. Capacity = 2·n·k.
+    """
+    from raft_tpu.neighbors import brute_force
+
+    res = res or current_resources()
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    if not 0 < k < n:
+        raise ValueError(f"need 0 < k < n_rows, got k={k}, n={n}")
+    bf = brute_force.build(dataset, metric=metric)
+    dists, ids = brute_force.search(bf, dataset, k + 1, res=res)
+    # drop each row's self column (it may not be at position 0 under ties):
+    # mask self matches, then keep the k best of the remaining k+1
+    rows = jnp.arange(n, dtype=jnp.int32)
+    self_mask = ids == rows[:, None]
+    dists = jnp.where(self_mask, jnp.inf, dists)
+    dists, sub = jax.lax.top_k(-dists, k)
+    dists = -dists
+    ids = jnp.take_along_axis(ids, sub, axis=1)
+
+    src = jnp.repeat(rows, k)
+    dst = ids.reshape(-1)
+    w = dists.reshape(-1).astype(jnp.float32)
+    valid = dst >= 0
+    from raft_tpu.sparse.linalg import symmetrize
+
+    directed = COO(jnp.where(valid, src, -1), jnp.where(valid, dst, 0),
+                   jnp.where(valid, w, 0), (n, n))
+    return symmetrize(directed, mode="max")

@@ -1,0 +1,63 @@
+"""Sparse structural ops: sort, filter, slice, row ops (reference sparse/op/).
+
+All ops preserve static capacity — "removed" entries become padding
+(row -1 / zero data), never a reshape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.convert import coo_sort, coo_to_csr, csr_to_coo
+from raft_tpu.sparse.types import COO, CSR
+
+sort = coo_sort  # sparse/op/sort.h
+
+
+def filter_entries(coo: COO, keep_mask) -> COO:
+    """Mask out entries (sparse/op/filter.cuh analog): entries where
+    ``keep_mask`` is False become padding, then re-sort pushes them to the
+    end. Capacity unchanged."""
+    keep = jnp.asarray(keep_mask, bool) & coo.valid
+    return coo_sort(COO(jnp.where(keep, coo.rows, -1),
+                        jnp.where(keep, coo.cols, 0),
+                        jnp.where(keep, coo.vals, 0), coo.shape))
+
+
+def remove_scalar(coo: COO, scalar=0.0) -> COO:
+    """Drop entries equal to ``scalar`` (sparse/op/filter.cuh
+    remove_scalar analog)."""
+    return filter_entries(coo, coo.vals != scalar)
+
+
+def slice_rows(csr: CSR, start: int, stop: int) -> CSR:
+    """Row-range slice [start, stop) with the same capacity
+    (sparse/op/slice.h analog). Entry positions shift so the slice's data
+    occupies the first ``new_nnz`` slots."""
+    n, m = csr.shape
+    start, stop = int(start), int(stop)
+    if not 0 <= start <= stop <= n:
+        raise ValueError(f"bad slice [{start}, {stop}) for {n} rows")
+    lo, hi = csr.indptr[start], csr.indptr[stop]
+    pos = jnp.arange(csr.capacity, dtype=csr.indptr.dtype)
+    src = jnp.clip(pos + lo, 0, csr.capacity - 1)
+    in_slice = pos < (hi - lo)
+    indices = jnp.where(in_slice, csr.indices[src], 0)
+    data = jnp.where(in_slice, csr.data[src], 0)
+    indptr = jnp.clip(
+        jax.lax.dynamic_slice_in_dim(csr.indptr, start, stop - start + 1) - lo,
+        0, hi - lo,
+    ) if stop > start else jnp.zeros(1, csr.indptr.dtype)
+    return CSR(indptr, indices, data, (stop - start, m))
+
+
+def row_scale(csr: CSR, scales) -> CSR:
+    """Scale each row by ``scales[row]`` (sparse/op/row_op.cuh analog)."""
+    scales = jnp.asarray(scales)
+    rid = jnp.clip(csr.row_ids(), 0, csr.shape[0] - 1)
+    return CSR(csr.indptr, csr.indices, csr.data * scales[rid], csr.shape)
+
+
+__all__ = ["sort", "filter_entries", "remove_scalar", "slice_rows",
+           "row_scale", "coo_to_csr", "csr_to_coo"]

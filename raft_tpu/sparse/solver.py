@@ -1,0 +1,232 @@
+"""Sparse solvers: Borůvka MST and a Lanczos eigensolver
+(reference sparse/solver/mst_solver.cuh:40, sparse/solver/lanczos.cuh:68).
+
+MST — TPU design. The reference's MST is a Borůvka variant with per-vertex
+atomics for min-edge selection and a union-find over device memory. Atomics
+and pointer-chasing unions don't map to XLA, so every phase here is a
+vectorized reduction over static shapes:
+
+  * min outgoing edge per component  → ``segment_min`` keyed on the
+    component color of each edge's source endpoint (both directions of every
+    undirected edge are present, so one side suffices). The selection key is
+    the composite ``(weight, min(colors), max(colors), entry index)`` —
+    crucially identical for *both directions* of an undirected edge, which
+    makes the order globally consistent: a choice-graph cycle longer than 2
+    would need every edge on it to share the same key, hence the same
+    component pair, hence be a 2-cycle. So only mutual pairs need breaking
+    (the smaller color becomes the root and drops its edge);
+  * contraction → plain pointer jumping ``p ← p∘p`` on the now-cycle-free
+    parent array, then relabel every vertex color through it; repeat until
+    no component has an outgoing edge.
+
+Rounds are O(log n); each round is sorts/segment-reductions/gathers the VPU
+vectorizes. Output is a fixed (n-1)-slot edge buffer + a traced count
+(forests of disconnected graphs fill fewer slots; unused slots are -1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.sparse.linalg import spmv
+from raft_tpu.sparse.types import COO, CSR
+
+
+class MstResult(NamedTuple):
+    """MST/forest edges (sparse/solver/mst_solver.cuh Graph_COO analog)."""
+
+    src: jax.Array      # (n-1,) int32, -1 beyond n_edges
+    dst: jax.Array      # (n-1,) int32
+    weight: jax.Array   # (n-1,) float32, 0 beyond n_edges
+    n_edges: jax.Array  # scalar int32
+    color: jax.Array    # (n,) final component label per vertex
+
+
+def _pointer_jump(p: jax.Array) -> jax.Array:
+    """p ← p∘p to fixpoint (valid once the parent graph is a forest)."""
+
+    def cond(state):
+        p, changed = state
+        return changed
+
+    def body(state):
+        p, _ = state
+        p2 = p[p]
+        return p2, jnp.any(p2 != p)
+
+    p, _ = lax.while_loop(cond, body, (p, jnp.array(True)))
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _mst_impl(rows, cols, vals, valid, n: int):
+    E = rows.shape[0]
+    INF = jnp.inf
+    out_src = jnp.full(max(n - 1, 1), -1, jnp.int32)
+    out_dst = jnp.full(max(n - 1, 1), -1, jnp.int32)
+    out_w = jnp.zeros(max(n - 1, 1), jnp.float32)
+    color = jnp.arange(n, dtype=jnp.int32)
+    count = jnp.zeros((), jnp.int32)
+
+    def cond(state):
+        _, _, _, _, _, changed = state
+        return changed
+
+    def body(state):
+        color, out_src, out_dst, out_w, count, _ = state
+        cu = color[jnp.clip(rows, 0, n - 1)]
+        cv = color[jnp.clip(cols, 0, n - 1)]
+        live = valid & (cu != cv)
+
+        # min outgoing edge per component under the direction-symmetric key
+        # (w, min(cu,cv), max(cu,cv), idx) — lexicographic via cascaded
+        # segment_min passes
+        key = jnp.where(live, cu, n).astype(jnp.int32)
+        cmin = jnp.minimum(cu, cv)
+        cmax = jnp.maximum(cu, cv)
+
+        w_live = jnp.where(live, vals, INF)
+        minw = jax.ops.segment_min(w_live, key, num_segments=n + 1)[:n]
+        sel = live & (vals == minw[jnp.clip(cu, 0, n - 1)])
+        mcmin = jax.ops.segment_min(
+            jnp.where(sel, cmin, n), key, num_segments=n + 1)[:n]
+        sel &= cmin == mcmin[jnp.clip(cu, 0, n - 1)]
+        mcmax = jax.ops.segment_min(
+            jnp.where(sel, cmax, n), key, num_segments=n + 1)[:n]
+        sel &= cmax == mcmax[jnp.clip(cu, 0, n - 1)]
+        eidx = jax.ops.segment_min(
+            jnp.where(sel, jnp.arange(E, dtype=jnp.int32), E),
+            key, num_segments=n + 1,
+        )[:n]
+        has_edge = eidx < E
+        e = jnp.clip(eidx, 0, E - 1)
+        c_ids = jnp.arange(n, dtype=jnp.int32)
+        t = jnp.where(has_edge, cv[e], c_ids)
+
+        # break mutual pairs (the only possible cycles): smaller color roots
+        mutual = t[t] == c_ids
+        is_root = ~has_edge | (mutual & (c_ids < t))
+        p = jnp.where(is_root, c_ids, t)
+        p = _pointer_jump(p)
+        keep = has_edge & ~is_root
+
+        # append kept edges at positions [count, count + n_kept)
+        pos = count + jnp.cumsum(keep.astype(jnp.int32)) - 1
+        pos = jnp.where(keep, jnp.clip(pos, 0, out_src.shape[0] - 1),
+                        out_src.shape[0])  # OOB -> dropped by mode="drop"
+        out_src = out_src.at[pos].set(rows[e], mode="drop")
+        out_dst = out_dst.at[pos].set(cols[e], mode="drop")
+        out_w = out_w.at[pos].set(vals[e].astype(jnp.float32), mode="drop")
+        n_kept = jnp.sum(keep.astype(jnp.int32))
+
+        return p[color], out_src, out_dst, out_w, count + n_kept, n_kept > 0
+
+    color, out_src, out_dst, out_w, count, _ = lax.while_loop(
+        cond, body, (color, out_src, out_dst, out_w, count, jnp.array(True))
+    )
+    return out_src, out_dst, out_w, count, color
+
+
+def mst(graph: COO) -> MstResult:
+    """Minimum spanning tree/forest of a symmetric weighted COO graph
+    (sparse/solver/mst.cuh:59 analog — the single-linkage substrate).
+
+    ``graph`` must contain both directions of every undirected edge (as
+    :func:`raft_tpu.sparse.neighbors.knn_graph` and
+    :func:`raft_tpu.sparse.linalg.symmetrize` produce).
+    """
+    n, m = graph.shape
+    if n != m:
+        raise ValueError(f"graph must be square, got {graph.shape}")
+    if n < 2:
+        raise ValueError("graph needs at least 2 vertices")
+    src, dst, w, cnt, color = _mst_impl(
+        graph.rows, graph.cols, graph.vals, graph.valid, n
+    )
+    return MstResult(src, dst, w, cnt, color)
+
+
+def connected_components(graph: COO) -> jax.Array:
+    """Per-vertex component labels via the same contraction machinery
+    (sparse/neighbors/cross_component_nn.cuh's connectivity sub-primitive)."""
+    return mst(graph).color
+
+
+# ---------------------------------------------------------------------------
+# Lanczos
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("matvec", "n", "max_iters"))
+def _lanczos_impl(matvec, n: int, max_iters: int, v0):
+    m = max_iters
+
+    v0 = v0 / jnp.linalg.norm(v0)
+    V = jnp.zeros((m, n), jnp.float32).at[0].set(v0)
+
+    def step(carry, i):
+        V, beta_prev = carry
+        v = V[i]
+        w = matvec(v)
+        alpha = jnp.dot(w, v)
+        w = w - alpha * v - beta_prev * V[jnp.maximum(i - 1, 0)] * (i > 0)
+        # full reorthogonalization against all previous vectors (the
+        # reference re-orthogonalizes too, sparse/solver/detail/lanczos.cuh):
+        # rows past i are zero so the correction is a masked gemv pair
+        w = w - V.T @ (V @ w)
+        beta = jnp.linalg.norm(w)
+        v_next = jnp.where(beta > 1e-10, w / jnp.maximum(beta, 1e-30),
+                           jnp.zeros_like(w))
+        V = V.at[jnp.minimum(i + 1, m - 1)].set(
+            jnp.where(i + 1 < m, v_next, V[m - 1])
+        )
+        return (V, beta), (alpha, beta)
+
+    (V, _), (alphas, betas) = lax.scan(step, (V, jnp.zeros((), jnp.float32)),
+                                       jnp.arange(m))
+    return V, alphas, betas
+
+
+def lanczos_smallest(
+    a: Union[CSR, Callable],
+    n_components: int,
+    n: Optional[int] = None,
+    max_iters: int = 0,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Smallest eigenpairs of a symmetric operator
+    (sparse/solver/lanczos.cuh:68 analog, used by spectral/).
+
+    ``a``: a CSR matrix or a matvec callable (jit-traceable). Returns
+    ``(eigenvalues (k,), eigenvectors (n, k))``.
+    """
+    if isinstance(a, CSR):
+        if a.shape[0] != a.shape[1]:
+            raise ValueError("operator must be square")
+        n = a.shape[0]
+        csr = a
+
+        def matvec(v):
+            return spmv(csr, v)
+    else:
+        if n is None:
+            raise ValueError("n is required when `a` is a callable")
+        matvec = a
+    k = int(n_components)
+    if not 0 < k <= n:
+        raise ValueError(f"need 0 < n_components <= {n}")
+    m = int(max_iters) if max_iters else min(n, max(4 * k, 32))
+    m = min(m, n)
+
+    v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+    V, alphas, betas = _lanczos_impl(matvec, n, m, v0)
+
+    T = (jnp.diag(alphas) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1], -1))
+    evals, S = jnp.linalg.eigh(T)
+    vecs = V.T @ S[:, :k]
+    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
+    return evals[:k], vecs

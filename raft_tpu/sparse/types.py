@@ -1,0 +1,150 @@
+"""Sparse containers: COO and CSR with static-shape (padded) storage.
+
+Reference surface: the owning sparse matrix types
+(core/device_coo_matrix.hpp, core/device_csr_matrix.hpp, core/sparse_types.hpp).
+
+TPU design — static nnz with sentinel padding. The reference's containers own
+a runtime-sized nnz; under XLA every shape is static, so a container carries a
+*capacity* (the array length) and marks unused tail entries with row ``-1``
+(COO) / entries beyond ``indptr[-1]`` (CSR). All kernels treat padding as
+"contributes zero": padded ``vals`` are stored as 0 and padded indices clipped
+into range before gathers. This is the same padding-over-pointers trade every
+dense structure in this framework makes (see neighbors/_packing.py).
+
+Both containers are registered pytrees, so they jit/vmap/shard like arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class COO:
+    """Coordinate-format sparse matrix (core/device_coo_matrix.hpp analog).
+
+    ``rows``/``cols``/``vals`` are (capacity,) arrays; entries with
+    ``rows < 0`` are padding and must carry ``vals == 0``.
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def valid(self) -> jax.Array:
+        """(capacity,) bool mask of real (non-padding) entries."""
+        return self.rows >= 0
+
+    def nnz(self) -> jax.Array:
+        """Traced count of real entries."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    def to_dense(self) -> jax.Array:
+        """Densify; duplicate coordinates sum (scatter-add semantics)."""
+        n, m = self.shape
+        r = jnp.clip(self.rows, 0, n - 1)
+        c = jnp.clip(self.cols, 0, m - 1)
+        out = jnp.zeros((n, m), self.vals.dtype)
+        v = jnp.where(self.valid, self.vals, 0)
+        return out.at[r, c].add(v)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CSR:
+    """Compressed-sparse-row matrix (core/device_csr_matrix.hpp analog).
+
+    ``indptr`` is (n_rows+1,); ``indices``/``data`` are (capacity,) with the
+    real entries in the first ``indptr[-1]`` positions (padding after: data 0,
+    indices clipped in-range).
+    """
+
+    indptr: jax.Array
+    indices: jax.Array
+    data: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[0]
+
+    def nnz(self) -> jax.Array:
+        return self.indptr[-1]
+
+    def row_ids(self) -> jax.Array:
+        """(capacity,) row id per entry — the CSR expand primitive every
+        segment-reduction kernel keys on; padding entries get ``n_rows``
+        (one-past-the-end segment)."""
+        n = self.shape[0]
+        pos = jnp.arange(self.capacity, dtype=self.indptr.dtype)
+        rid = jnp.searchsorted(self.indptr, pos, side="right") - 1
+        return jnp.where(pos < self.indptr[-1], rid, n).astype(jnp.int32)
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    def to_dense(self) -> jax.Array:
+        n, m = self.shape
+        rid = jnp.clip(self.row_ids(), 0, n - 1)
+        cid = jnp.clip(self.indices, 0, m - 1)
+        pos = jnp.arange(self.capacity)
+        v = jnp.where(pos < self.indptr[-1], self.data, 0)
+        return jnp.zeros((n, m), self.data.dtype).at[rid, cid].add(v)
+
+
+def coo_from_dense(dense, capacity: int | None = None) -> COO:
+    """Extract non-zeros from a concrete dense matrix (host path — nnz is a
+    data-dependent shape, so this runs outside jit; sparse/convert/dense_to_*
+    analog)."""
+    d = np.asarray(dense)
+    r, c = np.nonzero(d)
+    v = d[r, c]
+    cap = int(capacity) if capacity is not None else max(1, len(r))
+    if len(r) > cap:
+        raise ValueError(f"capacity {cap} < nnz {len(r)}")
+    pad = cap - len(r)
+    rows = np.concatenate([r.astype(np.int32), np.full(pad, -1, np.int32)])
+    cols = np.concatenate([c.astype(np.int32), np.zeros(pad, np.int32)])
+    vals = np.concatenate([v, np.zeros(pad, v.dtype)])
+    return COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals), d.shape)
+
+
+def csr_from_dense(dense, capacity: int | None = None) -> CSR:
+    """Host-path dense → CSR (sparse/convert analog)."""
+    from raft_tpu.sparse.convert import coo_to_csr
+
+    return coo_to_csr(coo_from_dense(dense, capacity))
+
+
+def coo_from_parts(rows, cols, vals, shape: Tuple[int, int]) -> COO:
+    """Wrap raw coordinate arrays (validated) into a COO."""
+    rows = jnp.asarray(rows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    vals = jnp.asarray(vals)
+    if not rows.shape == cols.shape == vals.shape or rows.ndim != 1:
+        raise ValueError("rows/cols/vals must be equal-length 1-D arrays")
+    vals = jnp.where(rows >= 0, vals, 0)
+    return COO(rows, cols, vals, (int(shape[0]), int(shape[1])))

@@ -222,6 +222,8 @@ def neighborhood_recall(
     ref = jnp.asarray(ref_indices)
     if idx.shape[0] != ref.shape[0]:
         raise ValueError("indices and ref_indices must have the same row count")
+    if (distances is None) != (ref_distances is None):
+        raise ValueError("distances and ref_distances must be provided together")
     match = jnp.any(idx[:, :, None] == ref[:, None, :], axis=2)
     if distances is not None:
         d = jnp.asarray(distances)[:, :, None]

@@ -1,0 +1,248 @@
+"""Sparse tier vs scipy oracles (SURVEY.md §4 tier-2): containers, convert,
+op, linalg, distance, neighbors, MST, Lanczos."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+from scipy.spatial.distance import cdist
+
+from raft_tpu import sparse
+from raft_tpu.sparse import convert, distance, linalg, neighbors, op, solver
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+def random_sparse(rng, n, m, density=0.1, pad=0):
+    d = sp.random(n, m, density=density, random_state=rng, dtype=np.float32)
+    dense = d.toarray()
+    cap = d.nnz + pad if d.nnz else 1 + pad
+    return dense, sparse.coo_from_dense(dense, capacity=cap)
+
+
+class TestContainers:
+    def test_coo_dense_roundtrip(self, rng):
+        dense, coo = random_sparse(rng, 23, 17, pad=5)
+        np.testing.assert_allclose(coo.to_dense(), dense, atol=1e-6)
+        assert int(coo.nnz()) == np.count_nonzero(dense)
+
+    def test_csr_roundtrip_and_row_ids(self, rng):
+        dense, coo = random_sparse(rng, 23, 17, pad=3)
+        csr = convert.coo_to_csr(coo)
+        np.testing.assert_allclose(csr.to_dense(), dense, atol=1e-6)
+        want = sp.csr_matrix(dense)
+        np.testing.assert_array_equal(np.asarray(csr.indptr), want.indptr)
+        nnz = int(csr.nnz())
+        np.testing.assert_array_equal(np.asarray(csr.indices)[:nnz], want.indices)
+        # row_ids expand
+        rid = np.asarray(csr.row_ids())[:nnz]
+        want_rid = np.repeat(np.arange(23), np.diff(want.indptr))
+        np.testing.assert_array_equal(rid, want_rid)
+
+    def test_csr_coo_roundtrip(self, rng):
+        dense, coo = random_sparse(rng, 9, 31, pad=2)
+        back = convert.csr_to_coo(convert.coo_to_csr(coo))
+        np.testing.assert_allclose(back.to_dense(), dense, atol=1e-6)
+
+    def test_capacity_too_small_raises(self):
+        with pytest.raises(ValueError):
+            sparse.coo_from_dense(np.eye(4, dtype=np.float32), capacity=2)
+
+
+class TestOp:
+    def test_filter_and_remove_scalar(self, rng):
+        dense, coo = random_sparse(rng, 12, 12, pad=4)
+        keep = np.asarray(coo.vals) > 0
+        got = op.filter_entries(coo, keep).to_dense()
+        np.testing.assert_allclose(got, np.where(dense > 0, dense, 0), atol=1e-6)
+
+    def test_slice_rows(self, rng):
+        dense, coo = random_sparse(rng, 20, 7, pad=3)
+        csr = convert.coo_to_csr(coo)
+        sl = op.slice_rows(csr, 5, 13)
+        np.testing.assert_allclose(sl.to_dense(), dense[5:13], atol=1e-6)
+
+    def test_row_scale(self, rng):
+        dense, coo = random_sparse(rng, 10, 6, pad=1)
+        csr = convert.coo_to_csr(coo)
+        s = rng.standard_normal(10).astype(np.float32)
+        got = op.row_scale(csr, s).to_dense()
+        np.testing.assert_allclose(got, dense * s[:, None], rtol=1e-5, atol=1e-6)
+
+
+class TestLinalg:
+    def test_spmm_spmv(self, rng):
+        dense, coo = random_sparse(rng, 31, 19, pad=6)
+        csr = convert.coo_to_csr(coo)
+        B = rng.standard_normal((19, 5)).astype(np.float32)
+        np.testing.assert_allclose(linalg.spmm(csr, B), dense @ B, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            linalg.spmv(csr, B[:, 0]), dense @ B[:, 0], rtol=1e-4, atol=1e-5
+        )
+
+    def test_transpose_add_degree(self, rng):
+        dense, coo = random_sparse(rng, 13, 8, pad=2)
+        np.testing.assert_allclose(linalg.transpose(coo).to_dense(), dense.T, atol=1e-6)
+        dense2, coo2 = random_sparse(rng, 13, 8, pad=5)
+        np.testing.assert_allclose(
+            linalg.add(coo, coo2).to_dense(), dense + dense2, atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(linalg.degree(coo)), (dense != 0).sum(axis=1)
+        )
+
+    def test_row_norm(self, rng):
+        dense, coo = random_sparse(rng, 14, 9, pad=3)
+        csr = convert.coo_to_csr(coo)
+        np.testing.assert_allclose(
+            linalg.row_norm(csr, "l1"), np.abs(dense).sum(axis=1), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            linalg.row_norm(csr, "l2"), (dense ** 2).sum(axis=1), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            linalg.row_norm(csr, "linf"), np.abs(dense).max(axis=1), rtol=1e-4, atol=1e-6
+        )
+
+    def test_symmetrize_max(self, rng):
+        dense, coo = random_sparse(rng, 11, 11, density=0.2, pad=4)
+        got = linalg.symmetrize(coo, "max").to_dense()
+        np.testing.assert_allclose(got, np.maximum(dense, dense.T), atol=1e-6)
+
+    def test_symmetrize_sum(self, rng):
+        dense, coo = random_sparse(rng, 11, 11, density=0.2, pad=4)
+        got = linalg.symmetrize(coo, "sum").to_dense()
+        np.testing.assert_allclose(got, dense + dense.T, atol=1e-5)
+
+    def test_laplacian(self, rng):
+        # symmetric non-negative adjacency
+        a = sp.random(10, 10, density=0.3, random_state=rng, dtype=np.float32)
+        dense = np.abs(a.toarray())
+        dense = np.maximum(dense, dense.T)
+        np.fill_diagonal(dense, 0)
+        coo = sparse.coo_from_dense(dense, capacity=np.count_nonzero(dense) + 3)
+        want = csgraph.laplacian(dense)
+        np.testing.assert_allclose(linalg.laplacian(coo).to_dense(), want,
+                                   rtol=1e-4, atol=1e-5)
+        want_n = csgraph.laplacian(dense, normed=True)
+        np.testing.assert_allclose(
+            linalg.laplacian(coo, normalized=True).to_dense(), want_n,
+            rtol=1e-3, atol=1e-4,
+        )
+
+
+class TestDistance:
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product", "l1", "cosine"])
+    def test_vs_dense_cdist(self, rng, metric):
+        xd, x = random_sparse(rng, 18, 24, density=0.3, pad=2)
+        yd, y = random_sparse(rng, 12, 24, density=0.3, pad=1)
+        got = np.asarray(distance.pairwise_distance(
+            convert.coo_to_csr(x), convert.coo_to_csr(y), metric
+        ))
+        if metric == "sqeuclidean":
+            want = cdist(xd, yd, "sqeuclidean")
+        elif metric == "inner_product":
+            want = xd @ yd.T  # dense convention: raw dot, not negated
+        elif metric == "l1":
+            want = cdist(xd, yd, "cityblock")
+        else:
+            want = cdist(xd, yd, "cosine")
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestNeighbors:
+    def test_brute_force_knn(self, rng):
+        xd, x = random_sparse(rng, 40, 16, density=0.4, pad=2)
+        qd, q = random_sparse(rng, 7, 16, density=0.4, pad=2)
+        d, i = neighbors.brute_force_knn(
+            convert.coo_to_csr(x), convert.coo_to_csr(q), k=5
+        )
+        want = np.argsort(cdist(qd, xd, "sqeuclidean"), axis=1)[:, :5]
+        # compare sets per row (ties may reorder)
+        for r in range(7):
+            assert set(np.asarray(i)[r]) == set(want[r])
+
+    def test_knn_graph_is_symmetric(self, rng):
+        X = rng.standard_normal((30, 8)).astype(np.float32)
+        g = neighbors.knn_graph(X, k=4)
+        dense = np.asarray(g.to_dense())
+        np.testing.assert_allclose(dense, dense.T, atol=1e-6)
+        assert (np.count_nonzero(dense, axis=1) >= 4).all()
+
+
+class TestMst:
+    def _scipy_mst_weight(self, dense):
+        return csgraph.minimum_spanning_tree(dense).sum()
+
+    def test_total_weight_matches_scipy(self, rng):
+        n = 40
+        # connected weighted graph: kNN graph of random points
+        X = rng.standard_normal((n, 5)).astype(np.float32)
+        g = neighbors.knn_graph(X, k=6)
+        res = solver.mst(g)
+        assert int(res.n_edges) == n - 1, "knn graph should be connected here"
+        got = float(np.asarray(res.weight)[: int(res.n_edges)].sum())
+        want = self._scipy_mst_weight(np.asarray(g.to_dense()))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # every vertex ends in one component
+        assert len(np.unique(np.asarray(res.color))) == 1
+
+    def test_forest_on_disconnected_graph(self):
+        # two triangles, no bridge
+        rows = np.array([0, 1, 0, 2, 1, 2, 3, 4, 3, 5, 4, 5], np.int32)
+        cols = np.array([1, 0, 2, 0, 2, 1, 4, 3, 5, 3, 5, 4], np.int32)
+        vals = np.array([1, 1, 2, 2, 3, 3, 1, 1, 2, 2, 3, 3], np.float32)
+        g = sparse.coo_from_parts(rows, cols, vals, (6, 6))
+        res = solver.mst(g)
+        assert int(res.n_edges) == 4  # (3-1) per triangle
+        assert float(np.asarray(res.weight)[:4].sum()) == pytest.approx(6.0)
+        assert len(np.unique(np.asarray(res.color))) == 2
+
+    def test_tie_heavy_graph(self, rng):
+        # all weights equal: any spanning tree works; weight must be n-1
+        n = 16
+        dense = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+        coo = sparse.coo_from_dense(dense)
+        res = solver.mst(coo)
+        assert int(res.n_edges) == n - 1
+        np.testing.assert_allclose(
+            np.asarray(res.weight)[: n - 1].sum(), n - 1, rtol=1e-6
+        )
+        # validity: recorded edges form a spanning tree (acyclic+connected)
+        src = np.asarray(res.src)[: n - 1]
+        dst = np.asarray(res.dst)[: n - 1]
+        t = sp.coo_matrix((np.ones(n - 1), (src, dst)), shape=(n, n))
+        ncomp, _ = csgraph.connected_components(t, directed=False)
+        assert ncomp == 1
+
+    def test_connected_components(self):
+        rows = np.array([0, 1, 2, 3], np.int32)
+        cols = np.array([1, 0, 3, 2], np.int32)
+        vals = np.ones(4, np.float32)
+        g = sparse.coo_from_parts(rows, cols, vals, (5, 5))
+        labels = np.asarray(solver.connected_components(g))
+        assert labels[0] == labels[1]
+        assert labels[2] == labels[3]
+        assert len({labels[0], labels[2], labels[4]}) == 3
+
+
+class TestLanczos:
+    def test_smallest_eigenpairs_vs_numpy(self, rng):
+        n = 60
+        # well-separated symmetric PSD: graph laplacian of a connected graph
+        X = rng.standard_normal((n, 4)).astype(np.float32)
+        g = neighbors.knn_graph(X, k=5)
+        lap = linalg.laplacian(g)
+        csr = convert.coo_to_csr(lap)
+        evals, evecs = solver.lanczos_smallest(csr, 3, max_iters=60)
+        dense = np.asarray(lap.to_dense())
+        want = np.linalg.eigvalsh(dense)[:3]
+        np.testing.assert_allclose(np.asarray(evals), want, atol=1e-3)
+        # residual check: ||A v - lambda v|| small
+        for j in range(3):
+            v = np.asarray(evecs)[:, j]
+            r = dense @ v - float(np.asarray(evals)[j]) * v
+            assert np.linalg.norm(r) < 1e-2
