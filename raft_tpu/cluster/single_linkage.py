@@ -1,0 +1,182 @@
+"""Single-linkage agglomerative clustering (reference
+cluster/single_linkage.cuh:53, detail in cluster/detail/single_linkage.cuh
+and detail/agglomerative.h).
+
+Pipeline (same decomposition as the reference):
+  connectivity graph (full pairwise, or kNN with k = log2(n) + c)
+    → Borůvka MST (sparse/solver.py)
+    → [kNN mode] connect-components repair: a disconnected kNN graph gets
+      the minimum cross-component edges added (cross_component_nn.cuh
+      analog, computed as a component-masked distance argmin) and the MST
+      re-runs — at most O(log n) repair rounds
+    → flat labels: cut the (n_clusters - 1) heaviest MST edges, run
+      connected components over the remainder, relabel monotonically.
+
+TPU design notes: the dendrogram cut and labeling are fully on-device
+(sort/segment ops); the scipy-format linkage matrix (`to_scipy_linkage`) is
+a host-side O(n α(n)) union-find walk — same split as the reference, whose
+dendrogram relabeling also runs on host-resident data
+(detail/agglomerative.h build_dendrogram_host).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.label import make_monotonic
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.sparse.solver import MstResult, mst
+from raft_tpu.sparse.types import COO
+
+
+@dataclass
+class LinkageResult:
+    """linkage_output analog (cluster/single_linkage_types.hpp)."""
+
+    labels: jax.Array        # (n,) int32 in [0, n_clusters)
+    mst_src: jax.Array       # (n-1,) merge edges, sorted by height
+    mst_dst: jax.Array
+    mst_heights: jax.Array   # (n-1,) float32
+    n_clusters: int
+
+    def to_scipy_linkage(self) -> np.ndarray:
+        """Host-side conversion to a scipy-style (n-1, 4) linkage matrix Z
+        (detail/agglomerative.h build_dendrogram_host analog)."""
+        src = np.asarray(self.mst_src)
+        dst = np.asarray(self.mst_dst)
+        h = np.asarray(self.mst_heights)
+        n = src.shape[0] + 1
+        # roots in parent-space are scipy cluster ids (leaves 0..n-1,
+        # internal node for merge i = n+i)
+        parent = list(range(2 * n - 1))
+        size = [1] * (2 * n - 1)
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        Z = np.zeros((n - 1, 4))
+        for i in range(n - 1):
+            ra, rb = find(int(src[i])), find(int(dst[i]))
+            new = n + i
+            parent[ra] = new
+            parent[rb] = new
+            size[new] = size[ra] + size[rb]
+            Z[i] = (min(ra, rb), max(ra, rb), h[i], size[new])
+        return Z
+
+
+def _full_graph(X, metric: str, res: Resources) -> COO:
+    """All-pairs connectivity (LinkageDistance::PAIRWISE analog)."""
+    n = X.shape[0]
+    d = dist_mod.pairwise_distance(X, X, metric, res=res)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), n)
+    cols = jnp.tile(jnp.arange(n, dtype=jnp.int32), n)
+    off_diag = rows != cols
+    return COO(jnp.where(off_diag, rows, -1), jnp.where(off_diag, cols, 0),
+               jnp.where(off_diag, d.reshape(-1), 0), (n, n))
+
+
+def _cross_component_edges(X, color, metric: str, res: Resources) -> COO:
+    """Min outgoing edge per component to any other component
+    (sparse/neighbors/cross_component_nn.cuh analog): component-masked
+    pairwise argmin, one edge (both directions) per component."""
+    n = X.shape[0]
+    d = dist_mod.pairwise_distance(X, X, metric, res=res)
+    d = jnp.where(color[:, None] == color[None, :], jnp.inf, d)
+    # per point: nearest foreign point; per component: its best point pair
+    pt_best = jnp.argmin(d, axis=1).astype(jnp.int32)
+    pt_w = jnp.min(d, axis=1)
+    comp_w = jax.ops.segment_min(pt_w, color, num_segments=n)
+    at_min = pt_w == comp_w[color]
+    src = jax.ops.segment_min(
+        jnp.where(at_min, jnp.arange(n, dtype=jnp.int32), n), color,
+        num_segments=n,
+    )
+    has = src < n
+    srcc = jnp.clip(src, 0, n - 1)
+    dst = pt_best[srcc]
+    w = pt_w[srcc]
+    rows = jnp.concatenate([jnp.where(has, srcc, -1), jnp.where(has, dst, -1)])
+    cols = jnp.concatenate([jnp.where(has, dst, 0), jnp.where(has, srcc, 0)])
+    vals = jnp.concatenate([jnp.where(has, w, 0)] * 2).astype(jnp.float32)
+    return COO(rows, cols, vals, (n, n))
+
+
+def single_linkage(
+    X,
+    n_clusters: int,
+    metric: str = "sqeuclidean",
+    connectivity: str = "knn",
+    c: int = 15,
+    res: Optional[Resources] = None,
+) -> LinkageResult:
+    """Fit single-linkage hierarchical clustering and cut at ``n_clusters``
+    (cluster/single_linkage.cuh:53; ``c`` controls k = log2(n) + c for the
+    kNN connectivity mode, DEFAULT_CONST_C analog).
+    """
+    res = res or current_resources()
+    X = jnp.asarray(X).astype(jnp.float32)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-D, got {X.shape}")
+    n = X.shape[0]
+    if not 0 < n_clusters <= n:
+        raise ValueError(f"need 0 < n_clusters <= {n}, got {n_clusters}")
+    if connectivity not in ("knn", "pairwise"):
+        raise ValueError(f"connectivity must be 'knn'|'pairwise', got {connectivity!r}")
+
+    if connectivity == "pairwise":
+        graph = _full_graph(X, metric, res)
+        result = mst(graph)
+    else:
+        from raft_tpu.sparse.neighbors import knn_graph
+
+        k = min(n - 1, int(math.log2(n)) + c)
+        graph = knn_graph(X, k, metric=metric, res=res)
+        result = mst(graph)
+        # repair rounds: forest → add min cross-component edges, redo MST
+        for _ in range(32):
+            if int(result.n_edges) == n - 1:
+                break
+            extra = _cross_component_edges(X, result.color, metric, res)
+            graph = COO(
+                jnp.concatenate([graph.rows, extra.rows]),
+                jnp.concatenate([graph.cols, extra.cols]),
+                jnp.concatenate([graph.vals, extra.vals]),
+                (n, n),
+            )
+            result = mst(graph)
+
+    return _cut(result, n, int(n_clusters))
+
+
+def _cut(result: MstResult, n: int, n_clusters: int) -> LinkageResult:
+    """Sort merge edges by height, drop the heaviest so exactly
+    ``n_clusters`` components remain, label the rest."""
+    order = jnp.argsort(jnp.where(jnp.arange(result.src.shape[0]) < result.n_edges,
+                                  result.weight, jnp.inf))
+    src = result.src[order]
+    dst = result.dst[order]
+    h = result.weight[order]
+
+    n_comp = n - result.n_edges  # components in the (possibly forest) MST
+    n_drop = jnp.maximum(n_clusters - n_comp, 0)
+    keep = jnp.arange(src.shape[0]) < (result.n_edges - n_drop)
+
+    from raft_tpu.sparse.solver import connected_components
+
+    rows = jnp.concatenate([jnp.where(keep, src, -1), jnp.where(keep, dst, -1)])
+    cols = jnp.concatenate([jnp.where(keep, dst, 0), jnp.where(keep, src, 0)])
+    vals = jnp.concatenate([jnp.where(keep, h, 0)] * 2)
+    color = connected_components(COO(rows, cols, vals, (n, n)))
+    labels, _ = make_monotonic(color)
+    return LinkageResult(labels, src, dst, h, n_clusters)

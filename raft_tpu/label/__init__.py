@@ -1,0 +1,7 @@
+"""Label utilities (reference cpp/include/raft/label/): monotonic relabeling
+and label merging — sort/searchsorted formulations instead of the reference's
+device hash kernels (label/classlabels.cuh:91, label/merge_labels.cuh)."""
+
+from raft_tpu.label.classlabels import get_classes, make_monotonic, merge_labels
+
+__all__ = ["get_classes", "make_monotonic", "merge_labels"]

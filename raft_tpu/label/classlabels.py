@@ -1,0 +1,85 @@
+"""Monotonic label relabeling (label/classlabels.cuh:91 analog).
+
+TPU design: rank-by-sorted-unique. The reference builds a class array with a
+device scan + binary search; here a single sort + prefix count gives each
+distinct label its dense rank, and a searchsorted maps every element — all
+static-shape, jit-safe, with the unique count returned as a traced scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def make_monotonic(labels, ignore_value: int | None = None) -> Tuple[jax.Array, jax.Array]:
+    """Relabel arbitrary int labels to dense 0..n_unique-1 (order of first
+    sorted appearance). Returns ``(monotonic (n,), n_unique scalar)``.
+
+    Entries equal to ``ignore_value`` keep -1 and don't count as a class.
+    """
+    labels = jnp.asarray(labels)
+    if labels.ndim != 1:
+        raise ValueError(f"labels must be 1-D, got {labels.shape}")
+    if ignore_value is not None:
+        big = jnp.iinfo(labels.dtype).max
+        work = jnp.where(labels == ignore_value, big, labels)
+    else:
+        work = labels
+    s = jnp.sort(work)
+    is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+    if ignore_value is not None:
+        is_new &= s != jnp.iinfo(labels.dtype).max
+    ranks = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    out = ranks[jnp.searchsorted(s, work)]
+    n_unique = ranks[-1] + 1
+    if ignore_value is not None:
+        out = jnp.where(labels == ignore_value, -1, out)
+    return out.astype(jnp.int32), n_unique
+
+
+def get_classes(labels) -> Tuple[jax.Array, jax.Array]:
+    """Sorted distinct labels, padded with the max label value
+    (label/classlabels.cuh getUniquelabels analog). Returns
+    ``(classes (n,) padded, n_unique scalar)`` — static shape, so the padded
+    tail repeats the largest class."""
+    labels = jnp.asarray(labels)
+    s = jnp.sort(labels)
+    is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+    n_unique = jnp.sum(is_new.astype(jnp.int32))
+    # stable-compact the distinct values to the front
+    order = jnp.argsort(~is_new, stable=True)
+    return s[order], n_unique
+
+
+def merge_labels(labels_a, labels_b) -> jax.Array:
+    """Merge two labelings: elements sharing a label in either input end up
+    in the same output label (label/merge_labels.cuh analog — its use case
+    is stitching connected-components halves).
+
+    Implemented as connected components over the bipartite label graph via
+    min-pointer hops on a union array, O(log n) sweeps.
+    """
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    if a.shape != b.shape or a.ndim != 1:
+        raise ValueError("labels_a/labels_b must be equal-length 1-D arrays")
+    a, _ = make_monotonic(a)
+    b, _ = make_monotonic(b)
+    n = a.shape[0]
+    # representative per element: min element index reachable via shared
+    # a-labels or shared b-labels; iterate to fixpoint
+    def body(state):
+        rep, _ = state
+        min_a = jax.ops.segment_min(rep, a, num_segments=n)
+        min_b = jax.ops.segment_min(rep, b, num_segments=n)
+        new = jnp.minimum(rep, jnp.minimum(min_a[a], min_b[b]))
+        return new, jnp.any(new != rep)
+
+    rep, _ = jax.lax.while_loop(
+        lambda s: s[1], body, (jnp.arange(n, dtype=jnp.int32), jnp.array(True))
+    )
+    out, _ = make_monotonic(rep)
+    return out

@@ -623,42 +623,51 @@ def search(
         # part of the budget)
         per_slot = index.max_list_size * 4 + index.pq_dim * n_codes * 2
 
-        def _sizes(cap_mult):
-            # per-list query cap: cap_mult x the mean load, 16-aligned
-            q_tile = queries.shape[0]
-            qpl_cap = -(-max(16, (cap_mult * q_tile * p) // index.n_lists) // 16) * 16
-            while index.n_lists * qpl_cap * per_slot > res.workspace_bytes and q_tile > 64:
-                q_tile //= 2
-                qpl_cap = -(-max(16, (cap_mult * q_tile * p) // index.n_lists) // 16) * 16
-            return int(q_tile), int(qpl_cap)
+        def _align16(v):
+            return -(-max(16, int(v)) // 16) * 16
 
-        # drop-detect + escalate: start at 2x mean; a skewed probe
-        # distribution that still drops pairs doubles the cap (one retrace),
-        # and persistent drops fall back to the exact gather backend
-        # (ADVICE.md medium finding — drops silently degraded recall)
-        cap_mult, dropped = 2, 0
-        for attempt in range(3):
-            q_tile, qpl_cap = _sizes(cap_mult)
+        # initial sizing: cap = 2x the mean per-list load; the workspace
+        # constraint is on cap (the (n_lists, cap, ·) scores/LUT blocks),
+        # shrinking the query tile shrinks the cap a tile needs
+        q_tile = queries.shape[0]
+        qpl_cap = _align16(2 * q_tile * p // index.n_lists)
+        while index.n_lists * qpl_cap * per_slot > res.workspace_bytes and q_tile > 64:
+            q_tile //= 2
+            qpl_cap = _align16(2 * q_tile * p // index.n_lists)
+        qpl_cap = min(qpl_cap, _align16(q_tile))
+
+        # drop-detect + escalate (ADVICE.md medium finding — silent drops
+        # degraded recall). A query probes each list at most once, so
+        # cap >= q_tile provably cannot drop: the loop terminates with zero
+        # drops. The gather backend is NOT a fallback here — large-shape
+        # take_along_axis crashes the TPU runtime.
+        while True:
             vals, ids, dropped = _search_impl_pallas(
                 queries, index.centers, index.rotation, index.codebooks,
                 index.list_codes, index.list_ids, index.b_sum, filter,
-                int(k), n_probes, index.metric, q_tile, qpl_cap,
+                int(k), n_probes, index.metric, int(q_tile), int(qpl_cap),
                 select_algo, res.compute_dtype, jax.default_backend() != "tpu",
             )
             dropped = int(dropped)
             if dropped == 0:
                 break
-            cap_mult *= 2
+            if qpl_cap >= q_tile:
+                raise RuntimeError(
+                    f"ivf_pq pallas scan dropped {dropped} pairs at "
+                    f"qpl_cap={qpl_cap} >= q_tile={q_tile}; this cannot "
+                    "happen — please report"
+                )
+            qpl_cap = min(_align16(2 * qpl_cap), _align16(q_tile))
+            if index.n_lists * qpl_cap * per_slot > res.workspace_bytes:
+                _log.warning(
+                    "ivf_pq pallas scan exceeding workspace budget to avoid "
+                    "dropping pairs (qpl_cap=%d); consider a larger "
+                    "Resources.workspace_bytes", qpl_cap,
+                )
             _log.warning(
-                "ivf_pq pallas scan dropped %d probed pairs at qpl_cap=%d "
-                "(skewed probes); retrying with a larger cap", dropped, qpl_cap,
+                "ivf_pq pallas scan dropped %d probed pairs (skewed probes); "
+                "retrying with qpl_cap=%d (one retrace)", dropped, qpl_cap,
             )
-        if dropped > 0:
-            _log.warning(
-                "ivf_pq pallas scan still dropping %d pairs; falling back "
-                "to the gather backend for this call", dropped,
-            )
-            backend = "gather"
     if backend == "gather":
         # tile budget: the (qt, p, m, s) code gather dominates
         per_query = max(1, n_probes * index.max_list_size * (index.pq_dim * 5 + 8))

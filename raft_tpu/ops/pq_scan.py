@@ -27,9 +27,13 @@ cap) is plain jnp around the kernel: `group_probed_pairs`. Pairs beyond the
 cap are dropped (slot -1 → +inf outside); the cap defaults to 2× the mean
 load so drops only occur under heavily skewed probe distributions.
 
-Intended for narrow LUTs (pq_bits ≤ 6, i.e. n_codes ≤ 64, where a query's
-LUT row is ≤ 8 KB and pre-gathering per-list LUT blocks is cheap). For
-pq_bits=8 the jnp gather path in neighbors/ivf_pq.py remains the backend.
+VMEM budget: the one-hot block is (s_chunk·n_codes, m_block) bf16 — both
+factors are tiled (subspace chunks ≤ 2048 one-hot rows; the list dim in
+m_block ≤ 1024 columns) so the block stays ≤ 4 MB at any pq_bits/list size.
+The subspace-chunk axis is the *innermost* grid dim so the fp32 output
+block's accumulation revisits are consecutive (the Pallas TPU requirement
+for read-modify-write output blocks). This is the production TPU backend for
+all pq_bits 4..8; the jnp gather path stays as the oracle/CPU route.
 """
 
 from __future__ import annotations
@@ -73,18 +77,18 @@ def group_probed_pairs(probes, n_lists: int, qpl_cap: int) -> Tuple[jax.Array, j
 
 
 def _pq_scan_kernel(luts_ref, codes_ref, bsum_ref, out_ref, *, nc, s_chunk):
-    sc = pl.program_id(1)
+    sc = pl.program_id(2)
     ck = s_chunk * nc
-    m = codes_ref.shape[2]
-    codes = codes_ref[0].astype(jnp.int32)  # (s_chunk, m)
+    mb = codes_ref.shape[2]
+    codes = codes_ref[0].astype(jnp.int32)  # (s_chunk, mb)
     # one-hot transpose OH_T[(s', c), j] = (codes[s', j] == c), built in VMEM
-    rep = jnp.broadcast_to(codes[:, None, :], (s_chunk, nc, m)).reshape(ck, m)
-    cidx = lax.broadcasted_iota(jnp.int32, (ck, m), 0) % nc
+    rep = jnp.broadcast_to(codes[:, None, :], (s_chunk, nc, mb)).reshape(ck, mb)
+    cidx = lax.broadcasted_iota(jnp.int32, (ck, mb), 0) % nc
     oh = (rep == cidx).astype(jnp.bfloat16)
     lut = luts_ref[0]  # (qpl, ck) bf16
     part = lax.dot_general(
         lut, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (qpl, m)
+    )  # (qpl, mb)
 
     @pl.when(sc == 0)
     def _():
@@ -113,24 +117,33 @@ def pq_scan(luts_grouped, codes_t, b_sum, nc: int, interpret: bool = False) -> j
     _, s, m = codes_t.shape
     assert f == s * nc, (f, s, nc)
     assert m % 128 == 0, f"max_list_size {m} must be 128-aligned for the kernel"
-    # chunk subspaces so the in-VMEM one-hot block stays ~≤ 2048 wide
+    # chunk subspaces so the one-hot block stays ~≤ 2048 rows …
     s_chunk = max(1, min(s, 2048 // nc))
     while s % s_chunk:
         s_chunk -= 1
     n_sc = s // s_chunk
     ck = s_chunk * nc
+    # … and tile the list dim so it stays ≤ 1024 columns (the (ck, m_block)
+    # bf16 one-hot must fit VMEM: unblocked m of 7K+ entries at pq_bits=8 is
+    # ~30 MB and faults the chip)
+    m_block = min(m, 1024)
+    while m % m_block:
+        m_block -= 128
+    n_mb = m // m_block
 
-    grid = (L, n_sc)
+    # grid order (l, mb, sc): sc innermost keeps the revisited fp32 output
+    # block resident across its accumulation steps
+    grid = (L, n_mb, n_sc)
     return pl.pallas_call(
         functools.partial(_pq_scan_kernel, nc=nc, s_chunk=s_chunk),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, qpl, ck), lambda l, sc: (l, 0, sc), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_chunk, m), lambda l, sc: (l, sc, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, qpl, ck), lambda l, mb, sc: (l, 0, sc), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_chunk, m_block), lambda l, mb, sc: (l, sc, mb), memory_space=pltpu.VMEM),
             # (L, 1, m) so the block's last-two dims equal the array's
-            pl.BlockSpec((1, 1, m), lambda l, sc: (l, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, m_block), lambda l, mb, sc: (l, 0, mb), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, qpl, m), lambda l, sc: (l, 0, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, qpl, m_block), lambda l, mb, sc: (l, 0, mb), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((L, qpl, m), jnp.float32),
         interpret=interpret,
     )(luts_grouped, codes_t, b_sum.reshape(L, 1, m))

@@ -1,0 +1,115 @@
+"""single-linkage + label module vs scipy/sklearn oracles."""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+from sklearn.metrics import adjusted_rand_score
+
+from raft_tpu.cluster.single_linkage import single_linkage
+from raft_tpu.label import get_classes, make_monotonic, merge_labels
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(5)
+
+
+class TestLabel:
+    def test_make_monotonic(self):
+        labels = np.array([10, 3, 10, 99, 3, 7], np.int32)
+        out, k = make_monotonic(labels)
+        assert int(k) == 4
+        # same-input -> same-output; order by sorted value: 3->0, 7->1, 10->2, 99->3
+        np.testing.assert_array_equal(np.asarray(out), [2, 0, 2, 3, 0, 1])
+
+    def test_make_monotonic_ignore(self):
+        labels = np.array([5, -1, 5, 2], np.int32)
+        out, k = make_monotonic(labels, ignore_value=-1)
+        assert int(k) == 2
+        np.testing.assert_array_equal(np.asarray(out), [1, -1, 1, 0])
+
+    def test_get_classes(self):
+        labels = np.array([4, 1, 4, 9, 1], np.int32)
+        classes, k = get_classes(labels)
+        assert int(k) == 3
+        np.testing.assert_array_equal(np.asarray(classes)[:3], [1, 4, 9])
+
+    def test_merge_labels(self):
+        # a: {0,1},{2,3}; b: {1,2},{0},{3} -> all merged via chain 0-1-2-3
+        a = np.array([0, 0, 1, 1], np.int32)
+        b = np.array([0, 1, 1, 2], np.int32)
+        out = np.asarray(merge_labels(a, b))
+        assert len(np.unique(out)) == 1
+        # disjoint stays disjoint
+        a = np.array([0, 0, 1, 1], np.int32)
+        b = np.array([2, 2, 3, 3], np.int32)
+        out = np.asarray(merge_labels(a, b))
+        assert out[0] == out[1] and out[2] == out[3] and out[0] != out[2]
+
+
+class TestSingleLinkage:
+    def _blobs(self, rng, n=90, dim=3, k=3, spread=8.0):
+        centers = rng.uniform(-spread, spread, (k, dim))
+        X = np.concatenate(
+            [centers[i] + 0.3 * rng.standard_normal((n // k, dim)) for i in range(k)]
+        ).astype(np.float32)
+        y = np.repeat(np.arange(k), n // k)
+        return X, y
+
+    def test_pairwise_matches_scipy_exactly(self, rng):
+        X, _ = self._blobs(rng)
+        res = single_linkage(X, n_clusters=3, metric="euclidean",
+                             connectivity="pairwise")
+        Z = sch.linkage(X.astype(np.float64), method="single", metric="euclidean")
+        # merge heights of single linkage are unique to the data: must match
+        np.testing.assert_allclose(
+            np.asarray(res.mst_heights), Z[:, 2], rtol=5e-3, atol=1e-4
+        )
+        want = sch.fcluster(Z, t=3, criterion="maxclust")
+        assert adjusted_rand_score(want, np.asarray(res.labels)) == 1.0
+
+    def test_scipy_linkage_matrix_valid(self, rng):
+        X, _ = self._blobs(rng, n=40)
+        res = single_linkage(X, n_clusters=2, metric="euclidean",
+                             connectivity="pairwise")
+        Z = res.to_scipy_linkage()
+        want = sch.linkage(X.astype(np.float64), method="single", metric="euclidean")
+        np.testing.assert_allclose(Z[:, 2], want[:, 2], rtol=5e-3, atol=1e-4)
+        np.testing.assert_allclose(np.sort(Z[:, 3]), np.sort(want[:, 3]))
+        # well-formed: every cluster id < 2n-1, sizes monotone-ish
+        assert Z[:, :2].max() < 2 * X.shape[0] - 1
+        labels = sch.fcluster(Z, t=2, criterion="maxclust")
+        assert adjusted_rand_score(labels, np.asarray(res.labels)) == 1.0
+
+    def test_knn_mode_recovers_blobs(self, rng):
+        X, y = self._blobs(rng, n=120, dim=4, k=4)
+        res = single_linkage(X, n_clusters=4, connectivity="knn", c=5)
+        assert adjusted_rand_score(y, np.asarray(res.labels)) == 1.0
+        assert len(np.unique(np.asarray(res.labels))) == 4
+
+    def test_knn_mode_repairs_disconnected_graph(self, rng):
+        # two tight, far-apart blobs with tiny k: kNN graph is disconnected,
+        # the repair path must still produce a full dendrogram
+        a = rng.standard_normal((20, 2)).astype(np.float32) * 0.1
+        b = rng.standard_normal((20, 2)).astype(np.float32) * 0.1 + 100.0
+        X = np.concatenate([a, b])
+        res = single_linkage(X, n_clusters=2, connectivity="knn", c=0)
+        labels = np.asarray(res.labels)
+        want = np.repeat([0, 1], 20)
+        assert adjusted_rand_score(want, labels) == 1.0
+        # all n-1 merge edges present (graph was repaired to connected)
+        assert np.isfinite(np.asarray(res.mst_heights)).all()
+
+    def test_n_clusters_one_and_n(self, rng):
+        X, _ = self._blobs(rng, n=30)
+        r1 = single_linkage(X, n_clusters=1, connectivity="pairwise")
+        assert len(np.unique(np.asarray(r1.labels))) == 1
+        rn = single_linkage(X, n_clusters=30, connectivity="pairwise")
+        assert len(np.unique(np.asarray(rn.labels))) == 30
+
+    def test_validation(self, rng):
+        X, _ = self._blobs(rng, n=30)
+        with pytest.raises(ValueError):
+            single_linkage(X, n_clusters=0)
+        with pytest.raises(ValueError):
+            single_linkage(X, n_clusters=5, connectivity="bogus")
