@@ -49,9 +49,10 @@ def get_classes(labels) -> Tuple[jax.Array, jax.Array]:
     s = jnp.sort(labels)
     is_new = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
     n_unique = jnp.sum(is_new.astype(jnp.int32))
-    # stable-compact the distinct values to the front
+    # stable-compact the distinct values to the front, pad tail with the max
     order = jnp.argsort(~is_new, stable=True)
-    return s[order], n_unique
+    classes = jnp.where(jnp.arange(s.shape[0]) < n_unique, s[order], s[-1])
+    return classes, n_unique
 
 
 def merge_labels(labels_a, labels_b) -> jax.Array:

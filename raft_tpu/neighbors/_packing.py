@@ -38,6 +38,76 @@ def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int) -> Tuple
     return list_payload, list_ids
 
 
+def spill_to_cap(work, centers, labels, metric: str, cap: int,
+                 chunk: int = 65536):
+    """Cap per-list occupancy by spilling overflow rows to their
+    second-nearest center.
+
+    The reference bounds list growth through its list containers and the
+    balancing passes (cluster/detail/kmeans_balanced.cuh adjust_centers);
+    with padded dense blocks a single runaway cluster would inflate the
+    whole (n_lists, max_list_size, ·) allocation AND every scan's chunk
+    count, so a hard cap matters more here. Rows ranked >= cap within their
+    cluster move to their second-nearest center when that list has room
+    (pre-spill occupancy — a one-level, best-effort spill: a second list
+    that also overflows keeps the row, so the cap is soft). Recall impact is
+    bounded: a spilled row is found whenever its second-best list is probed,
+    and n_probes >> 1 in practice.
+    """
+    n = labels.shape[0]
+    n_lists = centers.shape[0]
+    counts = jnp.bincount(labels, length=n_lists)
+    if int(jnp.max(counts)) <= cap:
+        return labels
+
+    # rank of each row within its cluster (arrival order)
+    order = jnp.argsort(labels)
+    offsets = jnp.cumsum(counts) - counts
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - offsets[labels[order]].astype(jnp.int32)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+    over = rank >= cap
+
+    # second-nearest center, chunked so the (n, n_lists) block never lands
+    from raft_tpu.ops import distance as dist_mod
+
+    second = []
+    for s in range(0, n, chunk):
+        w = work[s:s + chunk]
+        if metric == "inner_product":
+            d = -dist_mod.matmul_t(w, centers, None, "highest")
+        else:
+            d = dist_mod._expanded_distance(w, centers, "sqeuclidean", None, "highest")
+        d = d.at[jnp.arange(w.shape[0]), labels[s:s + chunk]].set(jnp.inf)
+        second.append(jnp.argmin(d, axis=1).astype(jnp.int32))
+    labels2 = jnp.concatenate(second)
+
+    # admission control per target: spills ranked within each target list
+    # only fill its *remaining* capacity, so concurrent spills from several
+    # overflowing lists cannot pile one target above the cap
+    spill_target = jnp.where(over, labels2, n_lists)  # n_lists = not spilling
+    s_order = jnp.argsort(spill_target)
+    t_sorted = spill_target[s_order]
+    t_counts = jnp.bincount(t_sorted, length=n_lists + 1)
+    t_off = jnp.cumsum(t_counts) - t_counts
+    spill_rank_sorted = jnp.arange(n, dtype=jnp.int32) - t_off[t_sorted].astype(jnp.int32)
+    spill_rank = jnp.zeros(n, jnp.int32).at[s_order].set(spill_rank_sorted)
+    admitted = over & (counts[labels2] + spill_rank < cap)
+    return jnp.where(admitted, labels2, labels)
+
+
+def auto_group_size(n: int, n_lists: int) -> int:
+    """512 (== ragged_scan.MC, enables the ragged TPU backend) when the mean
+    list is big enough that the padding is noise; else 64 so small indexes
+    stay small (the dense scan path doesn't care about 512-alignment)."""
+    return 512 if n // max(n_lists, 1) >= 192 else 64
+
+
+def auto_list_cap(n: int, n_lists: int, group_size: int, factor: int = 4) -> int:
+    """Default cap: ``factor`` × mean occupancy, group-aligned."""
+    mean = -(-n // n_lists)
+    return max(group_size, -(-(factor * mean) // group_size) * group_size)
+
+
 def unpack_lists(list_payload, list_ids) -> Tuple:
     """Inverse of pack_lists: recover the valid (payload, ids, labels) rows
     (used by extend to repack with additions)."""

@@ -35,6 +35,7 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors._packing import pack_lists, unpack_lists
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
@@ -43,7 +44,6 @@ from raft_tpu.ops.select_k import select_k
 from raft_tpu.utils.tiling import map_row_tiles
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
-_GROUP_SIZE = 32  # kIndexGroupSize parity (ivf_flat_types.hpp:47)
 
 
 @dataclass(frozen=True)
@@ -54,6 +54,13 @@ class IvfFlatParams:
     metric: str = "sqeuclidean"
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
+    # per-list occupancy cap: -1 = auto (4× mean, group-aligned), 0 = off.
+    # Overflow rows spill to their second-nearest list (_packing.spill_to_cap)
+    list_size_cap: int = -1
+    # list padding granule: 0 = auto (512 == ragged_scan.MC when the mean
+    # list is large enough to amortize it — required for the ragged TPU
+    # backend — else 64, kIndexGroupSize-style, to keep small indexes small)
+    group_size: int = 0
     seed: int = 0
 
     def __post_init__(self):
@@ -137,10 +144,12 @@ class IvfFlatIndex:
 # ---------------------------------------------------------------------------
 
 
-def _pack_lists(dataset, row_ids, labels, n_lists: int):
+def _pack_lists(dataset, row_ids, labels, n_lists: int, group: int = 0):
     """Padded per-list blocks (the ivf_list fill, detail/ivf_flat_build.cuh
-    build_index; group-of-32 rounding per kIndexGroupSize)."""
-    return pack_lists(dataset, row_ids, labels, n_lists, _GROUP_SIZE)
+    build_index; group rounding per kIndexGroupSize / ragged_scan.MC)."""
+    if group <= 0:
+        group = _packing.auto_group_size(dataset.shape[0], n_lists)
+    return pack_lists(dataset, row_ids, labels, n_lists, group)
 
 
 def build(
@@ -177,8 +186,15 @@ def build(
     else:
         centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
 
+    group = params.group_size or _packing.auto_group_size(n, params.n_lists)
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(n, params.n_lists, group)
+    if cap:
+        labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
+
     row_ids = jnp.arange(n, dtype=jnp.int32)
-    list_data, list_ids = _pack_lists(work, row_ids, labels, params.n_lists)
+    list_data, list_ids = _pack_lists(work, row_ids, labels, params.n_lists, group)
     list_norms = None
     if params.metric in ("sqeuclidean", "euclidean"):
         list_norms = dist_mod.sqnorm(list_data, axis=2)
@@ -217,7 +233,8 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
     all_vecs = jnp.concatenate([old_vecs, new_vectors])
     all_ids = jnp.concatenate([old_ids, new_ids])
     all_labels = jnp.concatenate([old_labels, new_labels])
-    list_data, list_ids = _pack_lists(all_vecs, all_ids, all_labels, index.n_lists)
+    group = 512 if index.max_list_size % 512 == 0 else 64
+    list_data, list_ids = _pack_lists(all_vecs, all_ids, all_labels, index.n_lists, group)
     list_norms = None
     if index.metric in ("sqeuclidean", "euclidean"):
         list_norms = dist_mod.sqnorm(list_data, axis=2)
@@ -227,6 +244,64 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
 # ---------------------------------------------------------------------------
 # Search
 # ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "metric", "select_algo", "compute_dtype"),
+)
+def _coarse_probes(queries, centers, n_probes, metric, select_algo, compute_dtype):
+    """Stage 1 alone: each query's top-n_probes list ids (q, p) int32
+    (detail/ivf_flat_search-inl.cuh:130)."""
+    if metric in ("sqeuclidean", "euclidean"):
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
+    else:
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
+    _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
+    return probes
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def _ragged_bias(list_ids, list_norms, filter, mode: str):
+    """Per-entry additive bias for the ragged scan: ‖x‖² for L2, 0 for
+    ip/cosine; +inf at padding and filtered-out entries."""
+    valid = list_ids >= 0
+    if filter is not None:
+        valid = valid & filter.test(jnp.maximum(list_ids, 0))
+    base = list_norms if mode == "l2" else jnp.zeros_like(list_ids, jnp.float32)
+    return jnp.where(valid, base, jnp.inf).astype(jnp.float32)
+
+
+def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
+    """Ragged chunked scan path (ops/ragged_scan.py): work ∝ actual probed
+    entries — no per-list cap, no padded-length scan."""
+    from raft_tpu.ops.ragged_scan import ragged_search
+
+    probes = _coarse_probes(
+        queries, index.centers, n_probes, index.metric, select_algo,
+        res.compute_dtype,
+    )
+    l2 = index.metric in ("sqeuclidean", "euclidean")
+    bias = _ragged_bias(index.list_ids, index.list_norms, filter,
+                        "l2" if l2 else "ip")
+    vals, ids = ragged_search(
+        queries, probes, index.list_data, bias, index.list_ids,
+        index.list_sizes(), int(k), alpha=-2.0 if l2 else -1.0,
+        workspace_bytes=res.workspace_bytes,
+        interpret=jax.default_backend() != "tpu",
+    )
+    if l2:
+        vals = jnp.maximum(vals + dist_mod.sqnorm(queries)[:, None], 0.0)
+        if index.metric == "euclidean":
+            vals = jnp.sqrt(vals)
+        vals = jnp.where(ids >= 0, vals, jnp.inf)
+    elif index.metric == "cosine":
+        vals = jnp.where(ids >= 0, 1.0 + vals, jnp.inf)
+    else:  # inner_product: flip back to "larger is better" values
+        vals = jnp.where(ids >= 0, -vals, -jnp.inf)
+    return vals, ids
 
 
 @functools.partial(
@@ -294,6 +369,7 @@ def search(
     n_probes: int = 20,
     filter: Optional[Bitset] = None,
     select_algo: str = "exact",
+    backend: str = "auto",
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Probe ``n_probes`` lists per query and return the top-k
@@ -302,6 +378,10 @@ def search(
     Returns ``(distances (q,k), indices (q,k))``; indices are source row ids,
     ``-1`` where fewer than k valid candidates were found. ``filter`` excludes
     rows by id (bitset_filter analog, sample_filter.cuh:31).
+
+    ``backend``: "ragged" (chunk-table Pallas scan, work ∝ probed entries —
+    the TPU default), "gather" (jnp gather+einsum scan — the exact-fp32
+    oracle path and CPU default), or "auto".
     """
     res = res or current_resources()
     queries = jnp.asarray(queries).astype(jnp.float32)
@@ -314,6 +394,23 @@ def search(
         )
     if index.metric == "cosine":
         queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+
+    from raft_tpu.ops.ragged_scan import MC as _MC
+
+    aligned = index.max_list_size % _MC == 0
+    if backend == "auto":
+        backend = "ragged" if jax.default_backend() == "tpu" and aligned else "gather"
+    if backend not in ("ragged", "gather"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend == "ragged":
+        if not aligned:
+            raise ValueError(
+                f"ragged backend needs max_list_size % {_MC} == 0, got "
+                f"{index.max_list_size}; rebuild with group_size={_MC} "
+                "(or use backend='gather')"
+            )
+        return _search_ragged(index, queries, int(k), n_probes, filter,
+                              select_algo, res)
 
     # query-tile size: the (qt, p, m, d) gather is the big intermediate
     per_query = max(1, n_probes * index.max_list_size * (index.dim + 2) * 4)

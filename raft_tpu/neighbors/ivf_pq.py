@@ -42,6 +42,7 @@ from jax import lax
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import _packing
 from raft_tpu.core.logger import get_logger
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
@@ -52,9 +53,6 @@ from raft_tpu.ops.select_k import select_k
 _log = get_logger()
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
-# lists padded to 128 (vs the reference kIndexGroupSize 32): the Pallas scan
-# kernel needs a 128-aligned minor dimension
-_GROUP_SIZE = 128
 
 
 @dataclass(frozen=True)
@@ -68,6 +66,11 @@ class IvfPqParams:
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
     codebook_n_iters: int = 25
+    # per-list occupancy cap: -1 = auto (4× mean, group-aligned), 0 = off
+    # (_packing.spill_to_cap overflow policy)
+    list_size_cap: int = -1
+    # list padding granule: 0 = auto (_packing.auto_group_size)
+    group_size: int = 0
     seed: int = 0
 
     def __post_init__(self):
@@ -94,6 +97,10 @@ class IvfPqIndex:
     list_codes: jax.Array  # (n_lists, max_list_size, pq_dim) uint8
     list_ids: jax.Array  # (n_lists, max_list_size) int32
     b_sum: jax.Array  # (n_lists, max_list_size) fp32
+    # (n_lists, max_list_size, rot_dim) bf16 ragged-scan cache; None until
+    # the first ragged search (lazy: it costs ~2·rot_dim bytes/slot, wasted
+    # on CPU/gather deployments that never read it)
+    decoded: Optional[jax.Array]
     metric: str
     pq_bits: int
 
@@ -131,7 +138,7 @@ class IvfPqIndex:
     def tree_flatten(self):
         return (
             self.centers, self.rotation, self.codebooks,
-            self.list_codes, self.list_ids, self.b_sum,
+            self.list_codes, self.list_ids, self.b_sum, self.decoded,
         ), (self.metric, self.pq_bits)
 
     @classmethod
@@ -155,16 +162,18 @@ class IvfPqIndex:
 
     @classmethod
     def load(cls, path) -> "IvfPqIndex":
+        # `decoded` is derived data — recomputed here, never serialized
         meta, arrays = load_arrays(path)
         if meta.get("kind") != "ivf_pq":
             raise ValueError(f"not an ivf_pq index: {meta.get('kind')}")
+        centers = jnp.asarray(arrays["centers"])
+        rotation = jnp.asarray(arrays["rotation"])
+        codebooks = jnp.asarray(arrays["codebooks"])
+        list_codes = jnp.asarray(arrays["list_codes"])
+        list_ids = jnp.asarray(arrays["list_ids"])
         return cls(
-            jnp.asarray(arrays["centers"]),
-            jnp.asarray(arrays["rotation"]),
-            jnp.asarray(arrays["codebooks"]),
-            jnp.asarray(arrays["list_codes"]),
-            jnp.asarray(arrays["list_ids"]),
-            jnp.asarray(arrays["b_sum"]),
+            centers, rotation, codebooks, list_codes, list_ids,
+            jnp.asarray(arrays["b_sum"]), None,
             meta["metric"],
             int(meta["pq_bits"]),
         )
@@ -244,11 +253,13 @@ def _encode(resid_rot, codebooks, chunk: int = 8192):
     return out.reshape(-1, resid_rot.shape[1])[:n]
 
 
-def _pack_lists(codes, row_ids, labels, n_lists: int):
+def _pack_lists(codes, row_ids, labels, n_lists: int, group: int = 0):
     n, pq_dim = codes.shape
+    if group <= 0:
+        group = _packing.auto_group_size(n, n_lists)
     sizes = jnp.bincount(labels, length=n_lists)
     max_size = int(jnp.max(sizes))
-    max_size = max(_GROUP_SIZE, -(-max_size // _GROUP_SIZE) * _GROUP_SIZE)
+    max_size = max(group, -(-max_size // group) * group)
 
     order = jnp.argsort(labels)
     sorted_labels = labels[order]
@@ -317,17 +328,47 @@ def build(
         resid_cb, k_cb, n_codes, params.codebook_n_iters
     )
 
+    group = params.group_size or _packing.auto_group_size(n, params.n_lists)
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(n, params.n_lists, group)
+    if cap:
+        labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
+
     # --- encode + pack (ivf_pq_build.cuh:1319) -----------------------------
     resid_all = _pad_rot(work - centers[labels], rot_dim) @ rotation.T
     codes = _encode(resid_all.reshape(n, pq_dim, dsub), codebooks)
     row_ids = jnp.arange(n, dtype=jnp.int32)
-    list_codes, list_ids = _pack_lists(codes, row_ids, labels, params.n_lists)
+    list_codes, list_ids = _pack_lists(codes, row_ids, labels, params.n_lists, group)
 
     b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, params.metric)
     return IvfPqIndex(
-        centers, rotation, codebooks, list_codes, list_ids, b_sum,
+        centers, rotation, codebooks, list_codes, list_ids, b_sum, None,
         params.metric, params.pq_bits,
     )
+
+
+@jax.jit
+def _decode_lists(centers, rotation, codebooks, list_codes, list_ids):
+    """bf16 reconstruction x̂ = R·c_l + cb[codes] per entry, in rotated space
+    — the ragged-scan cache (module docstring: at pq_bits=8 the decoded
+    matmul is 64× less MXU work than the one-hot LUT scan for the same
+    scores; bf16 here is the fp8-LUT-compression analog,
+    detail/ivf_pq_fp_8bit.cuh)."""
+    n_lists, max_size, pq_dim = list_codes.shape
+    n_codes, dsub = codebooks.shape[1], codebooks.shape[2]
+    rot_dim = pq_dim * dsub
+    rc = _pad_rot(centers, rot_dim) @ rotation.T  # (n_lists, rot_dim)
+    cb_flat = codebooks.reshape(pq_dim * n_codes, dsub)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+
+    def one_list(args):
+        rc_l, codes_l, ids_l = args  # (rot,), (m, s), (m,)
+        resid = jnp.take(cb_flat, codes_l.astype(jnp.int32) + s_off, axis=0)
+        x_hat = rc_l[None, :] + resid.reshape(max_size, rot_dim)
+        return jnp.where((ids_l >= 0)[:, None], x_hat, 0).astype(jnp.bfloat16)
+
+    return lax.map(one_list, (rc, list_codes, list_ids))
 
 
 def _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, metric):
@@ -391,19 +432,74 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     all_codes = jnp.concatenate([old_codes, codes])
     all_ids = jnp.concatenate([old_ids, new_ids])
     all_labels = jnp.concatenate([old_labels, labels])
-    list_codes, list_ids = _pack_lists(all_codes, all_ids, all_labels, index.n_lists)
+    group = 512 if index.max_list_size % 512 == 0 else 64
+    list_codes, list_ids = _pack_lists(all_codes, all_ids, all_labels, index.n_lists, group)
     b_sum = _compute_b_sum(
         index.centers, index.rotation, index.codebooks, list_codes, list_ids, index.metric
     )
     return IvfPqIndex(
         index.centers, index.rotation, index.codebooks, list_codes, list_ids,
-        b_sum, index.metric, index.pq_bits,
+        b_sum, None, index.metric, index.pq_bits,
     )
 
 
 # ---------------------------------------------------------------------------
 # Search
 # ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("l2",))
+def _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2: bool):
+    """Per-entry bias for the decoded scan: ‖x̂‖² = ‖R·c_l‖² + b_sum for L2
+    (b_sum already carries +inf at padding), 0/+inf for ip/cosine; filtered
+    entries get +inf."""
+    if l2:
+        rot_dim = rotation.shape[0]
+        rc2 = dist_mod.sqnorm(_pad_rot(centers, rot_dim) @ rotation.T)
+        bias = rc2[:, None] + b_sum
+    else:
+        bias = b_sum
+    if filter is not None:
+        bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
+    return bias
+
+
+def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
+    """Decoded-cache ragged scan (ops/ragged_scan.py): identical scores to
+    the LUT formulation (x̂ is the exact reconstruction the LUT sums over),
+    at 2·dim MXU FLOPs per probed entry instead of 2·pq_dim·n_codes."""
+    from raft_tpu.neighbors.ivf_flat import _coarse_probes
+    from raft_tpu.ops.ragged_scan import ragged_search
+
+    if index.decoded is None:
+        # lazy decode-cache fill, kept on the index instance
+        index.decoded = _decode_lists(
+            index.centers, index.rotation, index.codebooks,
+            index.list_codes, index.list_ids,
+        )
+    l2 = index.metric in ("sqeuclidean", "euclidean")
+    probes = _coarse_probes(
+        queries, index.centers, n_probes, index.metric, select_algo,
+        res.compute_dtype,
+    )
+    qr = _pad_rot(queries, index.rot_dim) @ index.rotation.T
+    bias = _ragged_bias_pq(index.b_sum, index.centers, index.rotation,
+                           index.list_ids, filter, l2)
+    vals, ids = ragged_search(
+        qr, probes, index.decoded, bias, index.list_ids, index.list_sizes(),
+        int(k), alpha=-2.0 if l2 else -1.0,
+        workspace_bytes=res.workspace_bytes,
+        interpret=jax.default_backend() != "tpu",
+    )
+    if l2:
+        vals = jnp.maximum(vals + dist_mod.sqnorm(qr)[:, None], 0.0)
+        if index.metric == "euclidean":
+            vals = jnp.sqrt(vals)
+        vals = jnp.where(ids >= 0, vals, jnp.inf)
+    else:
+        # match the gather backend: raw inner product, bigger = closer
+        vals = jnp.where(ids >= 0, -vals, -jnp.inf)
+    return vals, ids
 
 
 def _query_luts(queries, rotation, codebooks, metric, lut_dtype):
@@ -608,13 +704,34 @@ def search(
     if index.metric == "cosine":
         queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
 
+    from raft_tpu.ops.ragged_scan import MC as _MC
+
+    aligned = index.max_list_size % _MC == 0
     if backend == "auto":
-        # the take_along_axis gather path has crashed the TPU runtime on
-        # large shapes — on TPU always use the list-centric kernel (wide
-        # pq_bits=8 LUTs just get smaller query tiles via the budget below)
-        backend = "pallas" if jax.default_backend() == "tpu" else "gather"
-    if backend not in ("pallas", "gather"):
+        # ragged decoded scan on TPU (the fast path); jnp gather elsewhere
+        # (the exact-fp32 oracle; its take_along_axis crashes the TPU
+        # runtime at large shapes, so it is never auto-picked there);
+        # misaligned (old / small-group) indexes fall back to the LUT
+        # kernel on TPU
+        if jax.default_backend() == "tpu":
+            backend = "ragged" if aligned else "pallas"
+        else:
+            backend = "gather"
+    if backend not in ("ragged", "pallas", "gather"):
         raise ValueError(f"unknown backend {backend!r}")
+    if backend == "ragged":
+        if not aligned:
+            raise ValueError(
+                f"ragged backend needs max_list_size % {_MC} == 0, got "
+                f"{index.max_list_size}; rebuild with group_size={_MC} "
+                "(or use backend='pallas'/'gather')"
+            )
+        vals, ids = _search_ragged_pq(
+            index, queries, int(k), n_probes, filter, select_algo, res
+        )
+        if index.metric == "cosine":
+            vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
+        return vals, ids
     if backend == "pallas":
         p = n_probes
         n_codes = index.codebooks.shape[1]

@@ -1,0 +1,387 @@
+"""Ragged grouped-matmul list scan — the IVF search engine on TPU.
+
+Reference analog: the per-(query, probe) interleaved/PQ scan kernels
+(neighbors/detail/ivf_flat_interleaved_scan-inl.cuh:90,
+detail/ivf_pq_compute_similarity-inl.cuh) — one CTA per probed pair, early
+exit at the list's real length.
+
+TPU redesign — the scan is a *chunk-table-driven grouped matmul*:
+
+  1. Stage 1 (outside, cheap) computes every query's probed lists; the host
+     builds a chunk table from the ACTUAL loads: each (list, query-chunk of
+     ≤C queries, m-chunk of ≤MC entries) becomes one grid step. Work is
+     therefore ∝ Σ_pairs len(list) — skew cannot force drops (no per-list
+     cap exists) and list-length padding costs at most one partial MC chunk
+     per list, not max_list_size for every list.
+  2. The kernel is one MXU matmul per chunk: queries block (C, dim) ×
+     list-entries block (MC, dim)ᵀ, fp32 accumulation, with the per-entry
+     bias row (e.g. ‖x‖² for expanded L2, +inf at padding) fused in. Block
+     placement is data-dependent → scalar-prefetched chunk arrays drive the
+     BlockSpec index maps (pltpu.PrefetchScalarGridSpec), so list data is
+     DMA'd straight from the index arrays — no gather materialization.
+  3. Top-k: per chunk-row local top-k (a chunk holds MC entries, so
+     min(k, MC) per chunk provably contains every query's global top-k),
+     then a per-pair gather back through the chunk table and one final
+     lax.top_k per query.
+
+IVF-Flat feeds raw list vectors; IVF-PQ feeds *decoded* vectors (codes →
+bf16 reconstruction in rotated space, built once per index): at pq_bits=8
+a LUT one-hot matmul costs 2·pq_dim·256 FLOP per entry while the decoded
+matmul costs 2·dim — 64× less MXU work for identical scores (decode is the
+exact reconstruction the LUT sums over). The bf16 decode cache is this
+framework's analog of the reference's fp8-compressed LUT
+(detail/ivf_pq_fp_8bit.cuh): precision traded for bandwidth, re-ranked by
+refine.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C_SLOTS = 128   # queries per q-chunk (MXU M dim)
+MC = 512        # list entries per m-chunk (MXU N dim); == list group align.
+                # 512 keeps the per-step matmul fat enough that grid-step
+                # overhead (~μs) amortizes; lists are padded to this multiple.
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+def _bucket(n: int) -> int:
+    """Round up to a power of two (bounds the number of compiled shapes)."""
+    return 1 << max(4, math.ceil(math.log2(max(n, 1))))
+
+
+@dataclass
+class RaggedPlan:
+    """Host-built chunk table for one query tile (all arrays np.int32)."""
+
+    chunk_list: np.ndarray   # (T,) list id per chunk
+    chunk_qc: np.ndarray     # (T,) q-chunk id per chunk
+    chunk_mc: np.ndarray     # (T,) m-chunk index within the list
+    qids: np.ndarray         # (N_QC, C) query ids per q-chunk slot, -1 pad
+    chunk_off_qc: np.ndarray  # (N_QC,) first chunk id of each q-chunk
+    qc_nmc: np.ndarray       # (N_QC,) m-chunks of each q-chunk's list
+    qc_list: np.ndarray      # (N_QC,) list id of each q-chunk
+    pair_qc: np.ndarray      # (q, p) q-chunk of each probed pair
+    pair_slot: np.ndarray    # (q, p) slot of each pair within its q-chunk
+    n_chunks: int            # real chunks (<= len(chunk_list) == bucket)
+    max_mc: int              # max m-chunks among probed lists
+
+    @property
+    def t_pad(self) -> int:
+        return self.chunk_list.shape[0]
+
+    @property
+    def n_qc_pad(self) -> int:
+        return self.qids.shape[0]
+
+
+def plan_scan(probes: np.ndarray, lens: np.ndarray, n_lists: int) -> RaggedPlan:
+    """Build the chunk table from a tile's probe matrix (q, p) and the
+    per-list entry counts. Pure numpy — runs per tile on host (~ms), the
+    data-dependent sizing the GPU does with atomics and CTA scheduling."""
+    q, p = probes.shape
+    flat = probes.reshape(-1).astype(np.int64)
+    order = np.argsort(flat, kind="stable")
+    sorted_lists = flat[order]
+    qid_of = (order // p).astype(np.int32)
+
+    r = np.bincount(flat, minlength=n_lists)            # pairs per list
+    n_qc = _ceil_div(r, C_SLOTS)                        # q-chunks per list
+    n_mc = _ceil_div(np.maximum(lens, 0), MC)           # m-chunks per list
+    qc_off = np.concatenate([[0], np.cumsum(n_qc)]).astype(np.int64)
+    n_qc_total = int(qc_off[-1])
+
+    qc_list = np.repeat(np.arange(n_lists), n_qc)       # (n_qc_total,)
+    qc_mc = n_mc[qc_list]                               # chunks per q-chunk
+    chunk_off = np.concatenate([[0], np.cumsum(qc_mc)]).astype(np.int64)
+    t = int(chunk_off[-1])
+
+    chunk_qc = np.repeat(np.arange(n_qc_total), qc_mc).astype(np.int32)
+    chunk_list = qc_list[chunk_qc].astype(np.int32)
+    chunk_mc = (np.arange(t) - chunk_off[chunk_qc]).astype(np.int32)
+
+    # qids per q-chunk slot
+    pair_off = np.concatenate([[0], np.cumsum(r)]).astype(np.int64)
+    qc_within = np.arange(n_qc_total) - qc_off[qc_list]
+    pos = pair_off[qc_list][:, None] + qc_within[:, None] * C_SLOTS + np.arange(C_SLOTS)[None, :]
+    valid = pos < (pair_off[qc_list] + r[qc_list])[:, None]
+    qids = np.where(valid, qid_of[np.minimum(pos, max(q * p - 1, 0))], -1).astype(np.int32)
+
+    # pair → (qc, slot) back-map
+    rank = np.arange(q * p) - pair_off[sorted_lists]
+    pair_qc_s = (qc_off[sorted_lists] + rank // C_SLOTS).astype(np.int32)
+    pair_slot_s = (rank % C_SLOTS).astype(np.int32)
+    pair_qc = np.empty(q * p, np.int32)
+    pair_slot = np.empty(q * p, np.int32)
+    pair_qc[order] = pair_qc_s
+    pair_slot[order] = pair_slot_s
+
+    probed_mc = n_mc[np.unique(flat)]
+    max_mc = int(probed_mc.max()) if probed_mc.size else 1
+
+    # pad to pow2 buckets (padding chunks point at block 0; their output is
+    # never gathered because chunk_off_qc only spans real chunks)
+    t_pad = _bucket(t)
+    n_qc_pad = _bucket(n_qc_total)
+
+    def pad(a, n, fill):
+        out = np.full((n,) + a.shape[1:], fill, a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    return RaggedPlan(
+        chunk_list=pad(chunk_list, t_pad, 0),
+        chunk_qc=pad(chunk_qc, t_pad, 0),
+        chunk_mc=pad(chunk_mc, t_pad, 0),
+        qids=pad(qids, n_qc_pad, -1),
+        chunk_off_qc=pad(chunk_off[:-1].astype(np.int32), n_qc_pad, 0),
+        qc_nmc=pad(qc_mc.astype(np.int32), n_qc_pad, 0),
+        qc_list=pad(qc_list.astype(np.int32), n_qc_pad, 0),
+        pair_qc=pair_qc.reshape(q, p),
+        pair_slot=pair_slot.reshape(q, p),
+        n_chunks=t,
+        max_mc=max(max_mc, 1),
+    )
+
+
+_G = 4  # chunks per grid step (amortizes the ~µs per-step overhead)
+
+
+def _scan_kernel(cl_ref, cqc_ref, cmc_ref, *refs, alpha, kf, g):
+    """Per step: G chunk matmuls, each immediately reduced to its rows'
+    top-kf (iterative masked min — kf passes on the VPU) so only (C, kf)
+    values + within-list entry offsets ever reach HBM; the full (C, MC)
+    score block lives and dies in VMEM/registers."""
+    a_refs = refs[0:g]
+    b_refs = refs[g:2 * g]
+    bias_refs = refs[2 * g:3 * g]
+    outv_ref, oute_ref = refs[3 * g], refs[3 * g + 1]
+    i = pl.program_id(0)
+    for j in range(g):
+        a = a_refs[j][0].astype(jnp.bfloat16)        # (C, dim)
+        b = b_refs[j][0].astype(jnp.bfloat16)        # (MC, dim)
+        acc = lax.dot_general(
+            a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                            # (C, MC)
+        s = alpha * acc + bias_refs[j][0]
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mc0 = cmc_ref[i * g + j] * MC
+        vs, es = [], []
+        for _ in range(kf):
+            mn = jnp.min(s, axis=1)                  # (C,)
+            am = jnp.min(jnp.where(s <= mn[:, None], cols, MC), axis=1)
+            vs.append(mn)
+            es.append(mc0 + am)                      # entry offset in list
+            s = jnp.where(cols == am[:, None], jnp.inf, s)
+        outv_ref[0, j] = jnp.stack(vs, axis=1)       # (C, kf)
+        oute_ref[0, j] = jnp.stack(es, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_pad", "alpha", "kf", "interpret"),
+)
+def _ragged_matmul(chunk_list, chunk_qc, chunk_mc, a_grouped, list_data,
+                   bias, t_pad: int, alpha: float, kf: int, interpret: bool):
+    """Per-chunk-row top-kf of ``alpha·A[qc_i] @ B[l_i, mc_i]ᵀ + bias``.
+    Returns (vals (T, C, kf), entry_offsets (T, C, kf) int32 — offsets are
+    within the chunk's *list*, so id translation can wait until after the
+    per-pair reduction (a few MB instead of the full candidate set)."""
+    n_qc, c, dim = a_grouped.shape
+    n_lists, m, _ = list_data.shape
+    g = _G if t_pad % _G == 0 else 1
+
+    def a_map(j):
+        return lambda i, cl, cqc, cmc: (cqc[i * g + j], 0, 0)
+
+    def b_map(j):
+        return lambda i, cl, cqc, cmc: (cl[i * g + j], cmc[i * g + j], 0)
+
+    def bias_map(j):
+        return lambda i, cl, cqc, cmc: (cl[i * g + j], 0, cmc[i * g + j])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t_pad // g,),
+        in_specs=(
+            [pl.BlockSpec((1, c, dim), a_map(j)) for j in range(g)]
+            + [pl.BlockSpec((1, MC, dim), b_map(j)) for j in range(g)]
+            + [pl.BlockSpec((1, 1, MC), bias_map(j)) for j in range(g)]
+        ),
+        out_specs=(
+            # both outputs: one (1, g, C, kf) block per step covering the
+            # step's g chunks (chunk id = i*g + j, row-major)
+            [pl.BlockSpec((1, g, c, kf), lambda i, cl, cqc, cmc: (i, 0, 0, 0))] * 2
+        ),
+    )
+    bias3 = bias.reshape(n_lists, 1, m)
+    lv, le = pl.pallas_call(
+        functools.partial(_scan_kernel, alpha=alpha, kf=kf, g=g),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((t_pad // g, g, c, kf), jnp.float32),
+            jax.ShapeDtypeStruct((t_pad // g, g, c, kf), jnp.int32),
+        ),
+        interpret=interpret,
+    )(chunk_list, chunk_qc, chunk_mc,
+      *([a_grouped] * g), *([list_data] * g), *([bias3] * g))
+    return lv.reshape(t_pad, c, kf), le.reshape(t_pad, c, kf)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "kf", "max_mc"))
+def _merge_topk(lv, le, qc_list, pair_qc, pair_slot, chunk_off_qc, qc_nmc,
+                list_ids, k: int, kf: int, max_mc: int):
+    """Per-chunk-row top-kf -> per-query (vals, ids) top-k.
+
+    lv/le: (T, C, kf) kernel outputs (values + within-list entry offsets).
+
+    Stage order matters for bandwidth: reducing per *pair* first happens in
+    chunk-major layout (a dim-0 slice gather over each q-chunk's contiguous
+    chunk range), so the only random gathers left touch already-reduced
+    (., kp) rows — a few MB instead of the full candidate set.
+    """
+    t, c, _ = lv.shape
+    n_qc = chunk_off_qc.shape[0]
+
+    # per-pair reduction in qc-major layout
+    mcs = jnp.arange(max_mc, dtype=jnp.int32)
+    rng_ids = jnp.clip(chunk_off_qc[:, None] + mcs[None, :], 0, t - 1)
+    in_rng = mcs[None, :] < qc_nmc[:, None]                  # (N_QC, max_mc)
+    qc_v = jnp.where(in_rng[:, :, None, None], lv[rng_ids], jnp.inf)
+    qc_e = jnp.where(in_rng[:, :, None, None], le[rng_ids], 0)
+    # (N_QC, max_mc, C, kf) -> (N_QC*C, max_mc*kf) -> per-pair top-kp
+    qc_v = qc_v.transpose(0, 2, 1, 3).reshape(n_qc * c, max_mc * kf)
+    qc_e = qc_e.transpose(0, 2, 1, 3).reshape(n_qc * c, max_mc * kf)
+    kp = min(k, max_mc * kf)  # a pair can owe up to min(k, its entries)
+    pv, sel = lax.top_k(-qc_v, kp)
+    pv = -pv
+    pe = jnp.take_along_axis(qc_e, sel, axis=1)
+
+    # translate within-list entry offsets -> source row ids (reduced set only)
+    li = jnp.take_along_axis(
+        list_ids[qc_list],
+        jnp.clip(pe.reshape(n_qc, c * kp), 0, list_ids.shape[1] - 1),
+        axis=1,
+    ).reshape(n_qc, c, kp)
+    pv = pv.reshape(n_qc, c, kp)
+
+    # query-major gather of the reduced per-pair rows (small + random)
+    q, p = pair_qc.shape
+    cand_v = pv[pair_qc, pair_slot].reshape(q, p * kp)
+    cand_i = li[pair_qc, pair_slot].reshape(q, p * kp)
+    out_v, sel = lax.top_k(-cand_v, k)
+    out_i = jnp.take_along_axis(cand_i, sel, axis=1)
+    out_v = -out_v
+    out_i = jnp.where(jnp.isfinite(out_v), out_i, -1)
+    return out_v, out_i
+
+
+def ragged_search(
+    queries_mat,
+    probes,
+    list_data,
+    list_bias,
+    list_ids,
+    lens,
+    k: int,
+    alpha: float = -2.0,
+    workspace_bytes: int = 1 << 30,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Query-tiled ragged scan over all queries: sizes tiles so the chunk
+    score block stays inside the workspace budget, then concatenates."""
+    q = queries_mat.shape[0]
+    probes_np = np.asarray(probes)
+    lens_np = np.asarray(lens)
+    p = probes_np.shape[1]
+    n_lists = list_data.shape[0]
+
+    q_tile = min(q, 4096)
+    out_v, out_i = [], []
+    start = 0
+    while start < q:
+        qt = min(q_tile, q - start)
+        plan = plan_scan(probes_np[start:start + qt], lens_np, n_lists)
+        while plan.t_pad * C_SLOTS * MC * 4 > workspace_bytes and q_tile > 256:
+            q_tile //= 2
+            qt = min(q_tile, q - start)
+            plan = plan_scan(probes_np[start:start + qt], lens_np, n_lists)
+        v, i = _scan_with_plan(
+            queries_mat[start:start + qt], plan, list_data, list_bias,
+            list_ids, k, alpha, interpret,
+        )
+        out_v.append(v)
+        out_i.append(i)
+        start += qt
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
+
+
+def ragged_scan_topk(
+    queries_mat,
+    probes,
+    list_data,
+    list_bias,
+    list_ids,
+    lens,
+    k: int,
+    alpha: float = -2.0,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full ragged scan: probes (q, p) int32 → per-query top-k over the
+    probed lists' entries.
+
+    queries_mat: (q, dim) query-side matrix (rotated queries / raw queries).
+    list_data: (n_lists, m, dim) entry matrix (decoded PQ / raw vectors),
+      m a multiple of 128.
+    list_bias: (n_lists, m) per-entry additive term (+inf at padding).
+    list_ids: (n_lists, m) source row ids (-1 padding).
+    lens: (n_lists,) real entry counts.
+    probes rows must hold *distinct* list ids (coarse top-p guarantees
+    this); a duplicated probe would duplicate its candidates.
+    Scores are ``alpha * <q, x> + bias``; smaller is better. The caller adds
+    per-query constants (e.g. ‖q‖²) afterwards.
+    """
+    n_lists = list_data.shape[0]
+    plan = plan_scan(np.asarray(probes), np.asarray(lens), n_lists)
+    return _scan_with_plan(queries_mat, plan, list_data, list_bias, list_ids,
+                           k, alpha, interpret)
+
+
+def _scan_with_plan(queries_mat, plan: RaggedPlan, list_data, list_bias,
+                    list_ids, k, alpha, interpret):
+    # group the query side per q-chunk (pad rows are zero; their scores are
+    # garbage but unreferenced by the merge gather)
+    qids = jnp.asarray(plan.qids)
+    a_grouped = jnp.where(
+        (qids >= 0)[:, :, None],
+        jnp.asarray(queries_mat)[jnp.clip(qids, 0), :],
+        0,
+    ).astype(jnp.bfloat16)
+
+    kf = min(int(k), MC)
+    lv, le = _ragged_matmul(
+        jnp.asarray(plan.chunk_list), jnp.asarray(plan.chunk_qc),
+        jnp.asarray(plan.chunk_mc), a_grouped, list_data, list_bias,
+        plan.t_pad, float(alpha), kf, bool(interpret),
+    )
+    return _merge_topk(
+        lv, le, jnp.asarray(plan.qc_list), jnp.asarray(plan.pair_qc),
+        jnp.asarray(plan.pair_slot), jnp.asarray(plan.chunk_off_qc),
+        jnp.asarray(plan.qc_nmc), jnp.asarray(list_ids), int(k), kf,
+        plan.max_mc,
+    )
