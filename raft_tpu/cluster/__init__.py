@@ -4,10 +4,14 @@
     (cluster/kmeans.cuh).
   * :mod:`raft_tpu.cluster.kmeans_balanced` — balanced hierarchical k-means,
     the IVF coarse-quantizer trainer (cluster/kmeans_balanced.cuh).
-  * single-linkage agglomerative clustering arrives with the sparse/MST layer.
+  * :mod:`raft_tpu.cluster.single_linkage` — MST-based agglomerative
+    clustering (cluster/single_linkage.cuh).
 """
 
-from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster import kmeans, kmeans_balanced, single_linkage
 from raft_tpu.cluster.kmeans import KMeansParams
+from raft_tpu.cluster.single_linkage import LinkageResult
+from raft_tpu.cluster.single_linkage import single_linkage as single_linkage_fn
 
-__all__ = ["kmeans", "kmeans_balanced", "KMeansParams"]
+__all__ = ["kmeans", "kmeans_balanced", "single_linkage", "single_linkage_fn",
+           "KMeansParams", "LinkageResult"]

@@ -15,6 +15,19 @@ All share the filter protocol (`Bitset` prefilter, sample_filter.cuh:31) and
 container serialization (core/serialize.py).
 """
 
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+from raft_tpu.neighbors import (
+    ball_cover,
+    brute_force,
+    cagra,
+    epsilon_neighborhood,
+    ivf_flat,
+    ivf_pq,
+    nn_descent,
+    refine,
+)
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 
-__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]
+__all__ = [
+    "ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
+    "eps_neighbors", "ivf_flat", "ivf_pq", "nn_descent", "refine",
+]

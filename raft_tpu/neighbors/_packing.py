@@ -39,7 +39,7 @@ def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int) -> Tuple
 
 
 def spill_to_cap(work, centers, labels, metric: str, cap: int,
-                 chunk: int = 65536):
+                 base_counts=None, chunk: int = 65536):
     """Cap per-list occupancy by spilling overflow rows to their
     second-nearest center.
 
@@ -56,30 +56,41 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     """
     n = labels.shape[0]
     n_lists = centers.shape[0]
+    # base_counts: occupancy already committed to each list (extend() spills
+    # only the new rows on top of the existing fill)
+    base = (jnp.zeros(n_lists, jnp.int32) if base_counts is None
+            else jnp.asarray(base_counts, jnp.int32))
     counts = jnp.bincount(labels, length=n_lists)
-    if int(jnp.max(counts)) <= cap:
+    if int(jnp.max(counts + base)) <= cap:
         return labels
 
-    # rank of each row within its cluster (arrival order)
+    # rank of each row within its cluster (arrival order, after the base)
     order = jnp.argsort(labels)
     offsets = jnp.cumsum(counts) - counts
     rank_sorted = jnp.arange(n, dtype=jnp.int32) - offsets[labels[order]].astype(jnp.int32)
     rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
-    over = rank >= cap
+    over = base[labels] + rank >= cap
 
-    # second-nearest center, chunked so the (n, n_lists) block never lands
+    # second-nearest center — computed only for overflow rows (build is
+    # eager, so the data-dependent row subset is a host-side gather), in
+    # chunks so the (n_over, n_lists) block stays bounded
     from raft_tpu.ops import distance as dist_mod
+    import numpy as np
 
+    over_rows = np.where(np.asarray(over))[0]
+    work_o = work[jnp.asarray(over_rows)]
+    labels_o = labels[jnp.asarray(over_rows)]
     second = []
-    for s in range(0, n, chunk):
-        w = work[s:s + chunk]
+    for s in range(0, over_rows.shape[0], chunk):
+        w = work_o[s:s + chunk]
         if metric == "inner_product":
             d = -dist_mod.matmul_t(w, centers, None, "highest")
         else:
             d = dist_mod._expanded_distance(w, centers, "sqeuclidean", None, "highest")
-        d = d.at[jnp.arange(w.shape[0]), labels[s:s + chunk]].set(jnp.inf)
+        d = d.at[jnp.arange(w.shape[0]), labels_o[s:s + chunk]].set(jnp.inf)
         second.append(jnp.argmin(d, axis=1).astype(jnp.int32))
-    labels2 = jnp.concatenate(second)
+    second_o = jnp.concatenate(second) if second else jnp.zeros(0, jnp.int32)
+    labels2 = jnp.array(labels).at[jnp.asarray(over_rows)].set(second_o)
 
     # admission control per target: spills ranked within each target list
     # only fill its *remaining* capacity, so concurrent spills from several
@@ -91,7 +102,7 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     t_off = jnp.cumsum(t_counts) - t_counts
     spill_rank_sorted = jnp.arange(n, dtype=jnp.int32) - t_off[t_sorted].astype(jnp.int32)
     spill_rank = jnp.zeros(n, jnp.int32).at[s_order].set(spill_rank_sorted)
-    admitted = over & (counts[labels2] + spill_rank < cap)
+    admitted = over & (base[labels2] + counts[labels2] + spill_rank < cap)
     return jnp.where(admitted, labels2, labels)
 
 

@@ -1,0 +1,227 @@
+"""Random ball cover: exact kNN via landmark triangle-inequality pruning
+(reference neighbors/ball_cover-inl.cuh: build_index, all_knn_query :111,
+knn_query :258, eps_nn :313; kernels in
+spatial/knn/detail/ball_cover/registers.cuh).
+
+TPU design. The reference's one-CTA-per-query kernel walks landmarks in
+distance order and early-exits per query. Early exit is per-query control
+flow XLA can't express, so the scan is batched: landmarks are visited in
+order of each query's *lower bound* ``max(0, d(q, l) − radius_l)`` — which
+makes the bound sequence monotone per query, so one shared
+``lax.while_loop`` over landmark batches stops exactly when every query's
+next bound exceeds its current kth distance. Each step is a dense
+gather + matmul over B lists for all queries (finished queries ride along
+masked — the cost of lockstep, bounded by the slowest query).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.neighbors._packing import pack_lists
+from raft_tpu.ops import distance as dist_mod
+
+SUPPORTED_METRICS = ("sqeuclidean", "euclidean")
+_GROUP = 32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class BallCoverIndex:
+    """Landmarks + padded member lists + per-landmark radii
+    (ball_cover_types.hpp BallCoverIndex analog)."""
+
+    landmarks: jax.Array   # (L, dim) fp32
+    list_data: jax.Array   # (L, m, dim)
+    list_ids: jax.Array    # (L, m) int32, -1 padding
+    radii: jax.Array       # (L,) euclidean radius of each ball
+    metric: str
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.landmarks.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.landmarks.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_ids >= 0))
+
+    def tree_flatten(self):
+        return (self.landmarks, self.list_data, self.list_ids, self.radii), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+
+def build(
+    dataset,
+    n_landmarks: int = 0,
+    metric: str = "euclidean",
+    seed: int = 0,
+    res: Optional[Resources] = None,
+) -> BallCoverIndex:
+    """Sample √n landmarks, assign every point to its nearest landmark,
+    record ball radii (ball_cover-inl.cuh build_index)."""
+    res = res or current_resources()
+    metric = dist_mod.canonical_metric(metric)
+    if metric not in SUPPORTED_METRICS:
+        raise ValueError(f"ball_cover supports {SUPPORTED_METRICS}, got {metric!r}")
+    dataset = jnp.asarray(dataset).astype(jnp.float32)
+    n, dim = dataset.shape
+    L = int(n_landmarks) or max(1, int(n ** 0.5))
+    if L > n:
+        raise ValueError(f"n_landmarks={L} > n_rows={n}")
+
+    key = jax.random.key(seed)
+    rows = jax.random.choice(key, n, (L,), replace=False)
+    landmarks = dataset[rows]
+    d2 = dist_mod.pairwise_distance(dataset, landmarks, "sqeuclidean", res=res)
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    dist_to_lm = jnp.sqrt(jnp.maximum(
+        jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0], 0.0))
+
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    list_data, list_ids = pack_lists(dataset, row_ids, labels, L, _GROUP)
+    radii = jax.ops.segment_max(dist_to_lm, labels, num_segments=L)
+    radii = jnp.where(jnp.isfinite(radii), radii, 0.0)
+    return BallCoverIndex(landmarks, list_data, list_ids, radii, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "batch"))
+def _query_impl(queries, landmarks, list_data, list_ids, radii, k: int, batch: int):
+    q, dim = queries.shape
+    L, m, _ = list_data.shape
+    nb = -(-L // batch)
+
+    d_ql = jnp.sqrt(jnp.maximum(
+        dist_mod._expanded_distance(queries, landmarks, "sqeuclidean", None, "highest"),
+        0.0))
+    lb = jnp.maximum(d_ql - radii[None, :], 0.0)        # (q, L)
+    order = jnp.argsort(lb, axis=1).astype(jnp.int32)   # per-query visit order
+    lb_sorted = jnp.take_along_axis(lb, order, axis=1)
+    # pad the visit order to a batch multiple (repeat the last landmark —
+    # rescanning a list is harmless for a top-k merge)
+    pad = nb * batch - L
+    order = jnp.pad(order, ((0, 0), (0, pad)), mode="edge")
+    lb_sorted = jnp.pad(lb_sorted, ((0, 0), (0, pad)), mode="edge")
+
+    qn = dist_mod.sqnorm(queries)
+    norms = dist_mod.sqnorm(list_data, axis=2)          # (L, m)
+    norms = jnp.where(list_ids >= 0, norms, jnp.inf)
+
+    def cond(state):
+        best_v, _, b = state
+        kth = jnp.sqrt(jnp.maximum(best_v[:, k - 1], 0.0))
+        nxt = lb_sorted[:, jnp.minimum(b * batch, nb * batch - 1)]
+        return (b < nb) & jnp.any((nxt <= kth) | ~jnp.isfinite(kth))
+
+    def body(state):
+        best_v, best_i, b = state
+        lists = lax.dynamic_slice_in_dim(order, b * batch, batch, axis=1)  # (q, B)
+        cand = list_data[lists]                       # (q, B, m, dim)
+        ids = list_ids[lists].reshape(q, batch * m)
+        nrm = norms[lists].reshape(q, batch * m)
+        ip = jnp.einsum("qd,qbmd->qbm", queries, cand,
+                        preferred_element_type=jnp.float32).reshape(q, batch * m)
+        d2 = jnp.maximum(qn[:, None] + nrm - 2.0 * ip, 0.0)
+        d2 = jnp.where(ids >= 0, d2, jnp.inf)
+        allv = jnp.concatenate([best_v, d2], axis=1)
+        alli = jnp.concatenate([best_i, ids], axis=1)
+        best_v, sel = lax.top_k(-allv, k)
+        best_v = -best_v
+        best_i = jnp.take_along_axis(alli, sel, axis=1)
+        return best_v, best_i, b + 1
+
+    best_v = jnp.full((q, k), jnp.inf, jnp.float32)
+    best_i = jnp.full((q, k), -1, jnp.int32)
+    best_v, best_i, _ = lax.while_loop(cond, body, (best_v, best_i, jnp.zeros((), jnp.int32)))
+    return best_v, best_i
+
+
+def knn_query(
+    index: BallCoverIndex,
+    queries,
+    k: int,
+    batch: int = 8,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN against the indexed points (ball_cover-inl.cuh:258).
+    Returns (distances, indices) in the index's metric."""
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
+    if not 0 < k <= index.size:
+        raise ValueError(f"k={k} out of range for {index.size} points")
+    v, i = _query_impl(queries, index.landmarks, index.list_data,
+                       index.list_ids, index.radii, int(k), int(batch))
+    if index.metric == "euclidean":
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return jnp.where(i >= 0, v, jnp.inf), i
+
+
+def all_knn_query(
+    index: BallCoverIndex,
+    k: int,
+    batch: int = 8,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """kNN of every indexed point against the index itself, self included
+    (ball_cover-inl.cuh:111). Rows are ordered by source row id."""
+    # reconstruct the dataset in row order from the packed lists
+    flat_ids = index.list_ids.reshape(-1)
+    flat = index.list_data.reshape(-1, index.dim)
+    n = index.size
+    pos = jnp.where(flat_ids >= 0, flat_ids, n)  # padding → OOB → dropped
+    dataset = jnp.zeros((n, index.dim), jnp.float32).at[pos].set(
+        flat, mode="drop")
+    return knn_query(index, dataset, k, batch=batch, res=res)
+
+
+def eps_nn(
+    index: BallCoverIndex,
+    queries,
+    eps: float,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """All index points within L2 radius ``eps`` of each query
+    (ball_cover-inl.cuh:313): (adjacency (q, n) bool over source row ids,
+    degree (q,)). Balls with lower bound > eps contribute nothing and are
+    masked before the compare."""
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if eps <= 0:
+        raise ValueError(f"eps must be positive, got {eps}")
+    q = queries.shape[0]
+    n = index.size
+    L, m, dim = index.list_data.shape
+
+    d_ql = jnp.sqrt(jnp.maximum(dist_mod._expanded_distance(
+        queries, index.landmarks, "sqeuclidean", None, "highest"), 0.0))
+    ball_ok = (d_ql - index.radii[None, :]) <= eps       # (q, L)
+
+    qn = dist_mod.sqnorm(queries)
+    norms = jnp.where(index.list_ids >= 0,
+                      dist_mod.sqnorm(index.list_data, axis=2), jnp.inf)
+    ip = jnp.einsum("qd,lmd->qlm", queries, index.list_data,
+                    preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(qn[:, None, None] + norms[None] - 2.0 * ip, 0.0)
+    within = (d2 <= eps * eps) & ball_ok[:, :, None] & (index.list_ids >= 0)[None]
+
+    # scatter per-entry flags into row-id order
+    adj = jnp.zeros((q, n), bool)
+    flat_ids = jnp.clip(index.list_ids.reshape(-1), 0, n - 1)
+    pos = jnp.where(index.list_ids.reshape(-1) >= 0, flat_ids, n)
+    adj = adj.at[:, pos].max(within.reshape(q, -1), mode="drop")
+    return adj, jnp.sum(adj.astype(jnp.int32), axis=1)

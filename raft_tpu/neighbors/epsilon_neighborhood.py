@@ -1,0 +1,34 @@
+"""Dense epsilon-neighborhood (reference neighbors/epsilon_neighborhood.cuh:
+eps_neighbors_l2sq — boolean adjacency + per-row degree within radius).
+
+One tiled pairwise-distance pass with a fused comparison; the reference's
+custom kernel exists to avoid materializing distances, which XLA's fusion
+handles for free here (the bool matrix is the output either way).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.ops import distance as dist_mod
+
+
+def eps_neighbors(
+    x,
+    y,
+    eps: float,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(adjacency (m, n) bool, degree (m,) int32) of pairs with
+    ‖x_i − y_j‖² ≤ eps² (eps_neighbors_l2sq analog — eps is the L2 radius,
+    squared internally like the reference)."""
+    res = res or current_resources()
+    if eps <= 0:
+        raise ValueError(f"eps must be positive, got {eps}")
+    d2 = dist_mod.pairwise_distance(x, y, "sqeuclidean", res=res)
+    adj = d2 <= jnp.float32(eps) ** 2
+    return adj, jnp.sum(adj.astype(jnp.int32), axis=1)

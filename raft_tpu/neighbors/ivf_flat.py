@@ -229,11 +229,17 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
     new_labels = kmeans_balanced.predict(
         new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
     )
+    group = 512 if index.max_list_size % 512 == 0 else 64
+    total = int(old_ids.shape[0]) + int(new_vectors.shape[0])
+    cap = _packing.auto_list_cap(total, index.n_lists, group)
+    new_labels = _packing.spill_to_cap(
+        new_vectors, index.centers, new_labels, km_metric, cap,
+        base_counts=index.list_sizes(),
+    )
 
     all_vecs = jnp.concatenate([old_vecs, new_vectors])
     all_ids = jnp.concatenate([old_ids, new_ids])
     all_labels = jnp.concatenate([old_labels, new_labels])
-    group = 512 if index.max_list_size % 512 == 0 else 64
     list_data, list_ids = _pack_lists(all_vecs, all_ids, all_labels, index.n_lists, group)
     list_norms = None
     if index.metric in ("sqeuclidean", "euclidean"):

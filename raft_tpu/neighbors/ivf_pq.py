@@ -413,6 +413,14 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     labels = kmeans_balanced.predict(
         new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
     )
+    group = 512 if index.max_list_size % 512 == 0 else 64
+    total = index.size + int(new_vectors.shape[0])
+    cap = _packing.auto_list_cap(total, index.n_lists, group)
+    # spill BEFORE encoding: residuals are taken against the assigned center
+    labels = _packing.spill_to_cap(
+        new_vectors, index.centers, labels, km_metric, cap,
+        base_counts=index.list_sizes(),
+    )
     dsub = index.codebooks.shape[2]
     resid = _pad_rot(new_vectors - index.centers[labels], index.rot_dim) @ index.rotation.T
     codes = _encode(resid.reshape(new_vectors.shape[0], index.pq_dim, dsub), index.codebooks)
@@ -432,7 +440,6 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     all_codes = jnp.concatenate([old_codes, codes])
     all_ids = jnp.concatenate([old_ids, new_ids])
     all_labels = jnp.concatenate([old_labels, labels])
-    group = 512 if index.max_list_size % 512 == 0 else 64
     list_codes, list_ids = _pack_lists(all_codes, all_ids, all_labels, index.n_lists, group)
     b_sum = _compute_b_sum(
         index.centers, index.rotation, index.codebooks, list_codes, list_ids, index.metric

@@ -1,0 +1,78 @@
+"""Kernel-method Gram matrices (reference
+distance/detail/kernels/kernel_matrices.cuh:153,329,497 — Polynomial, Tanh,
+RBF over GramMatrixBase distance/detail/kernels/gram_matrix.cuh:53).
+
+Each kernel is one pairwise op + elementwise transform — XLA fuses the
+transform into the gemm epilogue, so there is nothing to hand-write here;
+the reference's custom kernels exist because cuBLAS can't fuse epilogues.
+Dense operands only (the reference's CSR paths map to sparse/distance.py's
+densify-by-tiles design the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.ops import distance as dist_mod
+
+
+def linear_kernel(x, y, res: Optional[Resources] = None) -> jax.Array:
+    """K = X·Yᵀ (gram_matrix.cuh evaluate base case)."""
+    res = res or current_resources()
+    return dist_mod.matmul_t(jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+                             res.compute_dtype, "highest")
+
+
+def polynomial_kernel(x, y, degree: int = 3, gain: float = 1.0,
+                      offset: float = 0.0, res: Optional[Resources] = None) -> jax.Array:
+    """K = (gain·X·Yᵀ + offset)^degree (kernel_matrices.cuh:153)."""
+    return (gain * linear_kernel(x, y, res) + offset) ** degree
+
+
+def tanh_kernel(x, y, gain: float = 1.0, offset: float = 0.0,
+                res: Optional[Resources] = None) -> jax.Array:
+    """K = tanh(gain·X·Yᵀ + offset) (kernel_matrices.cuh:329)."""
+    return jnp.tanh(gain * linear_kernel(x, y, res) + offset)
+
+
+def rbf_kernel(x, y, gain: float = 1.0, res: Optional[Resources] = None) -> jax.Array:
+    """K = exp(-gain·‖x-y‖²) (kernel_matrices.cuh:497)."""
+    res = res or current_resources()
+    d2 = dist_mod.pairwise_distance(x, y, "sqeuclidean", res=res)
+    return jnp.exp(-gain * jnp.maximum(d2, 0.0))
+
+
+def masked_l2_nn(
+    x,
+    y,
+    adj,
+    group_idx,
+    sqrt: bool = False,
+    res: Optional[Resources] = None,
+):
+    """Masked fused-L2 nearest neighbor (distance/masked_nn.cuh analog):
+    for each row i of x, the argmin over columns j of y with
+    ``adj[i, group_idx[j]]`` true. Returns ``(min_dists (m,), argmins (m,))``
+    with inf/-1 where a row's mask admits nothing.
+    """
+    res = res or current_resources()
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    adj = jnp.asarray(adj, bool)
+    group_idx = jnp.asarray(group_idx, jnp.int32)
+    if group_idx.shape[0] != y.shape[0]:
+        raise ValueError("group_idx must have one entry per y row")
+    if adj.ndim != 2 or adj.shape[0] != x.shape[0]:
+        raise ValueError("adj must be (x_rows, n_groups)")
+    d = dist_mod.pairwise_distance(x, y, "sqeuclidean", res=res)
+    mask = adj[:, jnp.clip(group_idx, 0, adj.shape[1] - 1)]  # (m, n)
+    d = jnp.where(mask, d, jnp.inf)
+    mins = jnp.min(d, axis=1)
+    args = jnp.where(jnp.isfinite(mins), jnp.argmin(d, axis=1), -1).astype(jnp.int32)
+    if sqrt:
+        mins = jnp.sqrt(jnp.maximum(mins, 0.0))
+    return mins, args

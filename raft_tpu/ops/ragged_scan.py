@@ -282,9 +282,13 @@ def _merge_topk(lv, le, qc_list, pair_qc, pair_slot, chunk_off_qc, qc_nmc,
     q, p = pair_qc.shape
     cand_v = pv[pair_qc, pair_slot].reshape(q, p * kp)
     cand_i = li[pair_qc, pair_slot].reshape(q, p * kp)
-    out_v, sel = lax.top_k(-cand_v, k)
+    kk = min(k, p * kp)  # k may exceed the candidate width; pad like the
+    out_v, sel = lax.top_k(-cand_v, kk)  # gather backend does
     out_i = jnp.take_along_axis(cand_i, sel, axis=1)
     out_v = -out_v
+    if kk < k:
+        out_v = jnp.pad(out_v, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
+        out_i = jnp.pad(out_i, ((0, 0), (0, k - kk)), constant_values=-1)
     out_i = jnp.where(jnp.isfinite(out_v), out_i, -1)
     return out_v, out_i
 
@@ -307,7 +311,9 @@ def ragged_search(
     probes_np = np.asarray(probes)
     lens_np = np.asarray(lens)
     p = probes_np.shape[1]
-    n_lists = list_data.shape[0]
+    n_lists, m = list_data.shape[0], list_data.shape[1]
+    if m % MC:
+        raise ValueError(f"list_data dim 1 must be a multiple of {MC}, got {m}")
 
     q_tile = min(q, 4096)
     out_v, out_i = [], []
@@ -347,7 +353,8 @@ def ragged_scan_topk(
 
     queries_mat: (q, dim) query-side matrix (rotated queries / raw queries).
     list_data: (n_lists, m, dim) entry matrix (decoded PQ / raw vectors),
-      m a multiple of 128.
+      m a multiple of MC (512) — the kernel's block granule; anything less
+      would read out of bounds.
     list_bias: (n_lists, m) per-entry additive term (+inf at padding).
     list_ids: (n_lists, m) source row ids (-1 padding).
     lens: (n_lists,) real entry counts.
@@ -356,7 +363,9 @@ def ragged_scan_topk(
     Scores are ``alpha * <q, x> + bias``; smaller is better. The caller adds
     per-query constants (e.g. ‖q‖²) afterwards.
     """
-    n_lists = list_data.shape[0]
+    n_lists, m = list_data.shape[0], list_data.shape[1]
+    if m % MC:
+        raise ValueError(f"list_data dim 1 must be a multiple of {MC}, got {m}")
     plan = plan_scan(np.asarray(probes), np.asarray(lens), n_lists)
     return _scan_with_plan(queries_mat, plan, list_data, list_bias, list_ids,
                            k, alpha, interpret)

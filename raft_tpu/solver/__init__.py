@@ -1,0 +1,5 @@
+"""Solvers (reference cpp/include/raft/solver/): linear assignment."""
+
+from raft_tpu.solver.linear_assignment import linear_assignment
+
+__all__ = ["linear_assignment"]

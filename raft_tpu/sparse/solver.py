@@ -162,7 +162,14 @@ def connected_components(graph: COO) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("matvec", "n", "max_iters"))
-def _lanczos_impl(matvec, n: int, max_iters: int, v0):
+def _lanczos_impl(matvec, n: int, max_iters: int, v0, U):
+    """One Lanczos run of the deflated operator P·A·P with P = I − U·Uᵀ.
+
+    ``U`` is a traced (n, k) deflation basis — zero columns are no-ops, so
+    callers pad it to a fixed width. It must be traced, NOT closure-captured:
+    jit hashes a static callable by id(), and Python id reuse across
+    successively created closures can silently replay a stale trace.
+    """
     m = max_iters
 
     v0 = v0 / jnp.linalg.norm(v0)
@@ -171,13 +178,18 @@ def _lanczos_impl(matvec, n: int, max_iters: int, v0):
     def step(carry, i):
         V, beta_prev = carry
         v = V[i]
-        w = matvec(v)
+        w = matvec(v - U @ (U.T @ v))
+        w = w - U @ (U.T @ w)
         alpha = jnp.dot(w, v)
         w = w - alpha * v - beta_prev * V[jnp.maximum(i - 1, 0)] * (i > 0)
         # full reorthogonalization against all previous vectors (the
         # reference re-orthogonalizes too, sparse/solver/detail/lanczos.cuh):
         # rows past i are zero so the correction is a masked gemv pair
         w = w - V.T @ (V @ w)
+        # deflation scrub LAST: the reorthogonalization can reintroduce
+        # U-components through drift in earlier rows, and any residue in
+        # v_next compounds exponentially over the run
+        w = w - U @ (U.T @ w)
         beta = jnp.linalg.norm(w)
         v_next = jnp.where(beta > 1e-10, w / jnp.maximum(beta, 1e-30),
                            jnp.zeros_like(w))
@@ -222,11 +234,41 @@ def lanczos_smallest(
     m = int(max_iters) if max_iters else min(n, max(4 * k, 32))
     m = min(m, n)
 
-    v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
-    V, alphas, betas = _lanczos_impl(matvec, n, m, v0)
+    # sequential deflation: a Krylov space from one start vector contains at
+    # most ONE eigenvector per degenerate eigenvalue (e.g. the c-fold zero
+    # eigenvalue of a c-component graph Laplacian), so each eigenpair gets
+    # its own run with previously-found directions projected out of the
+    # operator (the reference restarts its Lanczos the same way,
+    # sparse/solver/detail/lanczos.cuh computeSmallestEigenvectors restarts)
+    found_vals, found_vecs = [], []
+    key = jax.random.key(seed)
+    for j in range(k):
+        key, k_v0 = jax.random.split(key)
+        # fixed-width deflation basis: unfound columns stay zero (no-op)
+        U = jnp.zeros((n, k), jnp.float32)
+        for jj, u in enumerate(found_vecs):
+            U = U.at[:, jj].set(u)
 
-    T = (jnp.diag(alphas) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1], -1))
-    evals, S = jnp.linalg.eigh(T)
-    vecs = V.T @ S[:, :k]
-    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
-    return evals[:k], vecs
+        v0 = jax.random.normal(k_v0, (n,), jnp.float32)
+        v0 = v0 - U @ (U.T @ v0)
+        V, alphas, betas = _lanczos_impl(matvec, n, m, v0, U)
+        # happy breakdown: once some beta ~ 0 the Krylov space is exhausted
+        # and later (alpha, beta) are garbage zeros — push those diagonal
+        # entries to +huge so eigh ranks them last instead of as spurious
+        # smallest eigenvalues
+        good = jnp.concatenate([
+            jnp.array([True]),
+            jnp.cumprod((betas[:-1] > 1e-8).astype(jnp.int32)).astype(bool),
+        ])
+        alphas = jnp.where(good, alphas, 1e30)
+        offd = jnp.where(good[1:], betas[:-1], 0.0)
+        T = jnp.diag(alphas) + jnp.diag(offd, 1) + jnp.diag(offd, -1)
+        evals, S = jnp.linalg.eigh(T)
+        vec = V.T @ S[:, 0]
+        vec = vec / jnp.maximum(jnp.linalg.norm(vec), 1e-30)
+        found_vals.append(evals[0])
+        found_vecs.append(vec)
+    order = jnp.argsort(jnp.stack(found_vals))
+    vals = jnp.stack(found_vals)[order]
+    vecs = jnp.stack(found_vecs, axis=1)[:, order]
+    return vals, vecs

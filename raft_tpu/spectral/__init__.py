@@ -1,0 +1,7 @@
+"""Spectral graph partitioning (reference cpp/include/raft/spectral/):
+partition via Laplacian eigenvectors + k-means, modularity clustering, and
+partition quality analysis."""
+
+from raft_tpu.spectral.partition import analyze_partition, fit_embedding, partition
+
+__all__ = ["analyze_partition", "fit_embedding", "partition"]

@@ -1,0 +1,158 @@
+"""Parity-tail components vs sklearn/scipy oracles: Gram kernels, masked_nn,
+epsilon neighborhood, LAP, spectral partition, ball cover."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+from scipy.spatial.distance import cdist
+from sklearn.metrics import adjusted_rand_score
+from sklearn.metrics.pairwise import polynomial_kernel as sk_poly
+from sklearn.metrics.pairwise import rbf_kernel as sk_rbf
+from sklearn.metrics.pairwise import sigmoid_kernel as sk_sigmoid
+
+from raft_tpu.neighbors import ball_cover
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
+from raft_tpu.ops import kernels
+from raft_tpu.solver import linear_assignment
+from raft_tpu import spectral
+from raft_tpu.sparse.neighbors import knn_graph
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(23)
+
+
+class TestGramKernels:
+    def test_vs_sklearn(self, rng):
+        x = rng.standard_normal((40, 8)).astype(np.float32)
+        y = rng.standard_normal((25, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            kernels.linear_kernel(x, y), x @ y.T, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            kernels.polynomial_kernel(x, y, degree=3, gain=0.5, offset=1.0),
+            sk_poly(x, y, degree=3, gamma=0.5, coef0=1.0), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            kernels.tanh_kernel(x, y, gain=0.1, offset=0.2),
+            sk_sigmoid(x, y, gamma=0.1, coef0=0.2), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            kernels.rbf_kernel(x, y, gain=0.3),
+            sk_rbf(x, y, gamma=0.3), rtol=1e-3, atol=1e-4)
+
+
+class TestMaskedNN:
+    def test_masked_argmin(self, rng):
+        x = rng.standard_normal((20, 4)).astype(np.float32)
+        y = rng.standard_normal((30, 4)).astype(np.float32)
+        groups = rng.integers(0, 3, 30).astype(np.int32)
+        adj = rng.random((20, 3)) > 0.4
+        mins, args = kernels.masked_l2_nn(x, y, adj, groups)
+        d = cdist(x, y, "sqeuclidean")
+        d[~adj[:, groups]] = np.inf
+        want_arg = np.where(np.isfinite(d.min(1)), d.argmin(1), -1)
+        np.testing.assert_array_equal(np.asarray(args), want_arg)
+        finite = np.isfinite(d.min(1))
+        np.testing.assert_allclose(np.asarray(mins)[finite], d.min(1)[finite],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_validation(self, rng):
+        x = rng.standard_normal((4, 2)).astype(np.float32)
+        with pytest.raises(ValueError):
+            kernels.masked_l2_nn(x, x, np.ones((4, 2), bool), np.zeros(3, np.int32))
+
+
+class TestEpsNeighborhood:
+    def test_vs_cdist(self, rng):
+        x = rng.standard_normal((30, 5)).astype(np.float32)
+        y = rng.standard_normal((40, 5)).astype(np.float32)
+        adj, deg = eps_neighbors(x, y, eps=2.0)
+        want = cdist(x, y, "euclidean") <= 2.0
+        np.testing.assert_array_equal(np.asarray(adj), want)
+        np.testing.assert_array_equal(np.asarray(deg), want.sum(1))
+        with pytest.raises(ValueError):
+            eps_neighbors(x, y, eps=0.0)
+
+
+class TestLinearAssignment:
+    @pytest.mark.parametrize("n,kind", [(10, "int"), (60, "int"), (80, "float")])
+    def test_optimal_cost(self, rng, n, kind):
+        if kind == "int":
+            c = rng.integers(0, 100, (n, n)).astype(np.float32)
+        else:
+            c = rng.standard_normal((n, n)).astype(np.float32)
+        assign, total = linear_assignment(c)
+        a = np.asarray(assign)
+        assert sorted(a.tolist()) == list(range(n))  # a permutation
+        ri, ci = linear_sum_assignment(c)
+        want = c[ri, ci].sum()
+        assert float(total) <= want + max(1e-3, 1e-4 * abs(want))
+
+    def test_validation(self, rng):
+        with pytest.raises(ValueError):
+            linear_assignment(rng.standard_normal((3, 4)))
+
+
+class TestSpectral:
+    def test_partition_two_blocks(self, rng):
+        # two dense blocks weakly linked: spectral must split them
+        n = 60
+        X = np.concatenate([
+            rng.standard_normal((n // 2, 4)).astype(np.float32) * 0.3,
+            rng.standard_normal((n // 2, 4)).astype(np.float32) * 0.3 + 8.0,
+        ])
+        g = knn_graph(X, k=6)
+        labels, evals, evecs = spectral.partition(g, 2, seed=1)
+        want = np.repeat([0, 1], n // 2)
+        assert adjusted_rand_score(want, np.asarray(labels)) == 1.0
+        # smallest eigenvalue of a normalized laplacian ~ 0
+        assert abs(float(np.asarray(evals)[0])) < 1e-2
+        cut, cost = spectral.analyze_partition(g, labels)
+        # cross-block edges are few and long; cut must be < total weight / 4
+        total_w = float(np.asarray(g.vals).sum()) / 2
+        assert 0 <= float(cut) < total_w / 4
+
+    def test_validation(self, rng):
+        X = rng.standard_normal((20, 3)).astype(np.float32)
+        g = knn_graph(X, k=4)
+        with pytest.raises(ValueError):
+            spectral.fit_embedding(g, 0)
+
+
+class TestBallCover:
+    def test_knn_query_exact(self, rng):
+        X = rng.standard_normal((800, 6)).astype(np.float32)
+        Q = rng.standard_normal((50, 6)).astype(np.float32)
+        idx = ball_cover.build(X, metric="euclidean")
+        v, i = ball_cover.knn_query(idx, Q, k=7)
+        want = np.argsort(cdist(Q, X), axis=1)[:, :7]
+        got = np.asarray(i)
+        for r in range(50):
+            assert set(got[r]) == set(want[r]), r
+        np.testing.assert_allclose(
+            np.asarray(v), np.sort(cdist(Q, X), axis=1)[:, :7], rtol=1e-3, atol=1e-3)
+
+    def test_all_knn_query_contains_self(self, rng):
+        X = rng.standard_normal((300, 4)).astype(np.float32)
+        idx = ball_cover.build(X, metric="sqeuclidean")
+        v, i = ball_cover.all_knn_query(idx, k=3)
+        # each point finds itself at distance 0 (expanded-form fp can tie
+        # another near-identical point at 0, so check membership, not rank)
+        assert (np.asarray(i) == np.arange(300)[:, None]).any(axis=1).all()
+        np.testing.assert_allclose(np.asarray(v)[:, 0], 0.0, atol=1e-4)
+
+    def test_eps_nn(self, rng):
+        X = rng.standard_normal((400, 5)).astype(np.float32)
+        Q = rng.standard_normal((30, 5)).astype(np.float32)
+        idx = ball_cover.build(X)
+        adj, deg = ball_cover.eps_nn(idx, Q, eps=1.5)
+        want = cdist(Q, X) <= 1.5
+        np.testing.assert_array_equal(np.asarray(adj), want)
+        np.testing.assert_array_equal(np.asarray(deg), want.sum(1))
+
+    def test_validation(self, rng):
+        X = rng.standard_normal((100, 3)).astype(np.float32)
+        with pytest.raises(ValueError):
+            ball_cover.build(X, metric="cosine")
+        idx = ball_cover.build(X)
+        with pytest.raises(ValueError):
+            ball_cover.knn_query(idx, X[:5], k=0)
