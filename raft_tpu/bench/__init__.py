@@ -1,0 +1,6 @@
+"""ANN benchmark harness (reference python/raft-ann-bench + cpp/bench/ann):
+config-driven build/search sweeps reporting QPS, recall, and build time."""
+
+from raft_tpu.bench.runner import run_benchmark
+
+__all__ = ["run_benchmark"]

@@ -1,0 +1,180 @@
+"""Config-driven ANN benchmark runner (reference raft-ann-bench:
+per-algorithm param sweeps producing QPS/recall records,
+docs/source/raft_ann_benchmarks.md:420-438; JSON configs like
+cpp/bench/ann/src/common/conf.hpp).
+
+Usage:
+    python -m raft_tpu.bench.runner config.json -o results.json
+
+Config schema (JSON / dict):
+    {
+      "dataset": {"kind": "blobs", "n": 100000, "dim": 64, "n_queries": 1000,
+                  "n_clusters": 512, "seed": 0}
+               | {"kind": "files", "base": "base.npy", "queries": "q.npy"},
+      "k": 10,
+      "algos": [
+        {"name": "brute_force", "build": {}, "search": [{}]},
+        {"name": "ivf_flat", "build": {"n_lists": 256},
+         "search": [{"n_probes": 8}, {"n_probes": 32}]},
+        {"name": "ivf_pq", "build": {"n_lists": 256, "pq_dim": 32},
+         "search": [{"n_probes": 32, "refine_ratio": 4}]},
+        {"name": "cagra", "build": {"graph_degree": 32},
+         "search": [{"max_iterations": 24}]}
+      ]
+    }
+
+Each (algo, search-params) pair yields one record:
+    {"algo", "build_params", "search_params", "build_s", "qps", "recall"}
+— the reference harness's Latency/QPS/Recall counters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import random as rt_random
+from raft_tpu import stats
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
+
+
+def _force(x):
+    return float(jnp.sum(jnp.where(jnp.isfinite(x), x, 0)))
+
+
+def _load_dataset(spec: Dict):
+    kind = spec.get("kind", "blobs")
+    if kind == "files":
+        base = np.load(spec["base"], mmap_mode="r")
+        queries = np.load(spec["queries"])
+        return jnp.asarray(np.asarray(base, np.float32)), jnp.asarray(queries, jnp.float32)
+    if kind == "blobs":
+        n, dim = int(spec["n"]), int(spec["dim"])
+        q = int(spec.get("n_queries", 1000))
+        data, _, _ = rt_random.make_blobs(
+            int(spec.get("seed", 0)), n + q, dim,
+            n_clusters=int(spec.get("n_clusters", 1024)),
+            cluster_std=float(spec.get("cluster_std", 1.0)),
+            center_box=(-8.0, 8.0),
+        )
+        return data[:n], data[n:]
+    raise ValueError(f"unknown dataset kind {kind!r}")
+
+
+def _timed_qps(run, queries, reps: int) -> float:
+    v, _ = run(queries)
+    _force(v)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        v, _ = run(queries)
+    _force(v)
+    return queries.shape[0] / ((time.perf_counter() - t0) / reps)
+
+
+def _make_algo(name: str, build_params: Dict, dataset, k: int, metric: str):
+    """Returns (build_fn() -> state, search_fn(state, sp, queries) -> (v, i)).
+
+    ``metric`` (the config-level key) flows into every build unless the
+    algo's own build params override it — recall vs ground truth is only
+    meaningful when both rank under the same metric."""
+    build_params = dict(build_params)
+    if name != "cagra":  # cagra build is metric-free (graph construction)
+        build_params.setdefault("metric", metric)
+    if name == "brute_force":
+        return (lambda: brute_force.build(dataset, **build_params),
+                lambda ix, sp, qs: brute_force.search(ix, qs, k, **sp))
+    if name == "ivf_flat":
+        return (lambda: ivf_flat.build(dataset, ivf_flat.IvfFlatParams(**build_params)),
+                lambda ix, sp, qs: ivf_flat.search(ix, qs, k, **sp))
+    if name == "ivf_pq":
+        def search_pq(ix, sp, qs):
+            sp = dict(sp)
+            ratio = int(sp.pop("refine_ratio", 1))
+            if ratio > 1:
+                _, cand = ivf_pq.search(ix, qs, k * ratio, **sp)
+                return refine.refine(dataset, qs, cand, k,
+                                     metric=build_params["metric"])
+            return ivf_pq.search(ix, qs, k, **sp)
+
+        return (lambda: ivf_pq.build(dataset, ivf_pq.IvfPqParams(**build_params)),
+                search_pq)
+    if name == "cagra":
+        def search_cagra(ix, sp, qs):
+            return cagra.search(ix, qs, k, cagra.CagraSearchParams(**sp))
+
+        return (lambda: cagra.build(dataset, cagra.CagraParams(**build_params)),
+                search_cagra)
+    raise ValueError(f"unknown algo {name!r}")
+
+
+def run_benchmark(config: Dict, reps: int = 3) -> List[Dict]:
+    """Run every (algo, search-params) combination; returns records sorted
+    by algo then recall (the QPS@recall curve)."""
+    dataset, queries = _load_dataset(config["dataset"])
+    k = int(config.get("k", 10))
+    metric = config.get("metric", "sqeuclidean")
+
+    gt_v, gt_i = brute_force.search(
+        brute_force.build(dataset, metric=metric),
+        queries, k, select_algo="exact",
+    )
+    _force(gt_v)
+
+    records = []
+    for algo in config["algos"]:
+        name = algo["name"]
+        build_params = dict(algo.get("build", {}))
+        if name == "cagra" and metric != "sqeuclidean":
+            raise ValueError("cagra bench entries support sqeuclidean only")
+        build_fn, search_fn = _make_algo(name, build_params, dataset, k, metric)
+        t0 = time.perf_counter()
+        state = build_fn()
+        # force build completion through whatever arrays the index holds
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                _force(leaf)
+                break
+        build_s = time.perf_counter() - t0
+
+        for sp in algo.get("search", [{}]):
+            v, i = search_fn(state, sp, queries)
+            recall = float(stats.neighborhood_recall(i, gt_i, v, gt_v))
+            qps = _timed_qps(lambda qs: search_fn(state, sp, qs), queries, reps)
+            records.append({
+                "algo": name,
+                "build_params": build_params,
+                "search_params": sp,
+                "build_s": round(build_s, 2),
+                "qps": round(qps, 1),
+                "recall": round(recall, 4),
+                "k": k,
+            })
+    records.sort(key=lambda r: (r["algo"], r["recall"]))
+    return records
+
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("config", help="JSON config path")
+    ap.add_argument("-o", "--output", default=None, help="results JSON path")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+    with open(args.config) as f:
+        config = json.load(f)
+    records = run_benchmark(config, reps=args.reps)
+    text = json.dumps(records, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -143,8 +143,11 @@ def single_linkage(
         k = min(n - 1, int(math.log2(n)) + c)
         graph = knn_graph(X, k, metric=metric, res=res)
         result = mst(graph)
+        from raft_tpu.core.interruptible import check_interrupt
+
         # repair rounds: forest → add min cross-component edges, redo MST
         for _ in range(32):
+            check_interrupt()
             if int(result.n_edges) == n - 1:
                 break
             extra = _cross_component_edges(X, result.color, metric, res)

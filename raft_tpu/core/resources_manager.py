@@ -1,0 +1,56 @@
+"""Pooled per-device Resources for multi-threaded servers (reference
+core/device_resources_manager.hpp:50-95).
+
+The reference hands each server thread a pooled ``device_resources`` with
+round-robin stream assignment so handles aren't rebuilt per request. The
+JAX analog: one cached :class:`Resources` per device, derived PRNG streams
+per checkout (XLA manages streams itself), thread-safe.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+import jax
+
+from raft_tpu.core.resources import Resources
+
+_LOCK = threading.Lock()
+_POOL: dict = {}
+_COUNTER = itertools.count()
+_DEFAULTS: dict = {}
+
+
+def set_resource_defaults(workspace_bytes: Optional[int] = None,
+                          compute_dtype=None) -> None:
+    """Configure defaults applied to pool entries created afterwards
+    (device_resources_manager set_* analog); call before first checkout."""
+    with _LOCK:
+        if workspace_bytes is not None:
+            _DEFAULTS["workspace_bytes"] = int(workspace_bytes)
+        if compute_dtype is not None:
+            _DEFAULTS["compute_dtype"] = compute_dtype
+
+
+def get_resources(device: Optional[jax.Device] = None) -> Resources:
+    """The pooled Resources for ``device`` (default: jax.devices()[0]) —
+    device_resources_manager::get_device_resources analog. Repeated calls
+    return the same instance; its PRNG stream is internally locked, so
+    concurrent threads can share it."""
+    device = device or jax.devices()[0]
+    with _LOCK:
+        res = _POOL.get(device.id)
+        if res is None:
+            res = Resources(devices=[device],
+                            key=jax.random.key(next(_COUNTER)),
+                            **_DEFAULTS)
+            _POOL[device.id] = res
+        return res
+
+
+def clear_pool() -> None:
+    """Drop all pooled entries (tests / reconfiguration)."""
+    with _LOCK:
+        _POOL.clear()

@@ -1,0 +1,36 @@
+"""Profiler trace ranges (reference core/nvtx.hpp:25-90 RAII ranges).
+
+`jax.profiler.TraceAnnotation` is the TPU analog of an NVTX range: spans
+appear on the host timeline of a `jax.profiler.trace(...)` capture. The
+``traced`` decorator is the `RAFT_USING_RANGE`-style entry-point annotation
+used across build/search paths; it costs one context manager per call (not
+per device op) and nothing when no trace is active.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.profiler
+
+
+class trace_range(jax.profiler.TraceAnnotation):
+    """RAII-style range (core/nvtx.hpp range analog):
+
+    with trace_range("ivf_pq::search"):
+        ...
+    """
+
+
+def traced(name: str):
+    """Decorator wrapping a function body in a named trace range."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
@@ -161,6 +162,7 @@ def _search_impl(queries, dataset, norms, filter, k, metric, metric_arg,
     return select_k(cat_vals, k, select_min=select_min, indices=cat_idx, algo="exact")
 
 
+@traced("brute_force::search")
 def search(
     index: BruteForceIndex,
     queries,

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.neighbors import nn_descent as nnd
@@ -224,6 +225,7 @@ def optimize(graph: jax.Array, out_degree: int, n_blocks: int = 1) -> jax.Array:
     return out_ids
 
 
+@traced("cagra::build")
 def build(
     dataset,
     params: CagraParams = CagraParams(),
@@ -363,6 +365,7 @@ def _search_impl(
     return out_d, out_ids
 
 
+@traced("cagra::search")
 def search(
     index: CagraIndex,
     queries,

@@ -1,0 +1,147 @@
+"""CAGRA → HNSW export + CPU-side search (reference neighbors/hnsw.hpp,
+hnsw_types.hpp:41, writer detail/cagra/cagra_serialize.cuh
+serialize_to_hnswlib).
+
+``save_to_hnswlib`` writes the exact base-layer-only hnswlib
+``HierarchicalNSW<float>`` binary layout the reference emits, so the file
+loads in stock hnswlib for CPU serving (the interop story: build on TPU,
+serve anywhere). The writer is native C++ (raft_tpu/native/hnsw_writer.cpp,
+like the reference's) with a pure-Python fallback.
+
+``HnswIndex`` is a self-contained reader + greedy base-layer search — the
+in-repo stand-in for hnswlib's search (hnswlib is not a dependency), and
+the round-trip oracle for the writer.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+_HEADER = struct.Struct("<QQQQQQiiQQQdQ")
+
+
+def save_to_hnswlib(index, path) -> None:
+    """Write a CagraIndex as a base-layer-only hnswlib index file
+    (cagra_serialize.cuh serialize_to_hnswlib byte layout: header, then per
+    element [links_count u32 | graph row u32s | vector f32s | label u64],
+    then a zero u32 per element for the absent upper levels)."""
+    graph = np.ascontiguousarray(np.asarray(index.graph), dtype=np.uint32)
+    data = np.ascontiguousarray(np.asarray(index.dataset), dtype=np.float32)
+    n, degree = graph.shape
+    dim = data.shape[1]
+    if data.shape[0] != n:
+        raise ValueError(f"graph rows {n} != dataset rows {data.shape[0]}")
+    entry = n // 2  # the reference picks size/2 as the entrypoint
+
+    from raft_tpu.native import get_native_lib
+
+    lib = get_native_lib()
+    path = str(path)
+    if lib is not None:
+        import ctypes
+
+        rc = lib.raft_tpu_write_hnsw(
+            path.encode(), n, dim, degree,
+            graph.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            entry,
+        )
+        if rc != 0:
+            raise OSError(f"native hnsw writer failed with code {rc} for {path}")
+        return
+
+    # pure-Python fallback: identical bytes
+    size_per_el = degree * 4 + 4 + dim * 4 + 8
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(0, n, n, size_per_el, size_per_el - 8,
+                             degree * 4 + 4, 1, entry, degree // 2, degree,
+                             degree // 2, 0.42424242, 500))
+        lab = np.empty(1, np.uint64)
+        deg = np.full(1, degree, np.int32)
+        for i in range(n):
+            deg.tofile(f)
+            graph[i].tofile(f)
+            data[i].tofile(f)
+            lab[0] = i
+            lab.tofile(f)
+        np.zeros(n, np.int32).tofile(f)
+
+
+@dataclass
+class HnswIndex:
+    """Parsed base-layer-only hnswlib index (hnsw_types.hpp index analog)."""
+
+    graph: np.ndarray    # (n, degree) uint32
+    dataset: np.ndarray  # (n, dim) float32
+    labels: np.ndarray   # (n,) uint64
+    entrypoint: int
+
+    @classmethod
+    def load(cls, path, dim: int) -> "HnswIndex":
+        """Parse an hnswlib file of known ``dim`` (hnswlib's loader also
+        needs the space dim up front)."""
+        with open(path, "rb") as f:
+            hdr = _HEADER.unpack(f.read(_HEADER.size))
+            (_, max_el, n, size_per_el, label_off, offset_data, max_level,
+             entry, _, max_m0, _, _, _) = hdr
+            degree = (offset_data - 4) // 4
+            if size_per_el != degree * 4 + 4 + dim * 4 + 8:
+                raise ValueError(
+                    f"dim {dim} inconsistent with element size {size_per_el}")
+            raw = np.fromfile(f, np.uint8, n * size_per_el)
+        el = raw.reshape(n, size_per_el)
+        counts = el[:, :4].view(np.int32)[:, 0]
+        graph = np.ascontiguousarray(el[:, 4:offset_data]).view(np.uint32).reshape(n, degree)
+        dat = np.ascontiguousarray(el[:, offset_data:label_off]).view(np.float32).reshape(n, dim)
+        labels = np.ascontiguousarray(el[:, label_off:]).view(np.uint64)[:, 0]
+        if not (counts == degree).all():
+            raise ValueError("variable link counts: not a CAGRA-exported index")
+        return cls(graph, dat, labels, int(entry))
+
+    def knn(self, queries, k: int, ef: int = 64, n_iters: int | None = None):
+        """Greedy best-first base-layer search (hnswlib searchBaseLayerST
+        equivalent, numpy host implementation). Terminates like hnswlib —
+        candidate heap empty or its best exceeds the ef-th result;
+        ``n_iters`` optionally caps expansions (None = uncapped).
+        Returns (distances (q, k), labels (q, k))."""
+        q = np.asarray(queries, np.float32)
+        n, degree = self.graph.shape
+        ef = max(ef, k)
+        if n_iters is None:
+            n_iters = n  # hard safety bound only; termination is heap-driven
+        out_d = np.empty((q.shape[0], k), np.float32)
+        out_i = np.empty((q.shape[0], k), np.int64)
+        for r in range(q.shape[0]):
+            qv = q[r]
+            visited = {self.entrypoint}
+            cand = [(float(((self.dataset[self.entrypoint] - qv) ** 2).sum()),
+                     self.entrypoint)]
+            best = list(cand)
+            for _ in range(n_iters):
+                cand.sort()
+                if not cand:
+                    break
+                d0, u = cand.pop(0)
+                worst = max(best)[0] if len(best) >= ef else np.inf
+                if d0 > worst:
+                    break
+                nbrs = [v for v in self.graph[u] if v not in visited]
+                visited.update(int(v) for v in nbrs)
+                if nbrs:
+                    dv = ((self.dataset[nbrs] - qv) ** 2).sum(axis=1)
+                    for dd, v in zip(dv, nbrs):
+                        if len(best) < ef or dd < max(best)[0]:
+                            best.append((float(dd), int(v)))
+                            cand.append((float(dd), int(v)))
+                            if len(best) > ef:
+                                best.remove(max(best))
+            best.sort()
+            top = best[:k]
+            while len(top) < k:
+                top.append((np.inf, -1))
+            out_d[r] = [t[0] for t in top]
+            out_i[r] = [int(self.labels[t[1]]) if t[1] >= 0 else -1 for t in top]
+        return out_d, out_i

@@ -37,6 +37,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors._packing import pack_lists, unpack_lists
+from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
@@ -152,6 +153,7 @@ def _pack_lists(dataset, row_ids, labels, n_lists: int, group: int = 0):
     return pack_lists(dataset, row_ids, labels, n_lists, group)
 
 
+@traced("ivf_flat::build")
 def build(
     dataset,
     params: IvfFlatParams = IvfFlatParams(),
@@ -201,6 +203,7 @@ def build(
     return IvfFlatIndex(centers, list_data, list_ids, list_norms, params.metric)
 
 
+@traced("ivf_flat::extend")
 def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resources] = None) -> IvfFlatIndex:
     """Add vectors to an existing index (ivf_flat extend,
     detail/ivf_flat_build.cuh extend). Assigns to the fixed centers and
@@ -368,6 +371,7 @@ def _search_impl(
     return map_row_tiles(scan_tile, (queries, qn, probes), q_tile)
 
 
+@traced("ivf_flat::search")
 def search(
     index: IvfFlatIndex,
     queries,

@@ -44,6 +44,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
 from raft_tpu.core.logger import get_logger
+from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
@@ -278,6 +279,7 @@ def _pad_rot(x, rot_dim):
     return jnp.pad(x, ((0, 0), (0, pad))) if pad else x
 
 
+@traced("ivf_pq::build")
 def build(
     dataset,
     params: IvfPqParams = IvfPqParams(),
@@ -398,6 +400,7 @@ def _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, metric):
     return lax.map(one_list, (B, list_codes)) + pad_inf
 
 
+@traced("ivf_pq::extend")
 def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources] = None) -> IvfPqIndex:
     """Encode new vectors with the existing quantizers and repack
     (ivf_pq extend analog)."""
@@ -686,6 +689,7 @@ def _search_impl_pallas(
     return vals, ids, dropped
 
 
+@traced("ivf_pq::search")
 def search(
     index: IvfPqIndex,
     queries,

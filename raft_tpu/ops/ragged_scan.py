@@ -315,10 +315,13 @@ def ragged_search(
     if m % MC:
         raise ValueError(f"list_data dim 1 must be a multiple of {MC}, got {m}")
 
+    from raft_tpu.core.interruptible import check_interrupt
+
     q_tile = min(q, 4096)
     out_v, out_i = [], []
     start = 0
     while start < q:
+        check_interrupt()
         qt = min(q_tile, q - start)
         plan = plan_scan(probes_np[start:start + qt], lens_np, n_lists)
         while plan.t_pad * C_SLOTS * MC * 4 > workspace_bytes and q_tile > 256:

@@ -1,0 +1,83 @@
+"""Out-of-core streaming kNN + batch-k query iterator vs in-core oracle."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import batch_knn, brute_force
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(31)
+
+
+class TestOutOfCore:
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean",
+                                        "inner_product", "cosine"])
+    def test_matches_in_core(self, rng, metric):
+        X = rng.standard_normal((3000, 24)).astype(np.float32)
+        Q = rng.standard_normal((40, 24)).astype(np.float32)
+        v1, i1 = batch_knn.search_out_of_core(X, Q, 8, metric=metric,
+                                              chunk_rows=700)
+        v2, i2 = brute_force.search(brute_force.build(X, metric=metric), Q, 8)
+        # sets per row (ties may reorder across chunk boundaries)
+        for r in range(40):
+            assert set(np.asarray(i1)[r].tolist()) == set(np.asarray(i2)[r].tolist())
+        np.testing.assert_allclose(np.sort(np.asarray(v1), 1),
+                                   np.sort(np.asarray(v2), 1), rtol=1e-4, atol=1e-4)
+
+    def test_memmap_source(self, rng, tmp_path):
+        X = rng.standard_normal((2000, 16)).astype(np.float32)
+        p = tmp_path / "data.npy"
+        np.save(p, X)
+        mm = np.load(p, mmap_mode="r")
+        Q = rng.standard_normal((10, 16)).astype(np.float32)
+        v1, i1 = batch_knn.search_out_of_core(mm, Q, 5, chunk_rows=512)
+        v2, i2 = brute_force.search(brute_force.build(X), Q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_short_final_chunk_and_k_eq_n(self, rng):
+        X = rng.standard_normal((103, 8)).astype(np.float32)
+        Q = rng.standard_normal((6, 8)).astype(np.float32)
+        v, i = batch_knn.search_out_of_core(X, Q, 103, chunk_rows=50)
+        assert sorted(np.asarray(i)[0].tolist()) == list(range(103))
+
+    def test_validation(self, rng):
+        X = rng.standard_normal((50, 4)).astype(np.float32)
+        with pytest.raises(ValueError):
+            batch_knn.search_out_of_core(X, X[:2], 0)
+        with pytest.raises(ValueError):
+            batch_knn.search_out_of_core(X, X[:, :2], 5)
+        with pytest.raises(ValueError):
+            batch_knn.search_out_of_core(X, X[:2], 5, metric="hamming")
+
+
+class TestBatchKQuery:
+    def test_slabs_match_full_search(self, rng):
+        X = rng.standard_normal((500, 12)).astype(np.float32)
+        Q = rng.standard_normal((20, 12)).astype(np.float32)
+        idx = brute_force.build(X)
+        full_v, full_i = brute_force.search(idx, Q, 30)
+        got_v, got_i = [], []
+        for bv, bi in batch_knn.BatchKQuery(idx, Q, batch_size=7):
+            got_v.append(np.asarray(bv))
+            got_i.append(np.asarray(bi))
+            if sum(a.shape[1] for a in got_v) >= 30:
+                break
+        gv = np.concatenate(got_v, axis=1)[:, :30]
+        gi = np.concatenate(got_i, axis=1)[:, :30]
+        np.testing.assert_allclose(gv, np.asarray(full_v), rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(gi, np.asarray(full_i))
+
+    def test_exhausts_index(self, rng):
+        X = rng.standard_normal((40, 6)).astype(np.float32)
+        idx = brute_force.build(X)
+        total = sum(bi.shape[1] for _, bi in
+                    batch_knn.BatchKQuery(idx, X[:3], batch_size=16))
+        assert total == 40
+
+    def test_validation(self, rng):
+        X = rng.standard_normal((40, 6)).astype(np.float32)
+        idx = brute_force.build(X)
+        with pytest.raises(ValueError):
+            batch_knn.BatchKQuery(idx, X[:2], batch_size=0)
