@@ -82,8 +82,8 @@ def _make_algo(name: str, build_params: Dict, dataset, k: int, metric: str):
 
     ``metric`` (the config-level key) flows into every build unless the
     algo's own build params override it — recall vs ground truth is only
-    meaningful when both rank under the same metric."""
-    build_params = dict(build_params)
+    meaningful when both rank under the same metric. Mutates ``build_params``
+    in place so records report the metric actually used."""
     if name != "cagra":  # cagra build is metric-free (graph construction)
         build_params.setdefault("metric", metric)
     if name == "brute_force":
@@ -135,11 +135,7 @@ def run_benchmark(config: Dict, reps: int = 3) -> List[Dict]:
         build_fn, search_fn = _make_algo(name, build_params, dataset, k, metric)
         t0 = time.perf_counter()
         state = build_fn()
-        # force build completion through whatever arrays the index holds
-        for leaf in jax.tree_util.tree_leaves(state):
-            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
-                _force(leaf)
-                break
+        jax.block_until_ready(state)  # full-pytree barrier for build timing
         build_s = time.perf_counter() - t0
 
         for sp in algo.get("search", [{}]):

@@ -5,10 +5,11 @@ docs/source/using_raft_comms.rst and consumed by cuML/cuGraph — each rank
 holds a data shard, algorithms combine local compute with ``comms_t``
 collectives (SURVEY.md §2.9.3). Here each *mesh slot* holds a shard and the
 collectives are XLA collectives over ICI/DCN, issued from ``shard_map``
-library code (not demo code): sharded exact kNN with cross-shard top-k merge
-and data-sharded k-means.
+library code (not demo code): sharded exact kNN with cross-shard top-k merge,
+data-sharded k-means, and multi-device IVF-Flat (global quantizer + local
+per-device indexes, the raft-dask one-model-per-worker architecture).
 """
 
-from raft_tpu.distributed import brute_force, kmeans
+from raft_tpu.distributed import brute_force, ivf_flat, kmeans
 
-__all__ = ["brute_force", "kmeans"]
+__all__ = ["brute_force", "ivf_flat", "kmeans"]

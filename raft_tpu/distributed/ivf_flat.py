@@ -1,0 +1,142 @@
+"""Multi-device IVF-Flat: globally trained centers, per-device row shards,
+cross-shard top-k merge (the raft-dask MNMG model: one model per worker,
+collectives for the merge — python/raft-dask/raft_dask/common/comms.py:40,
+docs/source/using_raft_comms.rst; merge analog knn_merge_parts.cuh:140).
+
+Architecture. The coarse quantizer is trained ONCE with the data-sharded
+k-means (distributed/kmeans.py — psum over shards), so every shard probes
+the same lists. Each device then owns a normal :class:`IvfFlatIndex` over
+its row range (list ids offset to global row ids) — local list sizes differ
+per shard, which is exactly why the reference keeps one index per worker
+rather than one sharded container. Search fans the query batch to every
+device (XLA dispatches the per-shard searches concurrently), then one
+gather + exact re-select merges the (world·k) candidates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.neighbors import ivf_flat as sl  # single-device library
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndex, IvfFlatParams
+
+
+@dataclass
+class ShardedIvfFlatIndex:
+    """Per-device local indexes sharing one coarse quantizer."""
+
+    shards: List[IvfFlatIndex]   # one per device, list_ids hold GLOBAL rows
+    devices: List[jax.Device]
+    metric: str
+    n_total: int
+
+    @property
+    def n_lists(self) -> int:
+        return self.shards[0].n_lists
+
+    @property
+    def dim(self) -> int:
+        return self.shards[0].dim
+
+
+def build(
+    dataset,
+    params: IvfFlatParams = IvfFlatParams(),
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+) -> ShardedIvfFlatIndex:
+    """Train global centers (distributed k-means over the mesh), then build
+    each device's local index over its row range."""
+    res = res or current_resources()
+    comms = comms or make_comms()
+    devices = list(comms.mesh.devices.reshape(-1))
+    world = len(devices)
+    dataset = jnp.asarray(dataset).astype(jnp.float32)
+    n, dim = dataset.shape
+    if params.n_lists * world > n:
+        raise ValueError(
+            f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
+
+    # --- global coarse quantizer: data-sharded balanced k-means ------------
+    work = dataset
+    if params.metric == "cosine":
+        work = work / jnp.maximum(
+            jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+    km_metric = ("inner_product" if params.metric in ("cosine", "inner_product")
+                 else "sqeuclidean")
+    from raft_tpu.distributed import kmeans as dkm
+    from raft_tpu.cluster.kmeans import KMeansParams
+
+    out, _ = dkm.fit(
+        work, KMeansParams(n_clusters=params.n_lists,
+                           max_iter=params.kmeans_n_iters,
+                           seed=params.seed),
+        comms=comms,
+    )
+    centers = out.centroids
+
+    # --- per-device local indexes over contiguous row ranges ---------------
+    from raft_tpu.neighbors import _packing
+
+    bounds = [round(i * n / world) for i in range(world + 1)]
+    group = params.group_size or _packing.auto_group_size(
+        bounds[1] - bounds[0], params.n_lists)
+    shards = []
+    for d, dev in enumerate(devices):
+        lo, hi = bounds[d], bounds[d + 1]
+        rows = work[lo:hi]
+        labels = kmeans_balanced.predict(
+            rows, centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric),
+            res=res,
+        )
+        cap = params.list_size_cap
+        if cap < 0:
+            cap = _packing.auto_list_cap(hi - lo, params.n_lists, group)
+        if cap:
+            labels = _packing.spill_to_cap(rows, centers, labels, km_metric, cap)
+        list_data, list_ids = sl._pack_lists(rows,
+                                             jnp.arange(lo, hi, dtype=jnp.int32),
+                                             labels, params.n_lists, group)
+        list_norms = None
+        if params.metric in ("sqeuclidean", "euclidean"):
+            from raft_tpu.ops import distance as dist_mod
+
+            list_norms = dist_mod.sqnorm(list_data, axis=2)
+        local = IvfFlatIndex(centers, list_data, list_ids, list_norms,
+                             params.metric)
+        shards.append(jax.device_put(local, dev))
+    return ShardedIvfFlatIndex(shards, devices, params.metric, n)
+
+
+def search(
+    index: ShardedIvfFlatIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fan out, search every shard, merge the (world·k) candidates exactly.
+    Returns global (distances (q, k), row ids (q, k))."""
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    parts = []
+    for shard, dev in zip(index.shards, index.devices):
+        q_dev = jax.device_put(queries, dev)
+        parts.append(sl.search(shard, q_dev, k, n_probes=n_probes, res=res))
+    # merge on the first device (knn_merge_parts analog)
+    vals = jnp.concatenate([jax.device_put(v, index.devices[0]) for v, _ in parts], axis=1)
+    ids = jnp.concatenate([jax.device_put(i, index.devices[0]) for _, i in parts], axis=1)
+    select_min = index.metric != "inner_product"
+    key = vals if select_min else -vals
+    key = jnp.where(ids >= 0, key, jnp.inf)
+    top, sel = jax.lax.top_k(-key, k)
+    out_i = jnp.take_along_axis(ids, sel, axis=1)
+    out_v = jnp.take_along_axis(vals, sel, axis=1)
+    return out_v, out_i

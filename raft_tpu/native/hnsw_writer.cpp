@@ -39,7 +39,10 @@ int raft_tpu_write_hnsw(const char* path,
       static_cast<uint64_t>(degree) * 4 + 4 + static_cast<uint64_t>(dim) * 4 + 8;
   const uint64_t label_offset = size_data_per_element - 8;
   const uint64_t offset_data = static_cast<uint64_t>(degree) * 4 + 4;
-  const int32_t max_level = 1;
+  // 0, not the reference's 1: a base-layer-only index with max_level=0
+  // skips upper-level traversal in STOCK hnswlib (the reference's 1 only
+  // works with its patched base_layer_only loader)
+  const int32_t max_level = 0;
   const int32_t entry = static_cast<int32_t>(entrypoint);
   const uint64_t max_m = degree / 2;
   const uint64_t max_m0 = degree;

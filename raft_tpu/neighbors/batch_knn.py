@@ -92,7 +92,6 @@ def search_out_of_core(
         chunk_rows = int(max(k, min(n, res.workspace_bytes // max(1, (dim + q) * 4))))
     qn = dist_mod.sqnorm(queries)
 
-    select_min = True
     best_v = jnp.full((queries.shape[0], k),
                       jnp.inf, jnp.float32)
     best_i = jnp.full((queries.shape[0], k), -1, jnp.int32)

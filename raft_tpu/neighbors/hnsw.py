@@ -2,10 +2,11 @@
 hnsw_types.hpp:41, writer detail/cagra/cagra_serialize.cuh
 serialize_to_hnswlib).
 
-``save_to_hnswlib`` writes the exact base-layer-only hnswlib
-``HierarchicalNSW<float>`` binary layout the reference emits, so the file
-loads in stock hnswlib for CPU serving (the interop story: build on TPU,
-serve anywhere). The writer is native C++ (raft_tpu/native/hnsw_writer.cpp,
+``save_to_hnswlib`` writes the base-layer-only hnswlib
+``HierarchicalNSW<float>`` binary layout the reference emits — with one
+deliberate deviation: ``max_level`` is 0, not 1, so the file loads in STOCK
+hnswlib (the reference's 1 requires its patched ``base_layer_only`` loader;
+0 works in both). The interop story: build on TPU, serve anywhere. The writer is native C++ (raft_tpu/native/hnsw_writer.cpp,
 like the reference's) with a pure-Python fallback.
 
 ``HnswIndex`` is a self-contained reader + greedy base-layer search — the
@@ -57,7 +58,7 @@ def save_to_hnswlib(index, path) -> None:
     size_per_el = degree * 4 + 4 + dim * 4 + 8
     with open(path, "wb") as f:
         f.write(_HEADER.pack(0, n, n, size_per_el, size_per_el - 8,
-                             degree * 4 + 4, 1, entry, degree // 2, degree,
+                             degree * 4 + 4, 0, entry, degree // 2, degree,
                              degree // 2, 0.42424242, 500))
         lab = np.empty(1, np.uint64)
         deg = np.full(1, degree, np.int32)
