@@ -116,10 +116,22 @@ def _init_plus_plus(key, X, weights, n_clusters):
     """kmeans++ seeding (init_plus_plus analog, cluster/kmeans.cuh:584):
     first center uniform; each next sampled ∝ weight·D²(x) to chosen centers.
 
-    One `fori_loop` iteration per center — n_clusters sequential (n,dim)
-    distance sweeps, each a single fused gemm+argmin on the MXU.
+    One `fori_loop` iteration per center is a full (n, dim) distance sweep;
+    at the reference-typical n_lists of 1024–65536 that is k sequential
+    passes over the whole dataset, so seeding runs on a size-capped random
+    subsample (the reference trains on sampled trainsets for the same
+    reason, ivf_flat_types.hpp:55 kmeans_trainset_fraction): Lloyd
+    iterations afterwards see the full data, and ++-on-a-sample loses
+    nothing measurable at these sizes.
     """
     n = X.shape[0]
+    max_rows = max(4 * n_clusters, 16384)
+    if n > max_rows:
+        ks, key = jax.random.split(key)
+        rows = jax.random.choice(ks, n, (max_rows,), replace=False)
+        X = X[rows]
+        weights = weights[rows] if weights is not None else None
+        n = max_rows
     k0, key = jax.random.split(key)
     first = jax.random.randint(k0, (), 0, n)
     centers = jnp.zeros((n_clusters, X.shape[1]), X.dtype).at[0].set(X[first])

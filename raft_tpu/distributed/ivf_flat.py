@@ -81,6 +81,12 @@ def build(
         comms=comms,
     )
     centers = out.centroids
+    if params.metric in ("cosine", "inner_product"):
+        # the data-sharded trainer is plain L2 k-means; restore the spherical
+        # invariant the single-device build keeps (IvfFlatIndex docstring:
+        # cosine centers are stored L2-normalized)
+        centers = centers / jnp.maximum(
+            jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
 
     # --- per-device local indexes over contiguous row ranges ---------------
     from raft_tpu.neighbors import _packing

@@ -165,3 +165,23 @@ def rsvd(a, k: int, p: int = 10, n_iter: int = 4, key: Optional[jax.Array] = Non
     ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
     u = q @ ub
     return sign_flip(u[:, :k]), s[:k], vt[:k]
+
+
+def lanczos(matvec_or_matrix, n_components: int, n=None, max_iters: int = 0,
+            seed: int = 0):
+    """Smallest eigenpairs of a symmetric operator via deflated Lanczos
+    (reference linalg/lanczos.cuh — same engine as the sparse-tier solver,
+    which accepts dense matvecs; re-exported here for the dense linalg
+    surface). Accepts a CSR matrix, a (n, n) dense matrix, or a matvec
+    callable."""
+    import jax.numpy as jnp
+
+    from raft_tpu.sparse.solver import lanczos_smallest
+    from raft_tpu.sparse.types import CSR
+
+    a = matvec_or_matrix
+    if isinstance(a, CSR) or callable(a):
+        return lanczos_smallest(a, n_components, n=n, max_iters=max_iters, seed=seed)
+    dense = jnp.asarray(a)
+    return lanczos_smallest(lambda v: dense @ v, n_components,
+                            n=dense.shape[0], max_iters=max_iters, seed=seed)

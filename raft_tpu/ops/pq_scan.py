@@ -77,7 +77,7 @@ def group_probed_pairs(probes, n_lists: int, qpl_cap: int) -> Tuple[jax.Array, j
 
 
 def _pq_scan_kernel(luts_ref, codes_ref, bsum_ref, out_ref, *, nc, s_chunk):
-    sc = pl.program_id(2)
+    sc = pl.program_id(3)
     ck = s_chunk * nc
     mb = codes_ref.shape[2]
     codes = codes_ref[0].astype(jnp.int32)  # (s_chunk, mb)
@@ -117,6 +117,7 @@ def pq_scan(luts_grouped, codes_t, b_sum, nc: int, interpret: bool = False) -> j
     _, s, m = codes_t.shape
     assert f == s * nc, (f, s, nc)
     assert m % 128 == 0, f"max_list_size {m} must be 128-aligned for the kernel"
+    assert qpl % 16 == 0, f"qpl {qpl} must be 16-aligned (query-block tiling)"
     # chunk subspaces so the one-hot block stays ~≤ 2048 rows …
     s_chunk = max(1, min(s, 2048 // nc))
     while s % s_chunk:
@@ -125,25 +126,30 @@ def pq_scan(luts_grouped, codes_t, b_sum, nc: int, interpret: bool = False) -> j
     ck = s_chunk * nc
     # … and tile the list dim so it stays ≤ 1024 columns (the (ck, m_block)
     # bf16 one-hot must fit VMEM: unblocked m of 7K+ entries at pq_bits=8 is
-    # ~30 MB and faults the chip)
+    # ~30 MB and faults the chip) — and the query dim to ≤ 256 rows (skew
+    # escalation can push qpl past 1000, overflowing the fp32 output block)
     m_block = min(m, 1024)
     while m % m_block:
         m_block -= 128
     n_mb = m // m_block
+    q_block = min(qpl, 256)
+    while qpl % q_block:
+        q_block -= 16
+    n_qb = qpl // q_block
 
-    # grid order (l, mb, sc): sc innermost keeps the revisited fp32 output
-    # block resident across its accumulation steps
-    grid = (L, n_mb, n_sc)
+    # grid order (l, qb, mb, sc): sc innermost keeps the revisited fp32
+    # output block resident across its accumulation steps
+    grid = (L, n_qb, n_mb, n_sc)
     return pl.pallas_call(
         functools.partial(_pq_scan_kernel, nc=nc, s_chunk=s_chunk),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, qpl, ck), lambda l, mb, sc: (l, 0, sc), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_chunk, m_block), lambda l, mb, sc: (l, sc, mb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q_block, ck), lambda l, qb, mb, sc: (l, qb, sc), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_chunk, m_block), lambda l, qb, mb, sc: (l, sc, mb), memory_space=pltpu.VMEM),
             # (L, 1, m) so the block's last-two dims equal the array's
-            pl.BlockSpec((1, 1, m_block), lambda l, mb, sc: (l, 0, mb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, m_block), lambda l, qb, mb, sc: (l, 0, mb), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, qpl, m_block), lambda l, mb, sc: (l, 0, mb), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, q_block, m_block), lambda l, qb, mb, sc: (l, qb, mb), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((L, qpl, m), jnp.float32),
         interpret=interpret,
     )(luts_grouped, codes_t, b_sum.reshape(L, 1, m))
