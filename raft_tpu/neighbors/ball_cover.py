@@ -28,7 +28,7 @@ from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.neighbors._packing import pack_lists
 from raft_tpu.ops import distance as dist_mod
 
-SUPPORTED_METRICS = ("sqeuclidean", "euclidean")
+SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "haversine")
 _GROUP = 32
 
 
@@ -86,10 +86,15 @@ def build(
     key = jax.random.key(seed)
     rows = jax.random.choice(key, n, (L,), replace=False)
     landmarks = dataset[rows]
-    d2 = dist_mod.pairwise_distance(dataset, landmarks, "sqeuclidean", res=res)
-    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
-    dist_to_lm = jnp.sqrt(jnp.maximum(
-        jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0], 0.0))
+    if metric == "haversine":
+        d = dist_mod.haversine(dataset, landmarks)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+        dist_to_lm = jnp.take_along_axis(d, labels[:, None], axis=1)[:, 0]
+    else:
+        d2 = dist_mod.pairwise_distance(dataset, landmarks, "sqeuclidean", res=res)
+        labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        dist_to_lm = jnp.sqrt(jnp.maximum(
+            jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0], 0.0))
 
     row_ids = jnp.arange(n, dtype=jnp.int32)
     list_data, list_ids = pack_lists(dataset, row_ids, labels, L, _GROUP)
@@ -98,15 +103,22 @@ def build(
     return BallCoverIndex(landmarks, list_data, list_ids, radii, metric)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "batch"))
-def _query_impl(queries, landmarks, list_data, list_ids, radii, k: int, batch: int):
+@functools.partial(jax.jit, static_argnames=("k", "batch", "haversine"))
+def _query_impl(queries, landmarks, list_data, list_ids, radii, k: int,
+                batch: int, haversine: bool = False):
+    """Ranking distances are squared-L2 internally (kth compared as sqrt)
+    for the Euclidean family, and true great-circle radians for haversine —
+    both satisfy the triangle inequality the landmark bound needs."""
     q, dim = queries.shape
     L, m, _ = list_data.shape
     nb = -(-L // batch)
 
-    d_ql = jnp.sqrt(jnp.maximum(
-        dist_mod._expanded_distance(queries, landmarks, "sqeuclidean", None, "highest"),
-        0.0))
+    if haversine:
+        d_ql = dist_mod.haversine(queries, landmarks)
+    else:
+        d_ql = jnp.sqrt(jnp.maximum(
+            dist_mod._expanded_distance(queries, landmarks, "sqeuclidean", None, "highest"),
+            0.0))
     lb = jnp.maximum(d_ql - radii[None, :], 0.0)        # (q, L)
     order = jnp.argsort(lb, axis=1).astype(jnp.int32)   # per-query visit order
     lb_sorted = jnp.take_along_axis(lb, order, axis=1)
@@ -122,7 +134,10 @@ def _query_impl(queries, landmarks, list_data, list_ids, radii, k: int, batch: i
 
     def cond(state):
         best_v, _, b = state
-        kth = jnp.sqrt(jnp.maximum(best_v[:, k - 1], 0.0))
+        if haversine:
+            kth = best_v[:, k - 1]
+        else:
+            kth = jnp.sqrt(jnp.maximum(best_v[:, k - 1], 0.0))
         nxt = lb_sorted[:, jnp.minimum(b * batch, nb * batch - 1)]
         return (b < nb) & jnp.any((nxt <= kth) | ~jnp.isfinite(kth))
 
@@ -131,10 +146,18 @@ def _query_impl(queries, landmarks, list_data, list_ids, radii, k: int, batch: i
         lists = lax.dynamic_slice_in_dim(order, b * batch, batch, axis=1)  # (q, B)
         cand = list_data[lists]                       # (q, B, m, dim)
         ids = list_ids[lists].reshape(q, batch * m)
-        nrm = norms[lists].reshape(q, batch * m)
-        ip = jnp.einsum("qd,qbmd->qbm", queries, cand,
-                        preferred_element_type=jnp.float32).reshape(q, batch * m)
-        d2 = jnp.maximum(qn[:, None] + nrm - 2.0 * ip, 0.0)
+        if haversine:
+            flat = cand.reshape(q, batch * m, dim)
+            sin_dlat = jnp.sin(0.5 * (flat[:, :, 0] - queries[:, None, 0]))
+            sin_dlon = jnp.sin(0.5 * (flat[:, :, 1] - queries[:, None, 1]))
+            a = (sin_dlat ** 2
+                 + jnp.cos(queries[:, None, 0]) * jnp.cos(flat[:, :, 0]) * sin_dlon ** 2)
+            d2 = 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+        else:
+            nrm = norms[lists].reshape(q, batch * m)
+            ip = jnp.einsum("qd,qbmd->qbm", queries, cand,
+                            preferred_element_type=jnp.float32).reshape(q, batch * m)
+            d2 = jnp.maximum(qn[:, None] + nrm - 2.0 * ip, 0.0)
         d2 = jnp.where(ids >= 0, d2, jnp.inf)
         allv = jnp.concatenate([best_v, d2], axis=1)
         alli = jnp.concatenate([best_i, ids], axis=1)
@@ -165,7 +188,8 @@ def knn_query(
     if not 0 < k <= index.size:
         raise ValueError(f"k={k} out of range for {index.size} points")
     v, i = _query_impl(queries, index.landmarks, index.list_data,
-                       index.list_ids, index.radii, int(k), int(batch))
+                       index.list_ids, index.radii, int(k), int(batch),
+                       index.metric == "haversine")
     if index.metric == "euclidean":
         v = jnp.sqrt(jnp.maximum(v, 0.0))
     return jnp.where(i >= 0, v, jnp.inf), i

@@ -156,3 +156,27 @@ class TestBallCover:
         idx = ball_cover.build(X)
         with pytest.raises(ValueError):
             ball_cover.knn_query(idx, X[:5], k=0)
+
+    def test_haversine_knn_exact(self, rng):
+        # (lat, lon) radians on the sphere
+        lat = rng.uniform(-1.2, 1.2, 500)
+        lon = rng.uniform(-3.1, 3.1, 500)
+        X = np.stack([lat, lon], 1).astype(np.float32)
+        Q = X[:20] + rng.normal(0, 0.01, (20, 2)).astype(np.float32)
+        idx = ball_cover.build(X, metric="haversine")
+        v, i = ball_cover.knn_query(idx, Q, k=5)
+
+        def hav(a, b):
+            sdl = np.sin(0.5 * (b[:, 0][None] - a[:, 0][:, None]))
+            sdo = np.sin(0.5 * (b[:, 1][None] - a[:, 1][:, None]))
+            x = sdl**2 + np.cos(a[:, 0])[:, None] * np.cos(b[:, 0])[None] * sdo**2
+            return 2 * np.arcsin(np.sqrt(np.clip(x, 0, 1)))
+
+        d = hav(Q.astype(np.float64), X.astype(np.float64))
+        want = np.argsort(d, axis=1)[:, :5]
+        got = np.asarray(i)
+        for r in range(20):
+            if set(got[r]) != set(want[r]):
+                # fp ties: distance profile must agree
+                np.testing.assert_allclose(np.asarray(v)[r], np.sort(d[r])[:5],
+                                           rtol=1e-3, atol=1e-4)
