@@ -90,6 +90,10 @@ def run_suite():
     import jax
     import jax.numpy as jnp
 
+    from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # round-3: cold XLA compiles dominated builds
+
     from raft_tpu import random as rt_random
     from raft_tpu import stats
     from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine

@@ -98,21 +98,27 @@ def _balanced_em(X, centers0, key, n_clusters, n_iters, metric, threshold, works
         centers, sizes = calc_centers_and_sizes(X, labels, n_clusters, centers)
         fsizes = sizes.astype(jnp.float32)
         small = fsizes < threshold * average
-        # donors: n_clusters distinct points drawn uniformly from rows whose
-        # cluster is at least average-sized (the do/while at :462-465)
-        eligible = fsizes[labels] >= average
+        # Reseed by SPLITTING the largest clusters: the i-th underweight
+        # center moves to the midpoint between the i-th largest cluster's
+        # center and one of its random members, so the next E-step hands it
+        # roughly half of that cluster. (Round-3 fix: the previous
+        # teleport-onto-a-random-point reseed left persistent singleton
+        # clusters on spread-out data — a center sitting exactly on a point
+        # captures only that point and re-triggers forever. The reference's
+        # adjust_centers pull, :474-481, avoids this with its
+        # mesocluster-hierarchy init; splitting is the SPMD-friendly analog.)
         u = jax.random.uniform(jax.random.fold_in(key, i), (n,))
-        _, donors = lax.top_k(jnp.where(eligible, u, -1.0), n_clusters)
+        maxu = jax.ops.segment_max(u, labels, num_segments=n_clusters)
+        is_rep = u >= maxu[labels]
+        rep = jax.ops.segment_min(
+            jnp.where(is_rep, jnp.arange(n, dtype=jnp.int32), n), labels,
+            num_segments=n_clusters)                 # random member / cluster
+        donor_order = jnp.argsort(-fsizes)           # largest first
         rank = jnp.clip(jnp.cumsum(small.astype(jnp.int32)) - 1, 0, n_clusters - 1)
-        donor_pts = X[donors[rank]]
-        # Deviation from the reference's weighted pull (wc=min(csize,7),
-        # :474-481): a 1/(wc+1) drift is undone by the next M-step snapping the
-        # center back to its members' mean — the reference compensates with its
-        # mesocluster-hierarchy init (density-proportional seeding,
-        # build_hierarchical :1000+). Without that host-side hierarchy we
-        # teleport instead: the relocated center's Voronoi cell lands inside
-        # the donor cluster, so the E/M steps keep it there.
-        centers = jnp.where(small[:, None], donor_pts, centers)
+        donor = donor_order[rank]
+        donor_pt = X[jnp.clip(rep[donor], 0, n - 1)]
+        c_new = 0.5 * (centers[donor] + donor_pt)
+        centers = jnp.where(small[:, None], c_new, centers)
         if metric == "inner_product":
             # IP/cosine EM drifts toward zero centers without renormalization
             # (detail/kmeans_balanced.cuh:656-668)
@@ -174,7 +180,10 @@ def _fit_full(X, n_clusters, params, res):
         raise ValueError(f"n_clusters={n_clusters} > n_samples={n}")
     key = jax.random.key(params.seed)
     k_init, k_adjust = jax.random.split(key)
-    rows = jax.random.choice(k_init, n, (n_clusters,), replace=False)
+    # with-replacement init: the odd duplicate seed collapses to an empty
+    # cluster that the balancing reseed immediately relocates, and it avoids
+    # choice(replace=False)'s O(n log n) permutation compile (round 3)
+    rows = jax.random.randint(k_init, (n_clusters,), 0, n)
     centers0 = X[rows].astype(jnp.float32)
     with use_resources(res):
         return _balanced_em(

@@ -52,6 +52,13 @@ class LinkageResult:
         src = np.asarray(self.mst_src)
         dst = np.asarray(self.mst_dst)
         h = np.asarray(self.mst_heights)
+        if (src < 0).any() or (dst < 0).any() or not np.isfinite(h).all():
+            # -1/inf slots mean the spanning tree is a forest — a dendrogram
+            # does not exist (ADVICE.md round-2: corrupt Z emitted silently)
+            raise ValueError(
+                "spanning tree is a forest (disconnected data); "
+                "no dendrogram exists"
+            )
         n = src.shape[0] + 1
         # roots in parent-space are scipy cluster ids (leaves 0..n-1,
         # internal node for merge i = n+i)
@@ -158,6 +165,14 @@ def single_linkage(
                 (n, n),
             )
             result = mst(graph)
+        if int(result.n_edges) != n - 1:
+            # still a forest after the repair budget: surface it instead of
+            # mislabeling (ADVICE.md round-2 — n_clusters would misreport)
+            raise RuntimeError(
+                f"connectivity repair left {n - int(result.n_edges)} "
+                "components (non-finite distances?); use "
+                "connectivity='pairwise' or a larger c"
+            )
 
     return _cut(result, n, int(n_clusters))
 

@@ -1,0 +1,201 @@
+"""Shared shard-preparation helpers for the distributed IVF indexes.
+
+One implementation of the row-sharding, SPMD assign+spill phase, padded
+list sizing, local dense fallback scan, and cross-shard merge — ivf_flat
+and ivf_pq compose these (round-3 review: the two modules had begun to
+drift apart with four copies of this logic)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.neighbors import _packing
+from raft_tpu.ops.select_k import select_k
+
+
+def shard_rows(work, comms):
+    """Pad rows to a multiple of the communicator size and place them with a
+    leading (world,) sharded dimension. Padded rows carry global id -1."""
+    world = comms.size
+    n, dim = work.shape
+    rows_per = -(-n // world)
+    n_pad = rows_per * world
+    work_p = jnp.pad(work, ((0, n_pad - n), (0, 0)))
+    gids = jnp.where(jnp.arange(n_pad) < n, jnp.arange(n_pad), -1).astype(jnp.int32)
+    work_sh = jax.device_put(
+        work_p.reshape(world, rows_per, dim),
+        comms.sharding(comms.axis, None, None))
+    gids_sh = jax.device_put(
+        gids.reshape(world, rows_per), comms.sharding(comms.axis, None))
+    return work_sh, gids_sh, rows_per
+
+
+def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
+    """SPMD assign + spill per shard. Returns (labels_sh, counts_np) where
+    labels use the sentinel ``n_lists`` for padded rows (dropped at pack)
+    and counts_np (world, n_lists) counts real rows only.
+
+    The spill itself runs over ALL local rows (padding included) so its
+    rank/offset bookkeeping matches the labels array; the ≤ world-1 padded
+    zero rows behave as ordinary data during the spill and are exiled to
+    the sentinel afterwards."""
+
+    def body(rows, ids):
+        rows, ids = rows[0], ids[0]
+        _, labels = kmeans_balanced._assign(rows, centers, km_metric)
+        if cap:
+            counts_all = jnp.bincount(labels, length=n_lists)
+            labels = _packing._spill_core(
+                rows, centers, labels, km_metric, cap,
+                jnp.zeros(n_lists, jnp.int32), counts_all, 65536)
+        valid = ids >= 0
+        counts = jax.ops.segment_sum(
+            valid.astype(jnp.int32), jnp.where(valid, labels, 0),
+            num_segments=n_lists).astype(jnp.int32)
+        labels = jnp.where(valid, labels, n_lists)
+        return labels[None], counts[None]
+
+    axis = comms.axis
+    fn = jax.jit(jax.shard_map(
+        body, mesh=comms.mesh,
+        in_specs=(P(axis, None, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis, None)),
+        check_vma=False,
+    ))
+    labels_sh, counts_sh = fn(work_sh, gids_sh)
+    return labels_sh, np.asarray(counts_sh)
+
+
+def round_mls(max_count: int, group: int) -> int:
+    """Common padded list size: group-aligned; power-of-two 512-chunks when
+    the strip backend's granule is in play (ops/strip_scan.py)."""
+    mls = max(group, -(-max_count // group) * group)
+    if group == 512:
+        chunks = mls // group
+        mls = group * (1 << (chunks - 1).bit_length())
+    return mls
+
+
+def scatter_pack(labels, order_payloads, n_lists: int, mls: int):
+    """Scatter sorted rows into (n_lists, mls, ...) blocks; sentinel labels
+    (== n_lists) scatter out of range and are dropped.
+
+    labels: (rp,) with sentinel for invalid rows. order_payloads: list of
+    (init_array, values) pairs already in label-sorted order."""
+    rp = labels.shape[0]
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    counts = jnp.bincount(jnp.minimum(labels, n_lists), length=n_lists + 1)
+    offsets = (jnp.cumsum(counts) - counts)[:n_lists]
+    off_of = jnp.where(sorted_labels < n_lists,
+                       offsets[jnp.minimum(sorted_labels, n_lists - 1)], 0)
+    pos = jnp.arange(rp, dtype=jnp.int32) - off_of.astype(jnp.int32)
+    tgt_l = jnp.minimum(sorted_labels, n_lists)
+    outs = []
+    for init, values in order_payloads:
+        outs.append(init.at[tgt_l, pos].set(values[order], mode="drop"))
+    return outs
+
+
+def merge_shards(vals, ids, k: int, axis: str):
+    """Cross-shard candidate exchange + exact re-select (knn_merge_parts
+    analog, reference neighbors/detail/knn_merge_parts.cuh:140)."""
+    all_vals = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
+    all_ids = jax.lax.all_gather(ids, axis, axis=1, tiled=True)
+    key = jnp.where(all_ids >= 0, all_vals, jnp.inf)
+    out_v, sel = select_k(key, k, select_min=True)
+    out_i = jnp.take_along_axis(all_ids, sel, axis=1)
+    return jnp.where(out_i >= 0, out_v, jnp.inf), out_i
+
+
+@functools.lru_cache(maxsize=64)
+def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha):
+    """shard_map'd search tile shared by the distributed IVF indexes: local
+    scan (strip kernel, or dense gather for sub-512 lists) on the shard's
+    (data, ids, bias) triple + all_gather merge. Bias carries +inf at
+    padding (precomputed at build)."""
+    from raft_tpu.ops.strip_scan import _strip_tile_body
+
+    def body(queries, probes, qids, strip_list, pair_strip, pair_slot,
+             data, ids_arr, bias):
+        ld, li, b = data[0], ids_arr[0], bias[0]
+        if dense:
+            vals, ids = dense_local_scan(queries, probes, ld, b, li, k, alpha)
+        else:
+            vals, ids = _strip_tile_body(
+                queries, qids, strip_list, pair_strip, pair_slot,
+                ld, b, li, class_layout, k, kf, alpha, interpret,
+            )
+        return merge_shards(vals, ids, k, axis)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(),
+                  P(axis, None, None, None), P(axis, None, None),
+                  P(axis, None, None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
+                 alpha, dense, interpret, data, ids_arr, bias):
+    """Query-tiled SPMD search loop shared by the distributed IVF indexes.
+    One host sync happened already (probes_np); every tile is one async
+    shard_map dispatch."""
+    from raft_tpu.ops.strip_scan import plan_strips
+
+    if not dense and k > 512:
+        raise ValueError(
+            f"distributed strip search supports k <= 512, got {k}"
+        )
+    kf = min(int(k), 512)
+    q = queries_mat.shape[0]
+    q_tile = min(q, 4096)
+    out_v, out_i = [], []
+    start = 0
+    while start < q:
+        qt = min(q_tile, q - start)
+        plan = plan_strips(probes_np[start:start + qt], lens_max, n_lists)
+        fn = make_tile_fn(comms.mesh, comms.axis, plan.class_layout, int(k),
+                          kf, dense, interpret, alpha)
+        v, i = fn(queries_mat[start:start + qt],
+                  jnp.asarray(probes_np[start:start + qt]),
+                  jnp.asarray(plan.qids), jnp.asarray(plan.strip_list),
+                  jnp.asarray(plan.pair_strip), jnp.asarray(plan.pair_slot),
+                  data, ids_arr, bias)
+        out_v.append(v)
+        out_i.append(i)
+        start += qt
+    vals = out_v[0] if len(out_v) == 1 else jnp.concatenate(out_v, 0)
+    ids = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, 0)
+    return vals, ids
+
+
+def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float):
+    """Jittable dense fallback scan for shards too small for the strip
+    kernel (max_list_size < 512): gather the probed lists and reduce with
+    one einsum — the single-device gather backend per shard."""
+    cand = ld[probes].astype(jnp.float32)            # (q, p, mls, d)
+    ip = jnp.einsum("qd,qpmd->qpm", queries, cand,
+                    preferred_element_type=jnp.float32)
+    d = alpha * ip + bias[probes]
+    q = queries.shape[0]
+    flat_ids = li[probes].reshape(q, -1)
+    d = d.reshape(q, -1)
+    vals, sel = select_k(d, min(k, d.shape[1]), select_min=True)
+    ids = jnp.where(jnp.isinf(vals), -1,
+                    jnp.take_along_axis(flat_ids, sel, axis=1))
+    if ids.shape[1] < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - ids.shape[1])),
+                       constant_values=jnp.inf)
+        ids = jnp.pad(ids, ((0, 0), (0, k - ids.shape[1])),
+                      constant_values=-1)
+    return vals, ids

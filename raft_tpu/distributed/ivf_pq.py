@@ -1,0 +1,258 @@
+"""Multi-device IVF-PQ: globally trained quantizers, row-sharded code
+lists, one ``shard_map`` search — the north-star configuration (BASELINE.md:
+IVF-PQ on SIFT-1B over a TPU pod).
+
+Reference analog: the raft-dask MNMG pattern (one model per worker sharing
+centrally trained parameters, collectives for the merge —
+python/raft-dask/raft_dask/common/comms.py:40, knn_merge_parts.cuh:140)
+re-expressed as SPMD over a mesh, so it runs multi-host unchanged.
+
+Division of labor:
+  * **Global, replicated**: coarse centers (data-sharded k-means, psum over
+    shards), rotation matrix, per-subspace codebooks (trained on a
+    subsample — the reference trains on a host-side subsample too,
+    ivf_pq_build.cuh:1729). Every shard encodes/probes identically.
+  * **Per shard**: its rows' PQ codes packed into padded lists, b_sum, and
+    the int8 decoded strip-scan cache. The dequant scale is a replicated
+    analytic bound (max |R·c_l| + max |codebook entry| per dim), so no
+    cross-shard collective is needed at cache build.
+  * **Search**: identical strip-scan plan on every shard (per-list MAX fill
+    across shards), local scan, all_gather of (world·k) candidates, exact
+    re-select. Pipe through neighbors/refine (sharded refine: the candidate
+    ids are global) for the re-ranked headline configuration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.neighbors import _packing
+from raft_tpu.neighbors import ivf_pq as sl
+from raft_tpu.neighbors.ivf_pq import IvfPqParams
+from raft_tpu.ops import distance as dist_mod
+
+
+@dataclass
+class ShardedIvfPqIndex:
+    """Row-sharded IVF-PQ: replicated quantizers, per-shard code lists and
+    int8 decoded cache stacked on a leading (world,) mesh dimension."""
+
+    centers: jax.Array       # (n_lists, dim) replicated
+    rotation: jax.Array      # (rot_dim, rot_dim) replicated
+    codebooks: jax.Array     # (pq_dim, n_codes, dsub) replicated
+    list_codes: jax.Array    # (world, n_lists, mls, pq_dim) uint8, P(axis)
+    list_ids: jax.Array      # (world, n_lists, mls) int32, GLOBAL row ids
+    # full per-entry scan bias, built once at build: ‖R·c_l‖² + b_sum for
+    # L2 (b_sum for ip-family), +inf at padding (per-call rebuilds were one
+    # wasted index-sized pass per search)
+    bias: jax.Array          # (world, n_lists, mls) fp32, P(axis)
+    decoded: jax.Array       # (world, n_lists, mls, rot_dim) int8, P(axis)
+    decoded_scale: float     # replicated dequant scale (analytic bound)
+    metric: str
+    pq_bits: int
+    n_total: int
+    comms: Comms
+    lens_max: np.ndarray     # host (n_lists,) max per-list fill across shards
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.list_codes.shape[2]
+
+
+def build(
+    dataset,
+    params: IvfPqParams = IvfPqParams(),
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+) -> ShardedIvfPqIndex:
+    """Global quantizers + one SPMD assign/spill phase + one SPMD
+    encode/pack/decode phase."""
+    res = res or current_resources()
+    comms = comms or make_comms()
+    world = comms.size
+    axis = comms.axis
+    dataset = jnp.asarray(dataset).astype(jnp.float32)
+    n, dim = dataset.shape
+    if params.n_lists * world > n:
+        raise ValueError(f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
+    pq_dim = params.pq_dim or sl._auto_pq_dim(dim)
+    dsub = -(-dim // pq_dim)
+    rot_dim = pq_dim * dsub
+    n_codes = 1 << params.pq_bits
+
+    work = dataset
+    if params.metric == "cosine":
+        work = work / jnp.maximum(jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+    km_metric = ("inner_product" if params.metric in ("cosine", "inner_product")
+                 else "sqeuclidean")
+
+    # --- global coarse quantizer -------------------------------------------
+    from raft_tpu.cluster.kmeans import KMeansParams
+    from raft_tpu.distributed import kmeans as dkm
+
+    out, _ = dkm.fit(
+        work, KMeansParams(n_clusters=params.n_lists,
+                           max_iter=params.kmeans_n_iters, seed=params.seed),
+        comms=comms,
+    )
+    centers = out.centroids
+    if params.metric in ("cosine", "inner_product"):
+        centers = centers / jnp.maximum(
+            jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
+
+    # --- global rotation + codebooks (subsample-trained, replicated) -------
+    key = jax.random.key(params.seed)
+    k_rot, k_cb, k_sub = jax.random.split(key, 3)
+    rotation = sl.make_rotation_matrix(k_rot, rot_dim)
+    cb_rows = min(n, 65536)
+    sub_rows = jax.random.randint(k_sub, (cb_rows,), 0, n)
+    sub = work[sub_rows]
+    sub_labels = kmeans_balanced.predict(
+        sub, centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric),
+        res=res)
+    resid = sl._pad_rot(sub - centers[sub_labels], rot_dim) @ rotation.T
+    resid_cb = resid.reshape(cb_rows, pq_dim, dsub).transpose(1, 0, 2)
+    codebooks = sl._train_codebooks(resid_cb, k_cb, n_codes,
+                                    params.codebook_n_iters)
+
+    # --- shard rows + SPMD assign/spill phase (shared helpers) -------------
+    from raft_tpu.distributed._sharding import (assign_phase, round_mls,
+                                                scatter_pack, shard_rows)
+
+    work_sh, gids_sh, rows_per = shard_rows(work, comms)
+    group = params.group_size or _packing.auto_group_size(
+        rows_per, params.n_lists, floor=128)
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(rows_per, params.n_lists, group)
+    n_lists = params.n_lists
+    labels_sh, counts_np = assign_phase(
+        work_sh, gids_sh, centers, km_metric, cap, n_lists, comms)
+    mls = round_mls(int(counts_np.max()), group)
+
+    # replicated dequant scale: |x̂_d| <= max_l |(Rc_l)_d| + max_cb — an
+    # analytic bound, so shards need no collective to agree on it
+    rc = sl._pad_rot(centers, rot_dim) @ rotation.T
+    scale = float(
+        (jnp.max(jnp.abs(rc)) + jnp.max(jnp.abs(codebooks))) / 127.0)
+
+    # --- phase 2 (SPMD): encode + pack + b_sum + int8 decode ---------------
+    l2 = params.metric in ("sqeuclidean", "euclidean")
+
+    def pack_body(rows, ids, labels):
+        rows, ids, labels = rows[0], ids[0], labels[0]
+        rp = rows.shape[0]
+        safe_labels = jnp.minimum(labels, n_lists - 1)
+        residual = sl._pad_rot(rows - centers[safe_labels], rot_dim) @ rotation.T
+        codes = sl._encode(residual.reshape(rp, pq_dim, dsub), codebooks)
+        lc, li = scatter_pack(
+            labels,
+            [(jnp.zeros((n_lists, mls, pq_dim), jnp.uint8), codes),
+             (jnp.full((n_lists, mls), -1, jnp.int32), ids)],
+            n_lists, mls)
+        b_sum = sl._compute_b_sum(centers, rotation, codebooks, lc, li,
+                                  params.metric)
+        if l2:  # fold the coarse-center norm in once (b_sum is +inf at pad)
+            rc2 = dist_mod.sqnorm(sl._pad_rot(centers, rot_dim) @ rotation.T)
+            bias = rc2[:, None] + b_sum
+        else:
+            bias = b_sum
+        return lc[None], li[None], bias[None]
+
+    pack_fn = jax.jit(jax.shard_map(
+        pack_body, mesh=comms.mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None, None, None), P(axis, None, None),
+                   P(axis, None, None)),
+        check_vma=False,
+    ))
+    list_codes, list_ids, bias = pack_fn(work_sh, gids_sh, labels_sh)
+
+    # decode with the replicated analytic scale (separate pass so the scale
+    # logic stays in one place)
+    def decode_body(lc, li):
+        dec = sl._decode_lists_scaled(centers, rotation, codebooks, lc[0],
+                                      li[0], scale)
+        return dec[None]
+
+    decode_fn = jax.jit(jax.shard_map(
+        decode_body, mesh=comms.mesh,
+        in_specs=(P(axis, None, None, None), P(axis, None, None)),
+        out_specs=P(axis, None, None, None),
+        check_vma=False,
+    ))
+    decoded = decode_fn(list_codes, list_ids)
+    return ShardedIvfPqIndex(
+        centers, rotation, codebooks, list_codes, list_ids, bias, decoded,
+        scale, params.metric, params.pq_bits, n, comms,
+        counts_np.max(axis=0).astype(np.int32),
+    )
+
+
+def search(
+    index: ShardedIvfPqIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD IVF-PQ search over the sharded code lists. Returns PQ-approximate
+    (distances (q, k), global row ids (q, k)), replicated; re-rank with
+    neighbors/refine for the headline configuration."""
+    from raft_tpu.distributed._sharding import tiled_search
+    from raft_tpu.neighbors.ivf_flat import _coarse_probes
+    from raft_tpu.ops.strip_scan import strip_eligible
+
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.shape[1] != index.dim:
+        raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    if index.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    n_probes = int(min(n_probes, index.n_lists))
+    l2 = index.metric in ("sqeuclidean", "euclidean")
+
+    probes = _coarse_probes(queries, index.centers, n_probes, index.metric,
+                            "exact", res.compute_dtype)
+    probes_np = np.asarray(probes)                     # the one host sync
+    qr = sl._pad_rot(queries, index.rot_dim) @ index.rotation.T
+    vals, ids = tiled_search(
+        qr * index.decoded_scale, probes_np, index.lens_max, index.n_lists,
+        int(k), index.comms, -2.0 if l2 else -1.0,
+        dense=not strip_eligible(index.max_list_size),
+        interpret=jax.default_backend() != "tpu",
+        data=index.decoded, ids_arr=index.list_ids, bias=index.bias,
+    )
+
+    if l2:
+        vals = jnp.maximum(vals + dist_mod.sqnorm(qr)[:, None], 0.0)
+        if index.metric == "euclidean":
+            vals = jnp.sqrt(vals)
+        vals = jnp.where(ids >= 0, vals, jnp.inf)
+    else:
+        vals = jnp.where(ids >= 0, -vals, -jnp.inf)
+    if index.metric == "cosine":
+        vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
+    return vals, ids

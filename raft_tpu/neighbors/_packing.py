@@ -14,17 +14,25 @@ from typing import Tuple
 import jax.numpy as jnp
 
 
-def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int) -> Tuple:
+def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,
+               pow2_chunks: bool = False) -> Tuple:
     """Scatter rows into padded per-list blocks.
 
     payload: (n, ...) per-row data; row_ids: (n,) source ids; labels: (n,)
     list assignment. max_list_size = max cluster size rounded up to
-    ``group_size``. Returns (list_payload, list_ids).
+    ``group_size``. With ``pow2_chunks``, it is further rounded to a
+    power-of-two number of group_size chunks — the strip-scan TPU backend's
+    block divisibility requirement (ops/strip_scan.py; ≤ 2× padding, in
+    practice ~1.1× because the auto list cap is itself 4×mean ≈ pow2).
+    Returns (list_payload, list_ids).
     """
     n = payload.shape[0]
     sizes = jnp.bincount(labels, length=n_lists)
     max_size = int(jnp.max(sizes))
     max_size = max(group_size, -(-max_size // group_size) * group_size)
+    if pow2_chunks:
+        chunks = max_size // group_size
+        max_size = group_size * (1 << (chunks - 1).bit_length())
 
     order = jnp.argsort(labels)
     sorted_labels = labels[order]
@@ -53,8 +61,12 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     that also overflows keeps the row, so the cap is soft). Recall impact is
     bounded: a spilled row is found whenever its second-best list is probed,
     and n_probes >> 1 in practice.
+
+    Shapes are data-independent (second-nearest is computed for every row
+    in static tiles): one extra assignment-scale pass, but the compiled
+    programs are reused across builds — round-3 finding: data-dependent
+    shapes here caused fresh ~10 s XLA compiles on every build.
     """
-    n = labels.shape[0]
     n_lists = centers.shape[0]
     # base_counts: occupancy already committed to each list (extend() spills
     # only the new rows on top of the existing fill)
@@ -63,7 +75,14 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     counts = jnp.bincount(labels, length=n_lists)
     if int(jnp.max(counts + base)) <= cap:
         return labels
+    return _spill_core(work, centers, labels, metric, cap, base, counts, chunk)
 
+
+def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
+    """Jittable spill body (no host syncs) — usable inside shard_map
+    (distributed builds spill each shard in-SPMD)."""
+    n = labels.shape[0]
+    n_lists = centers.shape[0]
     # rank of each row within its cluster (arrival order, after the base)
     order = jnp.argsort(labels)
     offsets = jnp.cumsum(counts) - counts
@@ -71,46 +90,60 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     rank = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
     over = base[labels] + rank >= cap
 
-    # second-nearest center — computed only for overflow rows (build is
-    # eager, so the data-dependent row subset is a host-side gather), in
-    # chunks so the (n_over, n_lists) block stays bounded
+    # 4 nearest alternative centers for every row, in static-shape tiles
+    # (round-3: one alternative was not enough — a mega-cluster's neighbors
+    # fill up and the remainder stayed put, inflating max_list_size 2×)
     from raft_tpu.ops import distance as dist_mod
-    import numpy as np
+    from raft_tpu.ops.select_k import select_k
 
-    over_rows = np.where(np.asarray(over))[0]
-    work_o = work[jnp.asarray(over_rows)]
-    labels_o = labels[jnp.asarray(over_rows)]
-    second = []
-    for s in range(0, over_rows.shape[0], chunk):
-        w = work_o[s:s + chunk]
+    n_alt = min(4, n_lists - 1)
+    if n_alt <= 0:
+        return labels  # a single list has nowhere to spill
+    alts = []
+    for s in range(0, n, chunk):
+        w = work[s:s + chunk]
+        lb = labels[s:s + chunk]
         if metric == "inner_product":
-            d = -dist_mod.matmul_t(w, centers, None, "highest")
+            d = -dist_mod.matmul_t(w, centers, jnp.bfloat16)
         else:
-            d = dist_mod._expanded_distance(w, centers, "sqeuclidean", None, "highest")
-        d = d.at[jnp.arange(w.shape[0]), labels_o[s:s + chunk]].set(jnp.inf)
-        second.append(jnp.argmin(d, axis=1).astype(jnp.int32))
-    second_o = jnp.concatenate(second) if second else jnp.zeros(0, jnp.int32)
-    labels2 = jnp.array(labels).at[jnp.asarray(over_rows)].set(second_o)
+            d = dist_mod._expanded_distance(w, centers, "sqeuclidean",
+                                            jnp.bfloat16, None)
+        d = d.at[jnp.arange(w.shape[0]), lb].set(jnp.inf)
+        _, a = select_k(d, n_alt, select_min=True)
+        alts.append(a)
+    alt = jnp.concatenate(alts) if len(alts) > 1 else alts[0]  # (n, n_alt)
 
-    # admission control per target: spills ranked within each target list
-    # only fill its *remaining* capacity, so concurrent spills from several
-    # overflowing lists cannot pile one target above the cap
-    spill_target = jnp.where(over, labels2, n_lists)  # n_lists = not spilling
-    s_order = jnp.argsort(spill_target)
-    t_sorted = spill_target[s_order]
-    t_counts = jnp.bincount(t_sorted, length=n_lists + 1)
-    t_off = jnp.cumsum(t_counts) - t_counts
-    spill_rank_sorted = jnp.arange(n, dtype=jnp.int32) - t_off[t_sorted].astype(jnp.int32)
-    spill_rank = jnp.zeros(n, jnp.int32).at[s_order].set(spill_rank_sorted)
-    admitted = over & (base[labels2] + counts[labels2] + spill_rank < cap)
-    return jnp.where(admitted, labels2, labels)
+    # sequential admission over alternative ranks: each round, rows still
+    # overflowing bid for their next-nearest list; a target only accepts up
+    # to its remaining capacity (conservative: capacity freed by rows that
+    # spill OUT of a list in the same round is not reused)
+    free = jnp.maximum(cap - (base + counts), 0)
+    labels_out = labels
+    remaining = over
+    for r in range(n_alt):
+        target = jnp.where(remaining, alt[:, r], n_lists)
+        s_order = jnp.argsort(target)
+        t_sorted = target[s_order]
+        t_counts = jnp.bincount(t_sorted, length=n_lists + 1)
+        t_off = jnp.cumsum(t_counts) - t_counts
+        rank_sorted = jnp.arange(n, dtype=jnp.int32) - t_off[t_sorted].astype(jnp.int32)
+        t_rank = jnp.zeros(n, jnp.int32).at[s_order].set(rank_sorted)
+        admitted = remaining & (t_rank < free[jnp.clip(target, 0, n_lists - 1)]) \
+            & (target < n_lists)
+        labels_out = jnp.where(admitted, alt[:, r], labels_out)
+        free = free - jnp.bincount(jnp.where(admitted, alt[:, r], n_lists),
+                                   length=n_lists + 1)[:n_lists]
+        remaining = remaining & ~admitted
+    return labels_out
 
 
-def auto_group_size(n: int, n_lists: int) -> int:
-    """512 (== ragged_scan.MC, enables the ragged TPU backend) when the mean
-    list is big enough that the padding is noise; else 64 so small indexes
-    stay small (the dense scan path doesn't care about 512-alignment)."""
-    return 512 if n // max(n_lists, 1) >= 192 else 64
+def auto_group_size(n: int, n_lists: int, floor: int = 64) -> int:
+    """512 (== strip_scan.MC, enables the strip TPU backend) when the mean
+    list is big enough that the padding is noise; else ``floor`` so small
+    indexes stay small. ivf_pq passes floor=128: its Pallas LUT backend
+    requires 128-aligned max_list_size (ops/pq_scan.py), and a 64 granule can
+    produce odd multiples of 64 (ADVICE.md round-2 high finding)."""
+    return 512 if n // max(n_lists, 1) >= 192 else floor
 
 
 def auto_list_cap(n: int, n_lists: int, group_size: int, factor: int = 4) -> int:

@@ -58,7 +58,7 @@ class IvfFlatParams:
     # per-list occupancy cap: -1 = auto (4× mean, group-aligned), 0 = off.
     # Overflow rows spill to their second-nearest list (_packing.spill_to_cap)
     list_size_cap: int = -1
-    # list padding granule: 0 = auto (512 == ragged_scan.MC when the mean
+    # list padding granule: 0 = auto (512 == strip_scan.MC when the mean
     # list is large enough to amortize it — required for the ragged TPU
     # backend — else 64, kIndexGroupSize-style, to keep small indexes small)
     group_size: int = 0
@@ -88,6 +88,9 @@ class IvfFlatIndex:
     list_ids: jax.Array  # (n_lists, max_list_size) int32, -1 = padding
     list_norms: Optional[jax.Array]  # (n_lists, max_list_size) fp32, L2 only
     metric: str
+    # list padding granule used at build; extend() reuses it instead of
+    # inferring from max_list_size (ADVICE.md round-2). 0 = unknown (legacy).
+    group_size: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -109,11 +112,11 @@ class IvfFlatIndex:
         return jnp.sum(self.list_ids >= 0, axis=1).astype(jnp.int32)
 
     def tree_flatten(self):
-        return (self.centers, self.list_data, self.list_ids, self.list_norms), (self.metric,)
+        return (self.centers, self.list_data, self.list_ids, self.list_norms), (self.metric, self.group_size)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, aux[0])
+        return cls(*children, *aux)
 
     # -- persistence (ivf_flat_serialize.cuh analog) -----------------------
     def save(self, path) -> None:
@@ -124,7 +127,8 @@ class IvfFlatIndex:
         }
         if self.list_norms is not None:
             arrays["list_norms"] = self.list_norms
-        save_arrays(path, {"kind": "ivf_flat", "metric": self.metric}, arrays)
+        save_arrays(path, {"kind": "ivf_flat", "metric": self.metric,
+                           "group_size": self.group_size}, arrays)
 
     @classmethod
     def load(cls, path) -> "IvfFlatIndex":
@@ -137,6 +141,7 @@ class IvfFlatIndex:
             jnp.asarray(arrays["list_ids"]),
             jnp.asarray(arrays["list_norms"]) if "list_norms" in arrays else None,
             meta["metric"],
+            int(meta.get("group_size", 0)),
         )
 
 
@@ -147,10 +152,11 @@ class IvfFlatIndex:
 
 def _pack_lists(dataset, row_ids, labels, n_lists: int, group: int = 0):
     """Padded per-list blocks (the ivf_list fill, detail/ivf_flat_build.cuh
-    build_index; group rounding per kIndexGroupSize / ragged_scan.MC)."""
+    build_index; group rounding per kIndexGroupSize / strip_scan.MC)."""
     if group <= 0:
         group = _packing.auto_group_size(dataset.shape[0], n_lists)
-    return pack_lists(dataset, row_ids, labels, n_lists, group)
+    return pack_lists(dataset, row_ids, labels, n_lists, group,
+                      pow2_chunks=group == 512)
 
 
 @traced("ivf_flat::build")
@@ -182,7 +188,10 @@ def build(
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
     if n_train < n:
         key = jax.random.key(params.seed)
-        train_rows = jax.random.choice(key, n, (n_train,), replace=False)
+        # with-replacement sampling: the ~n_train²/2n duplicate rate is noise
+        # for k-means, and it avoids the O(n log n) permutation program that
+        # choice(replace=False) compiles (round-3: ~25 s of XLA compile)
+        train_rows = jax.random.randint(key, (n_train,), 0, n)
         centers = kmeans_balanced.fit(work[train_rows], params.n_lists, km, res=res)
         labels = kmeans_balanced.predict(work, centers, km, res=res)
     else:
@@ -200,7 +209,7 @@ def build(
     list_norms = None
     if params.metric in ("sqeuclidean", "euclidean"):
         list_norms = dist_mod.sqnorm(list_data, axis=2)
-    return IvfFlatIndex(centers, list_data, list_ids, list_norms, params.metric)
+    return IvfFlatIndex(centers, list_data, list_ids, list_norms, params.metric, group)
 
 
 @traced("ivf_flat::extend")
@@ -232,7 +241,8 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
     new_labels = kmeans_balanced.predict(
         new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
     )
-    group = 512 if index.max_list_size % 512 == 0 else 64
+    # persisted granule; legacy indexes (group_size 0) fall back to inference
+    group = index.group_size or (512 if index.max_list_size % 512 == 0 else 64)
     total = int(old_ids.shape[0]) + int(new_vectors.shape[0])
     cap = _packing.auto_list_cap(total, index.n_lists, group)
     new_labels = _packing.spill_to_cap(
@@ -247,12 +257,27 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
     list_norms = None
     if index.metric in ("sqeuclidean", "euclidean"):
         list_norms = dist_mod.sqnorm(list_data, axis=2)
-    return IvfFlatIndex(index.centers, list_data, list_ids, list_norms, index.metric)
+    return IvfFlatIndex(index.centers, list_data, list_ids, list_norms, index.metric, group)
 
 
 # ---------------------------------------------------------------------------
 # Search
 # ---------------------------------------------------------------------------
+
+
+def _lens_np(index):
+    """Host-cached per-list entry counts: planning needs them every search
+    call, and refetching would cost a device sync per call."""
+    cached = getattr(index, "_lens_np_cache", None)
+    if cached is None or cached.shape[0] != index.n_lists:
+        import numpy as np
+
+        cached = np.asarray(index.list_sizes())
+        try:
+            index._lens_np_cache = cached
+        except AttributeError:  # frozen/immutable containers: just recompute
+            pass
+    return cached
 
 
 @functools.partial(
@@ -284,9 +309,9 @@ def _ragged_bias(list_ids, list_norms, filter, mode: str):
 
 
 def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
-    """Ragged chunked scan path (ops/ragged_scan.py): work ∝ actual probed
-    entries — no per-list cap, no padded-length scan."""
-    from raft_tpu.ops.ragged_scan import ragged_search
+    """Strip-scan path (ops/strip_scan.py): work ∝ actual probed entries —
+    no per-list cap, no padded-length scan, per-pair top-k fused in-kernel."""
+    from raft_tpu.ops.strip_scan import strip_search
 
     probes = _coarse_probes(
         queries, index.centers, n_probes, index.metric, select_algo,
@@ -295,9 +320,9 @@ def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
     l2 = index.metric in ("sqeuclidean", "euclidean")
     bias = _ragged_bias(index.list_ids, index.list_norms, filter,
                         "l2" if l2 else "ip")
-    vals, ids = ragged_search(
+    vals, ids = strip_search(
         queries, probes, index.list_data, bias, index.list_ids,
-        index.list_sizes(), int(k), alpha=-2.0 if l2 else -1.0,
+        _lens_np(index), int(k), alpha=-2.0 if l2 else -1.0,
         workspace_bytes=res.workspace_bytes,
         interpret=jax.default_backend() != "tpu",
     )
@@ -405,9 +430,9 @@ def search(
     if index.metric == "cosine":
         queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
 
-    from raft_tpu.ops.ragged_scan import MC as _MC
+    from raft_tpu.ops.strip_scan import strip_eligible
 
-    aligned = index.max_list_size % _MC == 0
+    aligned = strip_eligible(index.max_list_size) and k <= 512
     if backend == "auto":
         backend = "ragged" if jax.default_backend() == "tpu" and aligned else "gather"
     if backend not in ("ragged", "gather"):
@@ -415,9 +440,9 @@ def search(
     if backend == "ragged":
         if not aligned:
             raise ValueError(
-                f"ragged backend needs max_list_size % {_MC} == 0, got "
-                f"{index.max_list_size}; rebuild with group_size={_MC} "
-                "(or use backend='gather')"
+                f"ragged backend needs max_list_size = a power-of-two "
+                f"multiple of 512, got {index.max_list_size}; rebuild with "
+                "group_size=512 (or use backend='gather')"
             )
         return _search_ragged(index, queries, int(k), n_probes, filter,
                               select_algo, res)

@@ -98,12 +98,20 @@ class IvfPqIndex:
     list_codes: jax.Array  # (n_lists, max_list_size, pq_dim) uint8
     list_ids: jax.Array  # (n_lists, max_list_size) int32
     b_sum: jax.Array  # (n_lists, max_list_size) fp32
-    # (n_lists, max_list_size, rot_dim) bf16 ragged-scan cache; None until
-    # the first ragged search (lazy: it costs ~2·rot_dim bytes/slot, wasted
-    # on CPU/gather deployments that never read it)
+    # (n_lists, max_list_size, rot_dim) int8 strip-scan cache (+ host-side
+    # float scale in ``decoded_scale``); None until the first strip search
+    # (lazy: rot_dim bytes/slot, wasted on CPU/gather deployments). The
+    # quantized-reconstruction analog of the reference's fp8-compressed LUT
+    # (detail/ivf_pq_fp_8bit.cuh): only the cross term -2⟨q, x̂⟩ is
+    # approximated — the ‖x̂‖² half rides exactly in b_sum.
     decoded: Optional[jax.Array]
     metric: str
     pq_bits: int
+    # list padding granule used at build; extend() reuses it instead of
+    # inferring from max_list_size (ADVICE.md round-2: inference can silently
+    # flip the granule and change backend eligibility). 0 = unknown (legacy).
+    group_size: int = 0
+    decoded_scale: Optional[jax.Array] = None  # 0-d fp32 dequant scale
 
     @property
     def n_lists(self) -> int:
@@ -140,17 +148,22 @@ class IvfPqIndex:
         return (
             self.centers, self.rotation, self.codebooks,
             self.list_codes, self.list_ids, self.b_sum, self.decoded,
-        ), (self.metric, self.pq_bits)
+            self.decoded_scale,
+        ), (self.metric, self.pq_bits, self.group_size)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, aux[0], aux[1])
+        (centers, rotation, codebooks, list_codes, list_ids, b_sum,
+         decoded, decoded_scale) = children
+        return cls(centers, rotation, codebooks, list_codes, list_ids,
+                   b_sum, decoded, *aux, decoded_scale=decoded_scale)
 
     # -- persistence (ivf_pq_serialize.cuh analog) -------------------------
     def save(self, path) -> None:
         save_arrays(
             path,
-            {"kind": "ivf_pq", "metric": self.metric, "pq_bits": self.pq_bits},
+            {"kind": "ivf_pq", "metric": self.metric, "pq_bits": self.pq_bits,
+             "group_size": self.group_size},
             {
                 "centers": self.centers,
                 "rotation": self.rotation,
@@ -177,6 +190,7 @@ class IvfPqIndex:
             jnp.asarray(arrays["b_sum"]), None,
             meta["metric"],
             int(meta["pq_bits"]),
+            int(meta.get("group_size", 0)),
         )
 
 
@@ -211,7 +225,10 @@ def _train_codebooks(resid_sub, key, n_codes, n_iters):
 
     def one_subspace(args):
         X, key = args
-        rows = jax.random.choice(key, n_train, (n_codes,), replace=False)
+        # with-replacement init: valid even when n_train < n_codes (tiny
+        # datasets leave dead codes, harmless), and avoids the O(n log n)
+        # permutation program choice(replace=False) would compile
+        rows = jax.random.randint(key, (n_codes,), 0, n_train)
         centers0 = X[rows]
 
         def step(_, centers):
@@ -255,23 +272,10 @@ def _encode(resid_rot, codebooks, chunk: int = 8192):
 
 
 def _pack_lists(codes, row_ids, labels, n_lists: int, group: int = 0):
-    n, pq_dim = codes.shape
     if group <= 0:
-        group = _packing.auto_group_size(n, n_lists)
-    sizes = jnp.bincount(labels, length=n_lists)
-    max_size = int(jnp.max(sizes))
-    max_size = max(group, -(-max_size // group) * group)
-
-    order = jnp.argsort(labels)
-    sorted_labels = labels[order]
-    offsets = jnp.cumsum(sizes) - sizes
-    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
-
-    list_codes = jnp.zeros((n_lists, max_size, pq_dim), jnp.uint8)
-    list_ids = jnp.full((n_lists, max_size), -1, jnp.int32)
-    list_codes = list_codes.at[sorted_labels, pos].set(codes[order])
-    list_ids = list_ids.at[sorted_labels, pos].set(row_ids[order].astype(jnp.int32))
-    return list_codes, list_ids
+        group = _packing.auto_group_size(codes.shape[0], n_lists, floor=128)
+    return _packing.pack_lists(codes, row_ids, labels, n_lists, group,
+                               pow2_chunks=group == 512)
 
 
 def _pad_rot(x, rot_dim):
@@ -312,7 +316,9 @@ def build(
     k_train, k_rot, k_cb = jax.random.split(key, 3)
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
     if n_train < n:
-        train_rows = jax.random.choice(k_train, n, (n_train,), replace=False)
+        # with-replacement: duplicates are noise for k-means, and it avoids
+        # the O(n log n) permutation program choice(replace=False) compiles
+        train_rows = jax.random.randint(k_train, (n_train,), 0, n)
         trainset = work[train_rows]
         centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
         labels = kmeans_balanced.predict(work, centers, km, res=res)
@@ -330,7 +336,7 @@ def build(
         resid_cb, k_cb, n_codes, params.codebook_n_iters
     )
 
-    group = params.group_size or _packing.auto_group_size(n, params.n_lists)
+    group = params.group_size or _packing.auto_group_size(n, params.n_lists, floor=128)
     cap = params.list_size_cap
     if cap < 0:
         cap = _packing.auto_list_cap(n, params.n_lists, group)
@@ -346,17 +352,21 @@ def build(
     b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, params.metric)
     return IvfPqIndex(
         centers, rotation, codebooks, list_codes, list_ids, b_sum, None,
-        params.metric, params.pq_bits,
+        params.metric, params.pq_bits, group,
     )
 
 
 @jax.jit
 def _decode_lists(centers, rotation, codebooks, list_codes, list_ids):
-    """bf16 reconstruction x̂ = R·c_l + cb[codes] per entry, in rotated space
-    — the ragged-scan cache (module docstring: at pq_bits=8 the decoded
-    matmul is 64× less MXU work than the one-hot LUT scan for the same
-    scores; bf16 here is the fp8-LUT-compression analog,
-    detail/ivf_pq_fp_8bit.cuh)."""
+    """int8-quantized reconstruction x̂ = R·c_l + cb[codes] per entry, in
+    rotated space — the strip-scan cache at rot_dim bytes/entry (the
+    quantized-reconstruction analog of the reference's fp8-compressed LUT,
+    detail/ivf_pq_fp_8bit.cuh: precision traded for bandwidth, re-ranked by
+    refine; the decoded matmul is 2·rot_dim FLOP/entry where the one-hot
+    LUT scan pays 2·pq_dim·n_codes for identical scores). Two chunked
+    passes (max-abs, then quantize) keep the fp32 intermediate per-list.
+
+    Returns (cache int8 (n_lists, m, rot_dim), scale 0-d fp32)."""
     n_lists, max_size, pq_dim = list_codes.shape
     n_codes, dsub = codebooks.shape[1], codebooks.shape[2]
     rot_dim = pq_dim * dsub
@@ -364,13 +374,38 @@ def _decode_lists(centers, rotation, codebooks, list_codes, list_ids):
     cb_flat = codebooks.reshape(pq_dim * n_codes, dsub)
     s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
 
-    def one_list(args):
+    def decode_one(args):
         rc_l, codes_l, ids_l = args  # (rot,), (m, s), (m,)
         resid = jnp.take(cb_flat, codes_l.astype(jnp.int32) + s_off, axis=0)
         x_hat = rc_l[None, :] + resid.reshape(max_size, rot_dim)
-        return jnp.where((ids_l >= 0)[:, None], x_hat, 0).astype(jnp.bfloat16)
+        return jnp.where((ids_l >= 0)[:, None], x_hat, 0.0)
 
-    return lax.map(one_list, (rc, list_codes, list_ids))
+    args = (rc, list_codes, list_ids)
+    maxabs = lax.map(lambda a: jnp.max(jnp.abs(decode_one(a))), args)
+    scale = jnp.maximum(jnp.max(maxabs), 1e-30) / 127.0
+    return _decode_lists_scaled(centers, rotation, codebooks, list_codes,
+                                list_ids, scale), scale
+
+
+def _decode_lists_scaled(centers, rotation, codebooks, list_codes, list_ids,
+                         scale):
+    """int8 reconstruction cache at a given dequant scale (distributed
+    builds pass a replicated analytic bound so shards need no collective)."""
+    n_lists, max_size, pq_dim = list_codes.shape
+    n_codes, dsub = codebooks.shape[1], codebooks.shape[2]
+    rot_dim = pq_dim * dsub
+    rc = _pad_rot(centers, rot_dim) @ rotation.T
+    cb_flat = codebooks.reshape(pq_dim * n_codes, dsub)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+
+    def quant_one(args):
+        rc_l, codes_l, ids_l = args
+        resid = jnp.take(cb_flat, codes_l.astype(jnp.int32) + s_off, axis=0)
+        x_hat = rc_l[None, :] + resid.reshape(max_size, rot_dim)
+        x_hat = jnp.where((ids_l >= 0)[:, None], x_hat, 0.0)
+        return jnp.clip(jnp.round(x_hat / scale), -127, 127).astype(jnp.int8)
+
+    return lax.map(quant_one, (rc, list_codes, list_ids))
 
 
 def _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids, metric):
@@ -416,7 +451,8 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     labels = kmeans_balanced.predict(
         new_vectors, index.centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res
     )
-    group = 512 if index.max_list_size % 512 == 0 else 64
+    # persisted granule; legacy indexes (group_size 0) fall back to inference
+    group = index.group_size or (512 if index.max_list_size % 512 == 0 else 128)
     total = index.size + int(new_vectors.shape[0])
     cap = _packing.auto_list_cap(total, index.n_lists, group)
     # spill BEFORE encoding: residuals are taken against the assigned center
@@ -449,7 +485,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     )
     return IvfPqIndex(
         index.centers, index.rotation, index.codebooks, list_codes, list_ids,
-        b_sum, None, index.metric, index.pq_bits,
+        b_sum, None, index.metric, index.pq_bits, group,
     )
 
 
@@ -475,15 +511,17 @@ def _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2: bool):
 
 
 def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
-    """Decoded-cache ragged scan (ops/ragged_scan.py): identical scores to
-    the LUT formulation (x̂ is the exact reconstruction the LUT sums over),
-    at 2·dim MXU FLOPs per probed entry instead of 2·pq_dim·n_codes."""
-    from raft_tpu.neighbors.ivf_flat import _coarse_probes
-    from raft_tpu.ops.ragged_scan import ragged_search
+    """int8-decoded-cache strip scan (ops/strip_scan.py): same ranking as
+    the LUT formulation (x̂ is the reconstruction the LUT sums over), at
+    2·rot_dim MXU FLOPs and rot_dim HBM bytes per probed entry instead of
+    2·pq_dim·n_codes FLOPs. The dequant scale folds into the query operand,
+    so the kernel sees a plain int8 B block."""
+    from raft_tpu.neighbors.ivf_flat import _coarse_probes, _lens_np
+    from raft_tpu.ops.strip_scan import strip_search
 
     if index.decoded is None:
         # lazy decode-cache fill, kept on the index instance
-        index.decoded = _decode_lists(
+        index.decoded, index.decoded_scale = _decode_lists(
             index.centers, index.rotation, index.codebooks,
             index.list_codes, index.list_ids,
         )
@@ -495,8 +533,9 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
     qr = _pad_rot(queries, index.rot_dim) @ index.rotation.T
     bias = _ragged_bias_pq(index.b_sum, index.centers, index.rotation,
                            index.list_ids, filter, l2)
-    vals, ids = ragged_search(
-        qr, probes, index.decoded, bias, index.list_ids, index.list_sizes(),
+    vals, ids = strip_search(
+        qr * index.decoded_scale, probes, index.decoded, bias,
+        index.list_ids, _lens_np(index),
         int(k), alpha=-2.0 if l2 else -1.0,
         workspace_bytes=res.workspace_bytes,
         interpret=jax.default_backend() != "tpu",
@@ -715,17 +754,20 @@ def search(
     if index.metric == "cosine":
         queries = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
 
-    from raft_tpu.ops.ragged_scan import MC as _MC
+    from raft_tpu.ops.strip_scan import strip_eligible
 
-    aligned = index.max_list_size % _MC == 0
+    aligned = strip_eligible(index.max_list_size) and k <= 512
+    pallas_ok = index.max_list_size % 128 == 0
     if backend == "auto":
         # ragged decoded scan on TPU (the fast path); jnp gather elsewhere
         # (the exact-fp32 oracle; its take_along_axis crashes the TPU
         # runtime at large shapes, so it is never auto-picked there);
         # misaligned (old / small-group) indexes fall back to the LUT
-        # kernel on TPU
+        # kernel on TPU, and — if even 128-alignment is missing (legacy
+        # 64-granule index, ADVICE.md round-2 high finding) — to the gather
+        # path, which such small-list indexes can afford
         if jax.default_backend() == "tpu":
-            backend = "ragged" if aligned else "pallas"
+            backend = "ragged" if aligned else ("pallas" if pallas_ok else "gather")
         else:
             backend = "gather"
     if backend not in ("ragged", "pallas", "gather"):
@@ -733,9 +775,9 @@ def search(
     if backend == "ragged":
         if not aligned:
             raise ValueError(
-                f"ragged backend needs max_list_size % {_MC} == 0, got "
-                f"{index.max_list_size}; rebuild with group_size={_MC} "
-                "(or use backend='pallas'/'gather')"
+                f"ragged backend needs max_list_size = a power-of-two "
+                f"multiple of 512, got {index.max_list_size}; rebuild with "
+                "group_size=512 (or use backend='pallas'/'gather')"
             )
         vals, ids = _search_ragged_pq(
             index, queries, int(k), n_probes, filter, select_algo, res
@@ -744,6 +786,12 @@ def search(
             vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
         return vals, ids
     if backend == "pallas":
+        if not pallas_ok:
+            raise ValueError(
+                f"pallas backend needs max_list_size % 128 == 0, got "
+                f"{index.max_list_size}; rebuild with group_size=128 "
+                "(or use backend='gather')"
+            )
         p = n_probes
         n_codes = index.codebooks.shape[1]
         # per (list, slot): fp32 scores row + the bf16 gathered LUT row

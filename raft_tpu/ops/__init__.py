@@ -11,14 +11,14 @@ TPU-native re-designs of cpp/include/raft/{distance,matrix,linalg}:
     row/col ops (reference linalg/*.cuh, matrix/*.cuh).
 """
 
-from raft_tpu.ops import distance, kernels, linalg, matrix, ragged_scan, select_k
+from raft_tpu.ops import distance, kernels, linalg, matrix, select_k, strip_scan
 from raft_tpu.ops.distance import pairwise_distance, fused_l2_nn_argmin
 from raft_tpu.ops.select_k import select_k as select_k_fn
 
 __all__ = [
     "distance",
     "kernels",
-    "ragged_scan",
+    "strip_scan",
     "linalg",
     "matrix",
     "select_k",

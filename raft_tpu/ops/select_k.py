@@ -7,13 +7,17 @@ choices enumerated in matrix/select_k_types.hpp:36-66 — radix "AIR top-k"
 TPU design: radix select does not map to the VPU (no per-lane scatter/atomics);
 the idiomatic backends are
   * ``"exact"`` — `lax.top_k` (XLA's sort-based top-k; exact, any k);
+  * ``"iter"`` — k masked-extrema passes (exact, VPU-friendly): on TPU,
+    lax.top_k lowers to a full per-row sort, measured ~10× slower than k
+    sequential min+mask passes for the small k ANN uses (k ≤ 64). Matches
+    lax.top_k exactly, including lowest-index tie-breaks;
   * ``"approx"`` — `lax.approx_min_k`/`approx_max_k`, the TPU partial-reduce
     top-k from the TPU-KNN paper (PAPERS.md: "TPU-KNN: K Nearest Neighbor
     Search at Peak FLOP/s") — ~recall_target accuracy at much higher
     throughput; the right default inside ANN search pipelines where candidate
     lists are over-fetched anyway.
 
-Both operate row-wise on a (batch, n) matrix, like the reference.
+All operate row-wise on a (batch, n) matrix, like the reference.
 """
 
 from __future__ import annotations
@@ -26,6 +30,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def iter_topk_min(values, k: int):
+    """k masked-min passes over the last axis: (vals, idx) exactly matching
+    ``lax.top_k(-values, k)`` semantics (ascending values, lowest index on
+    ties, distinct indices even on +inf tails) without the sort. The
+    per-pass work is ~4 elementwise VPU ops over the full block — for
+    k ≤ ~64 this beats TPU top_k's O(n log n) sort by a wide margin."""
+    v = values
+    n = v.shape[-1]
+    cols = lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
+    # explicit taken-mask (not just an inf overwrite): +inf input values are
+    # indistinguishable from extracted slots, and top_k still returns
+    # DISTINCT indices for them in ascending order
+    taken = jnp.zeros(v.shape, jnp.bool_)
+    vs, idxs = [], []
+    for _ in range(k):
+        masked = jnp.where(taken, jnp.inf, v)
+        mn = jnp.min(masked, axis=-1, keepdims=True)
+        am = jnp.min(jnp.where((masked <= mn) & ~taken, cols, n), axis=-1)
+        vs.append(mn[..., 0])
+        idxs.append(am)
+        taken = taken | (cols == am[..., None])
+    return jnp.stack(vs, -1), jnp.stack(idxs, -1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "algo", "recall_target"))
 def _select_k_impl(values, k, select_min, algo, recall_target):
     if algo == "approx":
@@ -33,6 +61,10 @@ def _select_k_impl(values, k, select_min, algo, recall_target):
             vals, idx = lax.approx_min_k(values, k, recall_target=recall_target)
         else:
             vals, idx = lax.approx_max_k(values, k, recall_target=recall_target)
+    elif algo == "iter":
+        vals, idx = iter_topk_min(values if select_min else -values, k)
+        if not select_min:
+            vals = -vals
     else:
         if select_min:
             neg_vals, idx = lax.top_k(-values, k)
@@ -57,8 +89,10 @@ def select_k(
     the candidate-id remap used by IVF search's two-stage select (reference
     detail/ivf_flat_search-inl.cuh:130,194).
 
-    ``algo``: "exact" | "approx" (TPU partial-reduce; ``recall_target``
-    trades recall for speed).
+    ``algo``: "exact" (lax.top_k) | "iter" (k masked-min passes; exact,
+    the fast TPU route for small k) | "approx" (TPU partial-reduce;
+    ``recall_target`` trades recall for speed). "exact" auto-routes to
+    "iter" for k <= 64 on TPU — same results, ~10x faster.
     """
     values = jnp.asarray(values)
     squeeze = values.ndim == 1
@@ -66,8 +100,17 @@ def select_k(
         values = values[None, :]
     if not 0 < k <= values.shape[-1]:
         raise ValueError(f"k={k} out of range for n={values.shape[-1]}")
-    if algo not in ("exact", "approx"):
+    if algo not in ("exact", "iter", "approx"):
         raise ValueError(f"unknown select_k algo {algo!r}")
+    # iter does k full passes over the row — a win over top_k's sort only
+    # while the row is narrow (k·n stays small); wide rows (brute-force over
+    # the whole dataset) must keep the single-sort top_k
+    if (algo == "exact" and k <= 64 and values.shape[-1] <= 8192
+            and jax.default_backend() == "tpu"
+            and jnp.issubdtype(values.dtype, jnp.floating)):
+        algo = "iter"
+    if algo == "iter" and not jnp.issubdtype(values.dtype, jnp.floating):
+        algo = "exact"  # the inf mask needs a floating dtype
     vals, idx = _select_k_impl(values, int(k), bool(select_min), algo, float(recall_target))
     if indices is not None:
         indices = jnp.asarray(indices)

@@ -72,8 +72,13 @@ def linear_assignment(costs, eps_final: float = 0.0) -> Tuple[jax.Array, jax.Arr
     """Min-cost perfect assignment of an (n, n) cost matrix.
 
     Returns ``(row_to_col (n,) int32, total_cost scalar)``. ``eps_final``
-    defaults to ``cost_range / (2n·(n+1))`` — tight enough that integer
-    costs solve exactly; pass a larger value to trade optimality for speed.
+    defaults to ``min(cost_range / (2n·(n+1)), 1/(2(n+1)))`` — the second
+    term guarantees n·ε < 1/2, so integer costs solve exactly (Bertsekas
+    1988); pass a larger value to trade optimality for speed.
+
+    Raises ``RuntimeError`` if the auction fails to assign every row within
+    the (escalating) round budget — a partial assignment is never returned
+    silently (ADVICE.md round-2 medium finding).
     """
     costs = jnp.asarray(costs, jnp.float32)
     if costs.ndim != 2 or costs.shape[0] != costs.shape[1]:
@@ -82,7 +87,7 @@ def linear_assignment(costs, eps_final: float = 0.0) -> Tuple[jax.Array, jax.Arr
     benefits = -costs
     rng = float(jnp.max(costs) - jnp.min(costs)) or 1.0
     if eps_final <= 0:
-        eps_final = rng / (2.0 * n * (n + 1))
+        eps_final = min(rng / (2.0 * n * (n + 1)), 1.0 / (2.0 * (n + 1)))
 
     eps = max(rng / 2.0, eps_final)
     prices = jnp.zeros(n, jnp.float32)
@@ -95,5 +100,20 @@ def linear_assignment(costs, eps_final: float = 0.0) -> Tuple[jax.Array, jax.Arr
             break
         eps = max(eps / 5.0, eps_final)
 
+    # the final phase must leave no row unassigned; with finite benefits the
+    # auction terminates, so an incomplete result means the round budget was
+    # too small — escalate (bounded) rather than return a corrupt total
+    for _ in range(3):
+        if bool(jnp.all(row_to_col >= 0)):
+            break
+        max_rounds *= 8
+        row_to_col, prices = _auction_phase(
+            benefits, prices, jnp.float32(eps_final), max_rounds
+        )
+    if not bool(jnp.all(row_to_col >= 0)):
+        raise RuntimeError(
+            "auction failed to assign all rows (non-finite costs?); "
+            f"{int(jnp.sum(row_to_col < 0))} rows unassigned"
+        )
     total = jnp.sum(costs[jnp.arange(n), jnp.clip(row_to_col, 0, n - 1)])
     return row_to_col, total

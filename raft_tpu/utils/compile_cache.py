@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache.
+
+Round-3 finding: on the tunneled TPU platform, cold compiles dominate index
+build wall-clock (~60 s for the balanced-kmeans EM program alone vs 270 ms
+of execution). The standard JAX fix is the persistent compilation cache —
+one-line opt-in, compiled executables reused across processes. bench.py,
+the test suite and __graft_entry__ enable it; library code never does
+(user policy, like the reference leaving cudaDeviceSetCacheConfig to apps).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(path: str | None = None) -> None:
+    """Turn on JAX's on-disk compilation cache (idempotent, best-effort)."""
+    import jax
+
+    path = path or os.environ.get(
+        "RAFT_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu_xla"),
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is an optimization, never a failure mode
+        pass

@@ -1,0 +1,111 @@
+"""Stage-level micro-bench of the strip path (synthetic, no index build)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import strip_scan as ss
+
+
+def force(x):
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)[..., :1]))
+
+
+def t(label, fn, reps=3):
+    out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:52s} {dt*1e3:9.1f} ms", flush=True)
+    return out
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    rng = np.random.default_rng(0)
+    NLIST, DIM, Q, P = 1024, 128, 4096, 32
+    m = 4096  # 8 chunks, pow2
+    lens = np.full(NLIST, 977, np.int32)
+    lens[:64] = 3900  # fat lists -> class 8
+    probes = np.stack([rng.choice(NLIST, P, replace=False) for _ in range(Q)])
+
+    t0 = time.perf_counter()
+    plan = ss.plan_strips(probes.astype(np.int32), lens, NLIST)
+    print(f"plan_strips {1e3*(time.perf_counter()-t0):.1f} ms "
+          f"n_strips={plan.n_strips} s_pad={plan.s_pad} layout={plan.class_layout}",
+          flush=True)
+
+    queries = jnp.asarray(rng.standard_normal((Q, DIM)), jnp.float32)
+    qids = jnp.asarray(plan.qids)
+    data32 = jnp.asarray(rng.standard_normal((NLIST, m, DIM)), jnp.float32)
+    data8 = jnp.clip(jnp.round(data32 * 30), -127, 127).astype(jnp.int8)
+    bias = jnp.zeros((NLIST, m), jnp.float32)
+    ids = jnp.arange(NLIST * m, dtype=jnp.int32).reshape(NLIST, m)
+    force(data8)
+
+    # --- a_grouped gather alone -------------------------------------------
+    @jax.jit
+    def agroup(queries, qids):
+        return jnp.where((qids >= 0)[:, :, None],
+                         queries[jnp.clip(qids, 0), :], 0).astype(jnp.bfloat16)
+
+    ag = t("a_grouped gather (fp32 src)", lambda: agroup(queries, qids))
+
+    qbf = queries.astype(jnp.bfloat16)
+    force(qbf)
+
+    @jax.jit
+    def agroup_bf(queries, qids):
+        return jnp.where((qids >= 0)[:, :, None],
+                         queries[jnp.clip(qids, 0), :], 0)
+
+    t("a_grouped gather (bf16 src)", lambda: agroup_bf(qbf, qids))
+
+    # --- kernels per class, pre-built A -----------------------------------
+    sl = jnp.asarray(plan.strip_list)
+    bias3 = bias.reshape(NLIST, 1, m)
+    for kf in (10, 16, 40):
+        for (w, sub, start, cnt) in plan.class_layout:
+            t(f"class w={w} sub={sub} cnt={cnt} kf={kf} fp32", lambda w=w, sub=sub, start=start, cnt=cnt, kf=kf: ss._strip_class_call(
+                jax.lax.slice_in_dim(sl, start, start + cnt),
+                jax.lax.slice_in_dim(ag, start, start + cnt),
+                data32, bias3, w, sub, -2.0, kf, False))
+            t(f"class w={w} sub={sub} cnt={cnt} kf={kf} int8", lambda w=w, sub=sub, start=start, cnt=cnt, kf=kf: ss._strip_class_call(
+                jax.lax.slice_in_dim(sl, start, start + cnt),
+                jax.lax.slice_in_dim(ag, start, start + cnt),
+                data8, bias3, w, sub, -2.0, kf, False))
+
+    # --- full tile (dispatch + merge) --------------------------------------
+    for kf in (10, 40):
+        t(f"full _strip_tile kf={kf} int8", lambda kf=kf: ss._strip_tile(
+            queries, qids, sl, jnp.asarray(plan.pair_strip),
+            jnp.asarray(plan.pair_slot), data8, bias, ids,
+            plan.class_layout, kf, kf, -2.0, False))
+
+    # --- coarse probe stage -----------------------------------------------
+    from raft_tpu.ops.select_k import select_k
+    centers = jnp.asarray(rng.standard_normal((NLIST, DIM)), jnp.float32)
+
+    @jax.jit
+    def coarse(queries):
+        d = (jnp.sum(queries**2, 1)[:, None] + jnp.sum(centers**2, 1)[None, :]
+             - 2.0 * queries @ centers.T)
+        return select_k(d, P, select_min=True, algo="iter")
+
+    t("coarse+select_iter (4096q, 1024 lists)", lambda: coarse(queries))
+
+
+if __name__ == "__main__":
+    main()

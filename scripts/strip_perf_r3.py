@@ -1,0 +1,87 @@
+"""On-chip perf check of the strip-scan search path (round 3)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import random as rt_random
+from raft_tpu import stats
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+
+
+def force(x):
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)[..., :1]))
+
+
+def t(label, fn, reps=3):
+    out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:45s} {dt*1e3:10.1f} ms", flush=True)
+    return out, dt
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    N, DIM, Q, NLIST, K = 1_000_000, 128, 10_000, 1024, 10
+    data, _, _ = rt_random.make_blobs(
+        0, N + Q, DIM, n_clusters=4096, cluster_std=1.0, center_box=(-8.0, 8.0))
+    dataset, queries = data[:N], data[N:]
+    force(dataset)
+
+    bf_index = brute_force.build(dataset, metric="sqeuclidean")
+    gt_vals, gt_ids = brute_force.search(bf_index, queries, K, select_algo="exact")
+    force(gt_vals)
+
+    t0 = time.perf_counter()
+    flat_index = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+        n_lists=NLIST, kmeans_trainset_fraction=0.2))
+    force(flat_index.list_norms)
+    print(f"{'ivf_flat.build TOTAL':45s} {(time.perf_counter()-t0)*1e3:10.1f} ms",
+          flush=True)
+    print("max_list_size:", flat_index.max_list_size, flush=True)
+
+    (fv, fi), dt = t("flat_strip_search_10k_np32", lambda: ivf_flat.search(
+        flat_index, queries, K, n_probes=32))
+    rec = float(stats.neighborhood_recall(fi, gt_ids, fv, gt_vals))
+    print(f"  -> QPS {Q/dt:,.0f}  recall {rec:.4f}", flush=True)
+
+    t0 = time.perf_counter()
+    pq_index = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
+        n_lists=NLIST, pq_dim=DIM // 2, pq_bits=8, kmeans_trainset_fraction=0.2))
+    force(pq_index.b_sum)
+    print(f"{'ivf_pq.build TOTAL':45s} {(time.perf_counter()-t0)*1e3:10.1f} ms",
+          flush=True)
+
+    K_FETCH = 40
+
+    def pq_run(qs):
+        _, cand = ivf_pq.search(pq_index, qs, K_FETCH, n_probes=32,
+                                backend="ragged")
+        return refine.refine(dataset, qs, cand, K)
+
+    (pv, pi), dt = t("pq_strip+refine_10k_np32", lambda: pq_run(queries))
+    rec = float(stats.neighborhood_recall(pi, gt_ids, pv, gt_vals))
+    print(f"  -> QPS {Q/dt:,.0f}  recall {rec:.4f}", flush=True)
+
+    # brute force anchor with the new iter select
+    (_, _), dt = t("brute_force_10k", lambda: brute_force.search(
+        bf_index, queries, K, select_algo="exact"))
+    print(f"  -> QPS {Q/dt:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

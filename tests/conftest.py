@@ -18,6 +18,10 @@ import jax
 
 jax.config.update("jax_platforms", _platform)
 
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 import numpy as np
 import pytest
 

@@ -163,7 +163,7 @@ class TestShardedIvfFlat:
         Q = rng.standard_normal((64, 16)).astype(np.float32)
         comms = Comms(local_mesh(8))
         idx = divf.build(X, divf.IvfFlatParams(n_lists=16), comms=comms)
-        assert len(idx.shards) == 8 and idx.n_total == 4000
+        assert idx.list_data.shape[0] == 8 and idx.n_total == 4000
         v, i = divf.search(idx, Q, 10, n_probes=16)  # exhaustive probes
         _, gt = brute_force.search(brute_force.build(X), Q, 10)
         recall = float(stats.neighborhood_recall(i, gt))
@@ -182,3 +182,45 @@ class TestShardedIvfFlat:
         X = np.random.default_rng(0).standard_normal((60, 4)).astype(np.float32)
         with pytest.raises(ValueError):
             divf.build(X, divf.IvfFlatParams(n_lists=16), comms=comms)
+
+
+class TestShardedIvfPq:
+    def test_build_search_refine_matches_ground_truth(self):
+        import numpy as np
+        from raft_tpu.comms import local_mesh
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.distributed import ivf_pq as dpq
+        from raft_tpu.neighbors import brute_force, ivf_pq, refine
+        from raft_tpu import stats
+
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((4000, 32)).astype(np.float32)
+        Q = rng.standard_normal((64, 32)).astype(np.float32)
+        comms = Comms(local_mesh(8))
+        idx = dpq.build(X, ivf_pq.IvfPqParams(n_lists=16, pq_dim=16),
+                        comms=comms)
+        assert idx.list_codes.shape[0] == 8 and idx.n_total == 4000
+        # exhaustive probes + over-fetch + exact refine: recall gate
+        _, cand = dpq.search(idx, Q, 40, n_probes=16)
+        v, i = refine.refine(X, Q, cand, 10)
+        _, gt = brute_force.search(brute_force.build(X), Q, 10)
+        recall = float(stats.neighborhood_recall(i, gt))
+        assert recall >= 0.95, recall
+        ids = np.asarray(i)
+        assert ids.max() >= 3500 and ids.min() >= 0
+
+    def test_metric_cosine_runs(self):
+        import numpy as np
+        from raft_tpu.comms import local_mesh
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.distributed import ivf_pq as dpq
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((2000, 16)).astype(np.float32)
+        Q = rng.standard_normal((16, 16)).astype(np.float32)
+        comms = Comms(local_mesh(8))
+        idx = dpq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8,
+                                              metric="cosine"), comms=comms)
+        v, i = dpq.search(idx, Q, 5, n_probes=8)
+        assert v.shape == (16, 5) and int(np.asarray(i).min()) >= 0

@@ -1,0 +1,152 @@
+"""strip_scan engine vs a naive per-pair oracle (tier-1, interpret mode)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.ops.strip_scan import C, MC, plan_strips, strip_search
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(17)
+
+
+def make_lists(rng, n_lists, dim, lens):
+    chunks = max((int(max(lens)) + MC - 1) // MC, 1)
+    m = MC * (1 << (chunks - 1).bit_length())  # pow2 chunks (strip_eligible)
+    data = np.zeros((n_lists, m, dim), np.float32)
+    bias = np.full((n_lists, m), np.inf, np.float32)
+    ids = np.full((n_lists, m), -1, np.int32)
+    nxt = 0
+    for l in range(n_lists):
+        v = rng.standard_normal((lens[l], dim)).astype(np.float32)
+        data[l, : lens[l]] = v
+        bias[l, : lens[l]] = (v ** 2).sum(1)
+        ids[l, : lens[l]] = np.arange(nxt, nxt + lens[l])
+        nxt += lens[l]
+    return data, bias, ids
+
+
+def oracle_l2(queries, probes, data, ids, lens, k):
+    out = []
+    for r in range(queries.shape[0]):
+        cand = []
+        for l in probes[r]:
+            for j in range(lens[l]):
+                cand.append((((queries[r] - data[l, j]) ** 2).sum(), int(ids[l, j])))
+        cand.sort()
+        row = [c[1] for c in cand[:k]] + [-1] * max(0, k - len(cand))
+        out.append(row)
+    return np.array(out)
+
+
+class TestStripScan:
+    def test_matches_oracle_l2_with_skew_and_empty_list(self, rng):
+        n_lists, dim, q, k = 7, 16, 23, 5
+        lens = rng.integers(0, 300, n_lists)
+        lens[0] = 0  # empty list probed by everyone
+        data, bias, ids = make_lists(rng, n_lists, dim, lens)
+        queries = rng.standard_normal((q, dim)).astype(np.float32)
+        others = np.stack([rng.choice([0, 2, 3, 4, 5, 6], 2, replace=False)
+                           for _ in range(q)])
+        probes = np.concatenate(
+            [np.ones((q, 1), np.int64), others], axis=1).astype(np.int32)
+
+        v, i = strip_search(
+            queries, probes, jnp.asarray(data), jnp.asarray(bias),
+            jnp.asarray(ids), lens, k, alpha=-2.0, interpret=True,
+        )
+        v = np.asarray(v) + (queries ** 2).sum(1)[:, None]
+        want = oracle_l2(queries, probes, data, ids, lens, k)
+        got = np.asarray(i)
+        for r in range(q):
+            # tie-tolerant: ids must match where distances are distinct
+            if not (got[r] == want[r]).all():
+                wv = sorted(
+                    ((queries[r] - data[l, j]) ** 2).sum()
+                    for l in probes[r] for j in range(lens[l])
+                )[:k]
+                # bf16 matmul: ~3 significant digits; ids may swap only
+                # within that noise, so gate on the distance profile
+                np.testing.assert_allclose(np.asarray(v)[r][: len(wv)], wv,
+                                           rtol=5e-3, atol=5e-2)
+
+    def test_long_list_sub_blocks_match_oracle(self, rng):
+        # one list longer than MAX_CLASS*MC forces the sub-block merge path
+        n_lists, dim, q, k = 3, 8, 31, 7
+        lens = np.array([9000, 40, 700])
+        data, bias, ids = make_lists(rng, n_lists, dim, lens)
+        queries = rng.standard_normal((q, dim)).astype(np.float32)
+        probes = np.tile(np.arange(3, dtype=np.int32), (q, 1))
+        v, i = strip_search(
+            queries, probes, jnp.asarray(data), jnp.asarray(bias),
+            jnp.asarray(ids), lens, k, alpha=-2.0, interpret=True,
+        )
+        want = oracle_l2(queries, probes, data, ids, lens, k)
+        got = np.asarray(i)
+        v = np.asarray(v) + (queries ** 2).sum(1)[:, None]
+        for r in range(q):
+            if not (got[r] == want[r]).all():
+                wv = sorted(
+                    ((queries[r] - data[l, j]) ** 2).sum()
+                    for l in probes[r] for j in range(lens[l])
+                )[:k]
+                # expanded-form bf16: |err| ~ 2·|⟨q,x⟩|·2⁻⁸, which at these
+                # norms is ~0.1 absolute — ids may swap within that band
+                np.testing.assert_allclose(v[r][: len(wv)], wv,
+                                           rtol=2e-2, atol=2e-1)
+
+    def test_plan_work_scales_with_load_not_cap(self, rng):
+        # all queries probe one hot list: strip count must track real pairs
+        n_lists, q, p = 64, 256, 4
+        lens = np.full(n_lists, 100)
+        probes = np.stack(
+            [np.concatenate([[7], rng.choice(np.setdiff1d(np.arange(64), [7]),
+                                             p - 1, replace=False)])
+             for _ in range(q)]).astype(np.int32)
+        plan = plan_strips(probes, lens, n_lists)
+        # hot list 7: 256 pairs → ceil(256/C) strips; every other probed
+        # list needs at most 1 (≤ 64 lists)
+        assert plan.n_strips <= -(-q // C) + n_lists
+        # single class (all lists are 1 chunk long), no sub-blocks
+        assert all(w == 1 and sub == 1 for (w, sub, _, _) in plan.class_layout)
+
+    def test_strip_search_tiling_matches_single_shot(self, rng):
+        n_lists, dim, q, k = 9, 8, 600, 4
+        lens = rng.integers(50, 200, n_lists)
+        data, bias, ids = make_lists(rng, n_lists, dim, lens)
+        queries = rng.standard_normal((q, dim)).astype(np.float32)
+        probes = np.stack([rng.choice(n_lists, 3, replace=False)
+                           for _ in range(q)]).astype(np.int32)
+        v1, i1 = strip_search(queries, probes, jnp.asarray(data),
+                              jnp.asarray(bias), jnp.asarray(ids),
+                              lens, k, interpret=True)
+        # tiny workspace forces multiple tiles
+        v2, i2 = strip_search(queries, probes, jnp.asarray(data),
+                              jnp.asarray(bias), jnp.asarray(ids),
+                              lens, k, workspace_bytes=1 << 18,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_int8_cache_ranks_like_fp32(self, rng):
+        # int8 B operand with the scale folded into the query side
+        n_lists, dim, q, k = 5, 16, 40, 5
+        lens = rng.integers(30, 200, n_lists)
+        data, bias, ids = make_lists(rng, n_lists, dim, lens)
+        queries = rng.standard_normal((q, dim)).astype(np.float32)
+        probes = np.stack([rng.choice(n_lists, 3, replace=False)
+                           for _ in range(q)]).astype(np.int32)
+        scale = np.abs(data).max() / 127.0
+        data_q = np.clip(np.round(data / scale), -127, 127).astype(np.int8)
+        v8, i8 = strip_search(queries * scale, probes, jnp.asarray(data_q),
+                              jnp.asarray(bias), jnp.asarray(ids), lens, k,
+                              interpret=True)
+        want = oracle_l2(queries, probes, data, ids, lens, k)
+        # quantized ranking: top-k overlap must stay high
+        overlap = np.mean([
+            len(set(np.asarray(i8)[r]) & set(want[r])) / k for r in range(q)
+        ])
+        assert overlap >= 0.9
