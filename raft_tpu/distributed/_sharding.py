@@ -122,21 +122,23 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha):
     padding (precomputed at build)."""
     from raft_tpu.ops.strip_scan import _strip_tile_body
 
-    def body(queries, probes, qids, strip_list, pair_strip, pair_slot,
-             data, ids_arr, bias):
+    def body(queries, probes, pair_const, qids, strip_list, pair_strip,
+             pair_slot, data, ids_arr, bias):
         ld, li, b = data[0], ids_arr[0], bias[0]
         if dense:
-            vals, ids = dense_local_scan(queries, probes, ld, b, li, k, alpha)
+            vals, ids = dense_local_scan(queries, probes, ld, b, li, k,
+                                         alpha, pair_const)
         else:
             vals, ids = _strip_tile_body(
                 queries, qids, strip_list, pair_strip, pair_slot,
                 ld, b, li, class_layout, k, kf, alpha, interpret,
+                pair_const,
             )
         return merge_shards(vals, ids, k, axis)
 
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(),
+        in_specs=(P(), P(), P(), P(), P(), P(), P(),
                   P(axis, None, None, None), P(axis, None, None),
                   P(axis, None, None)),
         out_specs=(P(), P()),
@@ -146,7 +148,8 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha):
 
 
 def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
-                 alpha, dense, interpret, data, ids_arr, bias):
+                 alpha, dense, interpret, data, ids_arr, bias,
+                 pair_const=None):
     """Query-tiled SPMD search loop shared by the distributed IVF indexes.
     One host sync happened already (probes_np); every tile is one async
     shard_map dispatch."""
@@ -158,6 +161,8 @@ def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
         )
     kf = min(int(k), 512)
     q = queries_mat.shape[0]
+    if pair_const is None:
+        pair_const = jnp.zeros(probes_np.shape, jnp.float32)
     q_tile = min(q, 4096)
     out_v, out_i = [], []
     start = 0
@@ -168,6 +173,7 @@ def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
                           kf, dense, interpret, alpha)
         v, i = fn(queries_mat[start:start + qt],
                   jnp.asarray(probes_np[start:start + qt]),
+                  pair_const[start:start + qt],
                   jnp.asarray(plan.qids), jnp.asarray(plan.strip_list),
                   jnp.asarray(plan.pair_strip), jnp.asarray(plan.pair_slot),
                   data, ids_arr, bias)
@@ -179,7 +185,8 @@ def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
     return vals, ids
 
 
-def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float):
+def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float,
+                     pair_const=None):
     """Jittable dense fallback scan for shards too small for the strip
     kernel (max_list_size < 512): gather the probed lists and reduce with
     one einsum — the single-device gather backend per shard."""
@@ -187,6 +194,8 @@ def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float):
     ip = jnp.einsum("qd,qpmd->qpm", queries, cand,
                     preferred_element_type=jnp.float32)
     d = alpha * ip + bias[probes]
+    if pair_const is not None:
+        d = d + pair_const[:, :, None]
     q = queries.shape[0]
     flat_ids = li[probes].reshape(q, -1)
     d = d.reshape(q, -1)

@@ -13,9 +13,10 @@ Division of labor:
     subsample — the reference trains on a host-side subsample too,
     ivf_pq_build.cuh:1729). Every shard encodes/probes identically.
   * **Per shard**: its rows' PQ codes packed into padded lists, b_sum, and
-    the int8 decoded strip-scan cache. The dequant scale is a replicated
-    analytic bound (max |R·c_l| + max |codebook entry| per dim), so no
-    cross-shard collective is needed at cache build.
+    the int8 residual strip-scan cache. The dequant scale is
+    max|codebooks|/127 — exact, data-independent, identical on every shard
+    with no collective (the −2⟨q, R·c_l⟩ center term rides the merge's
+    exact pair_const instead of the cache).
   * **Search**: identical strip-scan plan on every shard (per-list MAX fill
     across shards), local scan, all_gather of (world·k) candidates, exact
     re-select. Pipe through neighbors/refine (sharded refine: the candidate
@@ -96,6 +97,9 @@ def build(
     n, dim = dataset.shape
     if params.n_lists * world > n:
         raise ValueError(f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
+    if params.codebook_kind != "subspace":
+        raise NotImplementedError(
+            "distributed ivf_pq supports codebook_kind='subspace' only")
     pq_dim = params.pq_dim or sl._auto_pq_dim(dim)
     dsub = -(-dim // pq_dim)
     rot_dim = pq_dim * dsub
@@ -151,28 +155,31 @@ def build(
         work_sh, gids_sh, centers, km_metric, cap, n_lists, comms)
     mls = round_mls(int(counts_np.max()), group)
 
-    # replicated dequant scale: |x̂_d| <= max_l |(Rc_l)_d| + max_cb — an
-    # analytic bound, so shards need no collective to agree on it
-    rc = sl._pad_rot(centers, rot_dim) @ rotation.T
-    scale = float(
-        (jnp.max(jnp.abs(rc)) + jnp.max(jnp.abs(codebooks))) / 127.0)
+    # replicated dequant scale for the residual-only cache: max|codebook|
+    # is exact and identical on every shard for free (see
+    # neighbors/ivf_pq._decode_lists)
+    scale = float(jnp.maximum(jnp.max(jnp.abs(codebooks)), 1e-30) / 127.0)
 
     # --- phase 2 (SPMD): encode + pack + b_sum + int8 decode ---------------
     l2 = params.metric in ("sqeuclidean", "euclidean")
+
+    code_w = sl.packed_width(pq_dim, params.pq_bits)
 
     def pack_body(rows, ids, labels):
         rows, ids, labels = rows[0], ids[0], labels[0]
         rp = rows.shape[0]
         safe_labels = jnp.minimum(labels, n_lists - 1)
         residual = sl._pad_rot(rows - centers[safe_labels], rot_dim) @ rotation.T
-        codes = sl._encode(residual.reshape(rp, pq_dim, dsub), codebooks)
+        codes = sl.pack_codes(
+            sl._encode(residual.reshape(rp, pq_dim, dsub), codebooks),
+            params.pq_bits)
         lc, li = scatter_pack(
             labels,
-            [(jnp.zeros((n_lists, mls, pq_dim), jnp.uint8), codes),
+            [(jnp.zeros((n_lists, mls, code_w), jnp.uint8), codes),
              (jnp.full((n_lists, mls), -1, jnp.int32), ids)],
             n_lists, mls)
         b_sum = sl._compute_b_sum(centers, rotation, codebooks, lc, li,
-                                  params.metric)
+                                  params.metric, pq_dim, params.pq_bits)
         if l2:  # fold the coarse-center norm in once (b_sum is +inf at pad)
             rc2 = dist_mod.sqnorm(sl._pad_rot(centers, rot_dim) @ rotation.T)
             bias = rc2[:, None] + b_sum
@@ -189,20 +196,19 @@ def build(
     ))
     list_codes, list_ids, bias = pack_fn(work_sh, gids_sh, labels_sh)
 
-    # decode with the replicated analytic scale (separate pass so the scale
-    # logic stays in one place)
-    def decode_body(lc, li):
-        dec = sl._decode_lists_scaled(centers, rotation, codebooks, lc[0],
-                                      li[0], scale)
-        return dec[None]
+    # decode with the replicated scale (separate pass so the scale logic
+    # stays in one place)
+    def decode_body(lc):
+        return sl._decode_lists_scaled(codebooks, lc[0], scale, pq_dim,
+                                       params.pq_bits)[None]
 
     decode_fn = jax.jit(jax.shard_map(
         decode_body, mesh=comms.mesh,
-        in_specs=(P(axis, None, None, None), P(axis, None, None)),
+        in_specs=(P(axis, None, None, None),),
         out_specs=P(axis, None, None, None),
         check_vma=False,
     ))
-    decoded = decode_fn(list_codes, list_ids)
+    decoded = decode_fn(list_codes)
     return ShardedIvfPqIndex(
         centers, rotation, codebooks, list_codes, list_ids, bias, decoded,
         scale, params.metric, params.pq_bits, n, comms,
@@ -234,20 +240,28 @@ def search(
     n_probes = int(min(n_probes, index.n_lists))
     l2 = index.metric in ("sqeuclidean", "euclidean")
 
-    probes = _coarse_probes(queries, index.centers, n_probes, index.metric,
-                            "exact", res.compute_dtype)
+    alpha = -2.0 if l2 else -1.0
+    # one gemm feeds both the coarse ranking and the exact per-pair center
+    # term (rotation is orthogonal: raw centers work)
+    probes, qr_scaled, _, pair_const = sl._pq_search_prep(
+        queries, index.centers, index.rotation,
+        jnp.zeros((1, 1), jnp.float32), jnp.full((1, 1), -1, jnp.int32),
+        index.decoded_scale, None, n_probes, index.metric, "exact",
+        res.compute_dtype, l2,
+    )
     probes_np = np.asarray(probes)                     # the one host sync
-    qr = sl._pad_rot(queries, index.rot_dim) @ index.rotation.T
     vals, ids = tiled_search(
-        qr * index.decoded_scale, probes_np, index.lens_max, index.n_lists,
-        int(k), index.comms, -2.0 if l2 else -1.0,
+        qr_scaled, probes_np, index.lens_max, index.n_lists,
+        int(k), index.comms, alpha,
         dense=not strip_eligible(index.max_list_size),
         interpret=jax.default_backend() != "tpu",
         data=index.decoded, ids_arr=index.list_ids, bias=index.bias,
+        pair_const=pair_const,
     )
 
     if l2:
-        vals = jnp.maximum(vals + dist_mod.sqnorm(qr)[:, None], 0.0)
+        # ‖Rq‖² == ‖q‖² (orthogonal rotation; zero-padding adds nothing)
+        vals = jnp.maximum(vals + dist_mod.sqnorm(queries)[:, None], 0.0)
         if index.metric == "euclidean":
             vals = jnp.sqrt(vals)
         vals = jnp.where(ids >= 0, vals, jnp.inf)

@@ -204,8 +204,13 @@ def build(
     if cap:
         labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
 
+    # integer datasets (uint8/int8, the big-ann on-disk formats) are stored
+    # in their own dtype — 4× less HBM than fp32; every scan upcasts to the
+    # bf16 compute type on the fly (exact for |v| <= 256)
+    store = (dataset if (jnp.issubdtype(dataset.dtype, jnp.integer)
+                         and params.metric != "cosine") else work)
     row_ids = jnp.arange(n, dtype=jnp.int32)
-    list_data, list_ids = _pack_lists(work, row_ids, labels, params.n_lists, group)
+    list_data, list_ids = _pack_lists(store, row_ids, labels, params.n_lists, group)
     list_norms = None
     if params.metric in ("sqeuclidean", "euclidean"):
         list_norms = dist_mod.sqnorm(list_data, axis=2)
@@ -250,7 +255,18 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
         base_counts=index.list_sizes(),
     )
 
-    all_vecs = jnp.concatenate([old_vecs, new_vectors])
+    if (jnp.issubdtype(index.list_data.dtype, jnp.integer)
+            and new_vectors.dtype != index.list_data.dtype):
+        # keep the integer-storage invariant (4× HBM) instead of silently
+        # promoting the whole index to fp32; integer datasets extend with
+        # integer rows, so the round/clip is exact in the expected case
+        info = jnp.iinfo(index.list_data.dtype)
+        new_store = jnp.clip(jnp.round(new_vectors), info.min, info.max) \
+            .astype(index.list_data.dtype)
+    else:
+        new_store = new_vectors.astype(index.list_data.dtype) \
+            if new_vectors.dtype != index.list_data.dtype else new_vectors
+    all_vecs = jnp.concatenate([old_vecs, new_store])
     all_ids = jnp.concatenate([old_ids, new_ids])
     all_labels = jnp.concatenate([old_labels, new_labels])
     list_data, list_ids = _pack_lists(all_vecs, all_ids, all_labels, index.n_lists, group)

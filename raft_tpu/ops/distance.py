@@ -110,7 +110,14 @@ def matmul_t(x: jax.Array, y: jax.Array, compute_dtype=None, precision=None) -> 
     fused_l2_nn) default to "highest" — their contract is numerical accuracy;
     ANN search paths default to "default" — their contract is recall.
     """
-    if compute_dtype is not None and x.dtype == jnp.float32 and compute_dtype != jnp.float32:
+    if jnp.issubdtype(x.dtype, jnp.integer) or jnp.issubdtype(y.dtype, jnp.integer):
+        # integer datasets (uint8/int8 big-ann formats) against float
+        # queries: upcast the integer operand — bf16 is exact for |v| <= 256
+        target = compute_dtype or jnp.float32
+        x = x.astype(target)
+        y = y.astype(target)
+        precision = None if compute_dtype is not None else precision
+    elif compute_dtype is not None and x.dtype == jnp.float32 and compute_dtype != jnp.float32:
         x = x.astype(compute_dtype)
         y = y.astype(compute_dtype)
         precision = None

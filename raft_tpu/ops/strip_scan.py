@@ -12,20 +12,20 @@ TPU redesign, round 3. Round 2's chunk-table scan (one grid step per
 plus a 3-stage XLA merge whose per-pair gather/top_k dominated everything
 (lax.top_k on TPU is a full sort; the qc-major gather rematerialized the
 candidate set twice). The fix is to make the unit of work a **strip**: one
-grid step covers one (list × ≤128-query block) pair across the ENTIRE list —
+grid step covers one (list × ≤C-query block) pair across the ENTIRE list —
 a single contiguous (L·512, dim) DMA instead of L separate 512-blocks — and
 to finish the per-pair top-k INSIDE the kernel, so the host-side merge
 shrinks to one gather + one small select over (q, n_probes·kf).
 
-  * Lists are length-classed: class L ∈ {1, 2, 4, 8} covers lists of up to
-    L·512 entries (list storage is padded to a power-of-two number of
-    512-blocks, so every class divides the array). Lists longer than 8·512
-    keep a (8·512, dim) working block and iterate sub-blocks via a second
-    grid dimension, merging running top-kf across revisits — VMEM stays
-    bounded at ~2 MB for the score block no matter the list length.
+  * Lists are length-classed: class L ∈ {1..MAX_CLASS} (pow2) covers
+    lists of up to L·512 entries (list storage is padded to a power-of-two
+    number of 512-blocks, so every class divides the array). Longer lists
+    keep a (MAX_CLASS·512, dim) working block and iterate sub-blocks via a
+    second grid dimension, merging running top-kf across revisits — VMEM
+    stays bounded no matter the list length.
   * Per strip: one MXU matmul (C, dim) × (W, dim)ᵀ → (C, W) fp32 scores
-    (+ per-entry bias, +inf at padding), then kf masked-min passes on the
-    VPU extract the per-(query, list) top-kf values + within-list offsets.
+    (+ per-entry bias, +inf at padding), then a strided-bin tournament
+    top-k on the VPU extracts per-(query, list) top-kf values + offsets.
     A (query, probe) pair maps to exactly one strip slot, so these ARE the
     per-pair candidates — no cross-chunk reduction exists anymore.
   * The merge is one XLA gather of (q, p, kf) candidate rows followed by an
@@ -60,9 +60,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-C = 128          # queries per strip (MXU M dim)
+C = 192          # queries per strip (MXU M dim; fewer, fatter strips
+                 # amortize the measured ~25 µs fixed per-strip cost;
+                 # 256 measured a VMEM stack OOM at kf=40)
 MC = 512         # base entry block; class-L strips read L*MC entries at once
-MAX_CLASS = 8    # biggest single-fetch strip (8*512 entries = 2 MB fp32 scores)
+MAX_CLASS = 2    # biggest single-fetch strip: at C=256 queries, the
+                 # (C, W) score block + tournament temporaries must stay
+                 # inside ~16 MB VMEM; w=4 measured OOM at kf=40
 
 
 def _ceil_div(a, b):
@@ -180,31 +184,89 @@ def plan_strips(probes: np.ndarray, lens: np.ndarray, n_lists: int) -> StripPlan
     )
 
 
+def _extract_topk(v, offs, kf: int):
+    """kf masked-min passes over (C, n): (vals (C, kf), offsets (C, kf)).
+    Offset picks use a one-hot sum — no gathers in-kernel. A fori_loop (not
+    a Python unroll) keeps one live copy of the working block: the unrolled
+    form held ~kf copies and blew Mosaic's 16 MB scoped-vmem stack at
+    kf=40."""
+    c, n = v.shape
+    cols = lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    kcols = lax.broadcasted_iota(jnp.int32, (c, kf), 1)
+
+    def body(i, carry):
+        v, vals, es = carry
+        mn = jnp.min(v, axis=1)
+        am = jnp.min(jnp.where(v <= mn[:, None], cols, n), axis=1)
+        hit = cols == am[:, None]
+        e = jnp.sum(jnp.where(hit, offs, 0), axis=1)
+        sel = kcols == i
+        vals = jnp.where(sel, mn[:, None], vals)
+        es = jnp.where(sel, e[:, None], es)
+        return jnp.where(hit, jnp.inf, v), vals, es
+
+    _, vals, es = lax.fori_loop(
+        0, kf, body,
+        (v, jnp.full((c, kf), jnp.inf, jnp.float32),
+         jnp.zeros((c, kf), jnp.int32)),
+    )
+    return vals, es
+
+
+_NB = 128   # tournament bin count (strided: bin j = cols ≡ j mod _NB —
+            # a full VPU lane row, so the per-bin reductions stay wide)
+_KEEP = 4   # per-bin survivors in the tournament pool
+
+
+def _topk_block(s, kf: int, w: int):
+    """Top-kf of a (C, w) score block.
+
+    Direct kf masked-min passes cost kf·C·w VPU work — the kernel's
+    dominant cost at round-3 profiling. For kf ≥ 16 the block first plays a
+    tournament: keep the _KEEP smallest of each of _NB strided bins (built
+    with _KEEP passes reduced along the small axis of a (C, w/_NB, _NB)
+    view — the minor dim stays a full 128 lanes), then extract kf from the
+    _KEEP·_NB pool: (_KEEP·w + kf·_KEEP·_NB) vs kf·w work, ~1.7× at kf=40,
+    w=1024. Exact unless > _KEEP of a row's true top-kf collide in one bin
+    (entries land in bins by storage position, arbitrary w.r.t. distance —
+    a small tail event, and the kf ≥ 16 callers over-fetch + re-rank).
+    """
+    c = s.shape[0]
+    bs = w // _NB
+    if kf < 16 or bs < 2 or kf >= bs * _KEEP:
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return _extract_topk(s, cols, kf)
+    sv = s.reshape(c, bs, _NB)
+    rows3 = lax.broadcasted_iota(jnp.int32, sv.shape, 1)
+    binc = lax.broadcasted_iota(jnp.int32, (c, _NB), 1)
+    pool_v, pool_o = [], []
+    for _ in range(_KEEP):
+        mn = jnp.min(sv, axis=1)                       # (C, _NB)
+        am = jnp.min(jnp.where(sv <= mn[:, None, :], rows3, bs), axis=1)
+        pool_v.append(mn)
+        pool_o.append(am * _NB + binc)                 # strided col index
+        sv = jnp.where(rows3 == am[:, None, :], jnp.inf, sv)
+    pv = jnp.concatenate(pool_v, axis=1)               # (C, _KEEP·_NB)
+    po = jnp.concatenate(pool_o, axis=1)
+    return _extract_topk(pv, po, kf)
+
+
 def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref, oute_ref, *,
                   alpha, kf, w, n_sub):
     """One strip (× one sub-block when n_sub > 1): matmul + fused top-kf.
 
-    Scores = alpha·(A @ Bᵀ) + bias, smaller is better; kf masked-min passes
-    (3 VPU ops per element per pass) extract per-row top-kf values and
-    within-list entry offsets. Sub-block revisits merge the running top-kf
-    via a concat + kf passes over the 2·kf-wide block (value-indexed picks
-    use a one-hot sum — no gathers in-kernel)."""
+    Scores = alpha·(A @ Bᵀ) + bias, smaller is better; the tournament
+    top-k (_topk_block) extracts per-row top-kf values and within-list
+    entry offsets. Sub-block revisits merge the running top-kf via a
+    concat + kf passes over the 2·kf-wide block."""
     a = a_ref[0]                                   # (C, dim) bf16
     b = b_ref[0].astype(jnp.bfloat16)              # (w, dim)
     s = lax.dot_general(a, b, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)
     s = alpha * s + bias_ref[0]                    # (C, w)
-    cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    off = pl.program_id(1) * w if n_sub > 1 else 0
-    vs, es = [], []
-    for _ in range(kf):
-        mn = jnp.min(s, axis=1)
-        am = jnp.min(jnp.where(s <= mn[:, None], cols, w), axis=1)
-        vs.append(mn)
-        es.append(off + am)
-        s = jnp.where(cols == am[:, None], jnp.inf, s)
-    nv = jnp.stack(vs, axis=1)                     # (C, kf)
-    ne = jnp.stack(es, axis=1)
+    nv, ne = _topk_block(s, kf, w)                 # (C, kf) each
+    if n_sub > 1:
+        ne = ne + pl.program_id(1) * w
 
     if n_sub == 1:
         outv_ref[0] = nv
@@ -222,17 +284,9 @@ def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref, oute_ref, *,
     def _():
         cv = jnp.concatenate([outv_ref[0], nv], axis=1)    # (C, 2kf)
         ce = jnp.concatenate([oute_ref[0], ne], axis=1)
-        cols2 = lax.broadcasted_iota(jnp.int32, cv.shape, 1)
-        mvs, mes = [], []
-        for _ in range(kf):
-            mn = jnp.min(cv, axis=1)
-            am = jnp.min(jnp.where(cv <= mn[:, None], cols2, 2 * kf), axis=1)
-            hit = cols2 == am[:, None]
-            mvs.append(mn)
-            mes.append(jnp.sum(jnp.where(hit, ce, 0), axis=1))
-            cv = jnp.where(hit, jnp.inf, cv)
-        outv_ref[0] = jnp.stack(mvs, axis=1)
-        oute_ref[0] = jnp.stack(mes, axis=1)
+        mv, me = _extract_topk(cv, ce, kf)
+        outv_ref[0] = mv
+        oute_ref[0] = me
 
 
 @functools.partial(
@@ -283,10 +337,15 @@ def _strip_class_call(strip_list, a_grouped, list_data, bias3,
 def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
                      list_data, bias, list_ids,
                      class_layout, k: int, kf: int, alpha: float,
-                     interpret: bool):
+                     interpret: bool, pair_const=None):
     """One query tile: group the query side per strip, run every length
     class, then the two-gather merge. Plain traceable function so SPMD
-    callers can run it inside shard_map (distributed/ivf_*)."""
+    callers can run it inside shard_map (distributed/ivf_*).
+
+    ``pair_const`` (q, p): optional per-(query, probe) additive constant,
+    applied AFTER the in-kernel extraction — it cannot change within-pair
+    ranking, so this is exact. IVF-PQ uses it for the −2⟨q, R·c_l⟩ term so
+    the int8 cache only has to carry the (much smaller) residuals."""
     n_lists, m = list_data.shape[0], list_data.shape[1]
     a_grouped = jnp.where(
         (qids >= 0)[:, :, None],
@@ -307,10 +366,30 @@ def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
     out_v = jnp.concatenate(outs_v, axis=0) if len(outs_v) > 1 else outs_v[0]
     out_e = jnp.concatenate(outs_e, axis=0) if len(outs_e) > 1 else outs_e[0]
 
-    # merge: (q, p, kf) candidate gather -> top-k -> id translate
+    # pair_strip uses the PLAN's strip numbering (device plans leave gaps
+    # between class regions); the class outputs above are concatenated
+    # densely — remap by the static per-class delta (identity for gap-free
+    # host plans). Without this the merge reads the wrong rows whenever a
+    # class's padded count is below its region size (round-3 on-chip bug:
+    # recall collapsed to 0.16 while every small CPU test's buckets happened
+    # to equal the region size).
     q, p = pair_strip.shape
-    cand_v = out_v[pair_strip, pair_slot].reshape(q, p * kf)
-    cand_e = out_e[pair_strip, pair_slot].reshape(q, p * kf)
+    if len(class_layout) > 1:
+        concat_starts = np.cumsum([0] + [cnt for (_, _, _, cnt)
+                                         in class_layout[:-1]])
+        deltas = np.asarray(
+            [int(cs - start) for cs, (_, _, start, _)
+             in zip(concat_starts, class_layout)], np.int32)
+        cls_idx = sum((pair_strip >= start).astype(jnp.int32)
+                      for (_, _, start, _) in class_layout[1:])
+        pair_strip_c = pair_strip + jnp.asarray(deltas)[cls_idx]
+    else:
+        pair_strip_c = pair_strip - class_layout[0][2]
+    cand_v = out_v[pair_strip_c, pair_slot]
+    if pair_const is not None:
+        cand_v = cand_v + pair_const[:, :, None]
+    cand_v = cand_v.reshape(q, p * kf)
+    cand_e = out_e[pair_strip_c, pair_slot].reshape(q, p * kf)
     from raft_tpu.ops.select_k import iter_topk_min
 
     kk = min(k, p * kf)
@@ -335,6 +414,75 @@ _strip_tile = jax.jit(
 )
 
 
+def class_info(lens_np: np.ndarray):
+    """Static per-index class table from per-list lengths: ordered distinct
+    (w_blocks, n_sub) classes and each list's class ordinal."""
+    n_mc = np.maximum(-(-np.maximum(lens_np, 0) // MC), 1)
+    cls_full = (1 << np.ceil(np.log2(n_mc)).astype(np.int64))
+    w = np.minimum(cls_full, MAX_CLASS)
+    sub = np.maximum(cls_full // MAX_CLASS, 1)
+    keys = w * (1 << 20) + sub
+    uniq = np.unique(keys)
+    ordinal = np.searchsorted(uniq, keys).astype(np.int32)
+    classes = [(int(k_ >> 20), int(k_ & ((1 << 20) - 1))) for k_ in uniq]
+    return classes, ordinal
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_lists", "n_classes", "s_region"),
+)
+def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
+                 s_region: int):
+    """Device-side strip planning (round-3 v3): the host↔device link on the
+    tunneled TPU measured ~25 MB/s, so host-built plan tables (a few MB per
+    tile) dominated search latency. This builds the same tables with jnp
+    sorts/scatters ON DEVICE; the host only fetches the per-class strip
+    counts (a few ints) to fix the static grid sizes.
+
+    Strips live in fixed per-class regions of ``s_region`` slots (region c
+    starts at c·s_region); unused slots carry qids=-1 / strip_list=0 and are
+    never read by the merge. Returns (qids, strip_list, pair_strip,
+    pair_slot, counts_per_class)."""
+    q, p = probes.shape
+    qp = q * p
+    flat = probes.reshape(-1)
+    order = jnp.argsort(flat, stable=True)
+    sorted_lists = flat[order]
+    r = jnp.bincount(flat, length=n_lists)
+    n_qc = -(-r // C)                                  # strips per list
+
+    # class-major list layout: lists sorted by (class, id); each list's
+    # strip base = its class region start + strips of earlier lists in class
+    list_order = jnp.argsort(cls_ord * n_lists
+                             + jnp.arange(n_lists, dtype=jnp.int32))
+    n_qc_sorted = n_qc[list_order]
+    csum = jnp.cumsum(n_qc_sorted) - n_qc_sorted       # exclusive, global
+    cls_sorted = cls_ord[list_order]
+    counts = jax.ops.segment_sum(n_qc_sorted, cls_sorted,
+                                 num_segments=n_classes)
+    class_first = jnp.cumsum(counts) - counts          # exclusive
+    base_sorted = cls_sorted * s_region + (csum - class_first[cls_sorted])
+    strip_base = jnp.zeros(n_lists, jnp.int32).at[list_order].set(
+        base_sorted.astype(jnp.int32))
+
+    pair_off = jnp.cumsum(r) - r
+    rank = (jnp.arange(qp, dtype=jnp.int32)
+            - pair_off[sorted_lists].astype(jnp.int32))
+    ps_sorted = strip_base[sorted_lists] + rank // C
+    slot_sorted = rank % C
+    pair_strip = jnp.zeros(qp, jnp.int32).at[order].set(ps_sorted)
+    pair_slot = jnp.zeros(qp, jnp.int32).at[order].set(slot_sorted)
+
+    s_tot = n_classes * s_region
+    strip_list = jnp.zeros(s_tot, jnp.int32).at[ps_sorted].set(
+        sorted_lists.astype(jnp.int32))
+    qids = jnp.full((s_tot, C), -1, jnp.int32).at[ps_sorted, slot_sorted].set(
+        (order // p).astype(jnp.int32))
+    return (qids, strip_list, pair_strip.reshape(q, p),
+            pair_slot.reshape(q, p), counts)
+
+
 def strip_search(
     queries_mat,
     probes,
@@ -346,6 +494,7 @@ def strip_search(
     alpha: float = -2.0,
     workspace_bytes: int = 1 << 30,
     interpret: bool = False,
+    pair_const=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Full strip scan: probes (q, p) int32 → per-query top-k over the
     probed lists' entries. Drop-in contract of round 2's ragged_search:
@@ -365,7 +514,6 @@ def strip_search(
     fp32 distances themselves are the product.
     """
     q = queries_mat.shape[0]
-    probes_np = np.asarray(probes)
     lens_np = np.asarray(lens)
     n_lists, m = list_data.shape[0], list_data.shape[1]
     if m % MC or (m // MC) & (m // MC - 1):
@@ -380,24 +528,43 @@ def strip_search(
 
     from raft_tpu.core.interruptible import check_interrupt
 
-    # tile so the kernel outputs + candidate blocks stay inside the budget
-    q_tile = min(q, 4096)
+    classes, cls_ord_np = class_info(lens_np)
+    cls_ord = jnp.asarray(cls_ord_np)  # 4 KB — the only per-search upload
+    n_classes = len(classes)
+    probes_dev = jnp.asarray(probes)
+    p = probes_dev.shape[1]
+
+    # tile sizing: per-tile device tables + kernel outputs within workspace
+    q_tile = min(q, 16384)
+
+    def s_region_for(qt):
+        return _bucket(_ceil_div(qt * p, C) + n_lists)
+
+    while (s_region_for(q_tile) * n_classes * C * (kf * 8 + 4)
+           > workspace_bytes and q_tile > 512):
+        q_tile //= 2
+
     out_v, out_i = [], []
     start = 0
     while start < q:
         check_interrupt()
         qt = min(q_tile, q - start)
-        plan = plan_strips(probes_np[start:start + qt], lens_np, n_lists)
-        while plan.s_pad * C * kf * 8 * 2 > workspace_bytes and q_tile > 256:
-            q_tile //= 2
-            qt = min(q_tile, q - start)
-            plan = plan_strips(probes_np[start:start + qt], lens_np, n_lists)
+        s_region = s_region_for(qt)
+        qids, strip_list, pair_strip, pair_slot, counts = _plan_device(
+            lax.slice_in_dim(probes_dev, start, start + qt, axis=0),
+            cls_ord, n_lists, n_classes, s_region,
+        )
+        counts_np = np.asarray(counts)  # ~n_classes ints — the only fetch
+        layout = tuple(
+            (classes[c][0], classes[c][1], c * s_region,
+             min(_bucket(int(counts_np[c])), s_region))
+            for c in range(n_classes) if counts_np[c] > 0
+        ) or ((1, 1, 0, 1),)
         v, i = _strip_tile(
-            queries_mat[start:start + qt],
-            jnp.asarray(plan.qids), jnp.asarray(plan.strip_list),
-            jnp.asarray(plan.pair_strip), jnp.asarray(plan.pair_slot),
-            list_data, list_bias, list_ids,
-            plan.class_layout, int(k), kf, float(alpha), bool(interpret),
+            queries_mat[start:start + qt], qids, strip_list, pair_strip,
+            pair_slot, list_data, list_bias, list_ids,
+            layout, int(k), kf, float(alpha), bool(interpret),
+            None if pair_const is None else pair_const[start:start + qt],
         )
         out_v.append(v)
         out_i.append(i)

@@ -1,0 +1,96 @@
+"""End-to-end phase bisect of ivf_flat strip search on the real index."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import random as rt_random
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_flat import _coarse_probes, _lens_np, _ragged_bias
+from raft_tpu.ops import strip_scan as ss
+
+
+def force(x):
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)[..., :1]))
+
+
+def t(label, fn, reps=5):
+    out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:52s} {dt*1e3:9.1f} ms", flush=True)
+    return out
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    N, DIM, Q, NLIST, K = 1_000_000, 128, 10_000, 1024, 10
+    data, _, _ = rt_random.make_blobs(
+        0, N + Q, DIM, n_clusters=4096, cluster_std=1.0, center_box=(-8.0, 8.0))
+    dataset, queries = data[:N], data[N:]
+    force(dataset)
+    idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+        n_lists=NLIST, kmeans_trainset_fraction=0.2))
+    force(idx.list_norms)
+    lens = _lens_np(idx)
+    print("mls", idx.max_list_size, "len histo",
+          np.percentile(lens, [50, 90, 99, 100]).tolist(), flush=True)
+
+    from raft_tpu.core.resources import current_resources
+    res = current_resources()
+    probes = t("coarse_probes (jit, 10k)", lambda: _coarse_probes(
+        queries, idx.centers, 32, idx.metric, "exact", res.compute_dtype))
+    t0 = time.perf_counter()
+    probes_np = np.asarray(probes)
+    print(f"{'probes fetch (sync)':52s} {1e3*(time.perf_counter()-t0):9.1f} ms",
+          flush=True)
+
+    t0 = time.perf_counter()
+    plans = [ss.plan_strips(probes_np[s:s + 4096], lens, NLIST)
+             for s in range(0, Q, 4096)]
+    print(f"{'plan_strips x{}'.format(len(plans)):52s} "
+          f"{1e3*(time.perf_counter()-t0):9.1f} ms", flush=True)
+    for p in plans:
+        print("  layout", p.class_layout, flush=True)
+
+    bias = _ragged_bias(idx.list_ids, idx.list_norms, None, "l2")
+    force(bias)
+
+    t("full strip_search (batched)", lambda: ss.strip_search(
+        queries, probes, idx.list_data, bias, idx.list_ids, lens, K,
+        interpret=False), reps=3)
+
+    # single batch group: first two tiles (same layout?)
+    p0 = plans[0]
+    qs = jnp.stack([queries[0:4096]])
+    qids_t = jnp.asarray(np.stack([p0.qids]))
+    sl_t = jnp.asarray(np.stack([p0.strip_list]))
+    ps_t = jnp.asarray(np.stack([p0.pair_strip]))
+    slot_t = jnp.asarray(np.stack([p0.pair_slot]))
+    t("one-tile batch call (incl uploads)", lambda: ss._strip_tile_batch(
+        jnp.stack([queries[0:4096]]), jnp.asarray(np.stack([p0.qids])),
+        jnp.asarray(np.stack([p0.strip_list])),
+        jnp.asarray(np.stack([p0.pair_strip])),
+        jnp.asarray(np.stack([p0.pair_slot])),
+        idx.list_data, bias, idx.list_ids,
+        p0.class_layout, K, K, -2.0, False), reps=3)
+    t("one-tile batch call (pre-uploaded)", lambda: ss._strip_tile_batch(
+        qs, qids_t, sl_t, ps_t, slot_t, idx.list_data, bias, idx.list_ids,
+        p0.class_layout, K, K, -2.0, False), reps=5)
+
+
+if __name__ == "__main__":
+    main()

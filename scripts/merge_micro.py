@@ -1,0 +1,106 @@
+"""Bisect the strip tile's non-kernel stages + gather variants."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import strip_scan as ss
+from raft_tpu.ops.select_k import iter_topk_min
+
+
+def force(x):
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)[..., :1]))
+
+
+def t(label, fn, reps=5):
+    out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:56s} {dt*1e3:9.1f} ms", flush=True)
+    return out
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    rng = np.random.default_rng(0)
+    NLIST, DIM, Q, P = 1024, 128, 4096, 32
+    m = 4096
+    lens = np.full(NLIST, 977, np.int32)
+    probes = np.stack([rng.choice(NLIST, P, replace=False) for _ in range(Q)])
+    plan = ss.plan_strips(probes.astype(np.int32), lens, NLIST)
+    S = plan.s_pad
+    print(f"S={S} layout={plan.class_layout}", flush=True)
+
+    queries = jnp.asarray(rng.standard_normal((Q, DIM)), jnp.float32)
+    qids = jnp.asarray(plan.qids)
+    pair_strip = jnp.asarray(plan.pair_strip)
+    pair_slot = jnp.asarray(plan.pair_slot)
+    sl = jnp.asarray(plan.strip_list)
+    ids = jnp.arange(NLIST * m, dtype=jnp.int32).reshape(NLIST, m)
+
+    for kf in (10, 40):
+        out_v = jnp.asarray(rng.standard_normal((S, ss.C, kf)), jnp.float32)
+        out_e = jnp.asarray(rng.integers(0, m, (S, ss.C, kf)), jnp.int32)
+        force(out_v)
+
+        @jax.jit
+        def agroup(queries, qids):
+            return jnp.where((qids >= 0)[:, :, None],
+                             queries[jnp.clip(qids, 0), :], 0).astype(jnp.bfloat16)
+
+        t(f"kf={kf} a_grouped gather", lambda: agroup(queries, qids))
+
+        @jax.jit
+        def cand_gather(out_v, out_e, pair_strip, pair_slot):
+            cv = out_v[pair_strip, pair_slot].reshape(Q, P * kf)
+            ce = out_e[pair_strip, pair_slot].reshape(Q, P * kf)
+            return cv, ce
+
+        cv, ce = t(f"kf={kf} cand gather (2d adv-index)", lambda: cand_gather(
+            out_v, out_e, pair_strip, pair_slot))
+
+        @jax.jit
+        def cand_gather_flat(out_v, out_e, pair_strip, pair_slot):
+            flat = (pair_strip * ss.C + pair_slot).reshape(-1)
+            cv = jnp.take(out_v.reshape(S * ss.C, kf), flat, axis=0)
+            ce = jnp.take(out_e.reshape(S * ss.C, kf), flat, axis=0)
+            return cv.reshape(Q, P * kf), ce.reshape(Q, P * kf)
+
+        t(f"kf={kf} cand gather (flat take)", lambda: cand_gather_flat(
+            out_v, out_e, pair_strip, pair_slot))
+
+        @jax.jit
+        def final_select(cv, ce, pair_strip):
+            vals, sel = iter_topk_min(cv, min(kf, P * kf))
+            win_list = jnp.take_along_axis(
+                sl[pair_strip], sel // kf, axis=1)
+            win_off = jnp.take_along_axis(ce, sel, axis=1)
+            out_ids = ids[win_list, win_off]
+            return vals, out_ids
+
+        t(f"kf={kf} final select+translate (k={kf})", lambda: final_select(
+            cv, ce, pair_strip))
+
+        @jax.jit
+        def final_topk(cv):
+            nv, s_ = jax.lax.top_k(-cv, kf)
+            return -nv, s_
+
+        t(f"kf={kf} final lax.top_k (k={kf})", lambda: final_topk(cv))
+
+
+if __name__ == "__main__":
+    main()

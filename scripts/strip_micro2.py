@@ -1,0 +1,90 @@
+"""Tournament-kernel micro-bench (round 3, iteration 2)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import strip_scan as ss
+
+
+def force(x):
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)[..., :1]))
+
+
+def t(label, fn, reps=5):
+    out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    force(out if not isinstance(out, tuple) else out[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:52s} {dt*1e3:9.1f} ms", flush=True)
+    return out, dt
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    rng = np.random.default_rng(0)
+    NLIST, DIM, Q, P = 1024, 128, 4096, 32
+    m = 4096
+    lens = np.full(NLIST, 977, np.int32)
+    lens[:64] = 3900
+    probes = np.stack([rng.choice(NLIST, P, replace=False) for _ in range(Q)])
+    plan = ss.plan_strips(probes.astype(np.int32), lens, NLIST)
+    print(f"n_strips={plan.n_strips} s_pad={plan.s_pad} layout={plan.class_layout}",
+          flush=True)
+
+    queries = jnp.asarray(rng.standard_normal((Q, DIM)), jnp.float32)
+    qids = jnp.asarray(plan.qids)
+    data32 = jnp.asarray(rng.standard_normal((NLIST, m, DIM)), jnp.float32)
+    data16 = data32.astype(jnp.bfloat16)
+    data8 = jnp.clip(jnp.round(data32 * 30), -127, 127).astype(jnp.int8)
+    bias = jnp.zeros((NLIST, m), jnp.float32)
+    ids = jnp.arange(NLIST * m, dtype=jnp.int32).reshape(NLIST, m)
+    force(data8); force(data16)
+
+    @jax.jit
+    def agroup(queries, qids):
+        return jnp.where((qids >= 0)[:, :, None],
+                         queries[jnp.clip(qids, 0), :], 0).astype(jnp.bfloat16)
+
+    ag, _ = t("a_grouped gather", lambda: agroup(queries, qids))
+    sl = jnp.asarray(plan.strip_list)
+    bias3 = bias.reshape(NLIST, 1, m)
+
+    for kf in (10, 40):
+        for name, d in (("fp32", data32), ("bf16", data16), ("int8", data8)):
+            tot = 0.0
+            for (w, sub, start, cnt) in plan.class_layout:
+                _, dt = t(f"class w={w} cnt={cnt} kf={kf} {name}",
+                          lambda w=w, sub=sub, start=start, cnt=cnt, kf=kf, d=d:
+                          ss._strip_class_call(
+                              jax.lax.slice_in_dim(sl, start, start + cnt),
+                              jax.lax.slice_in_dim(ag, start, start + cnt),
+                              d, bias3, w, sub, -2.0, kf, False))
+                tot += dt
+            print(f"  == kernels total kf={kf} {name}: {tot*1e3:.1f} ms", flush=True)
+
+    for kf in (10, 40):
+        t(f"full tile kf={kf} int8", lambda kf=kf: ss._strip_tile(
+            queries, qids, sl, jnp.asarray(plan.pair_strip),
+            jnp.asarray(plan.pair_slot), data8, bias, ids,
+            plan.class_layout, kf, kf, -2.0, False))
+        t(f"full tile kf={kf} bf16", lambda kf=kf: ss._strip_tile(
+            queries, qids, sl, jnp.asarray(plan.pair_strip),
+            jnp.asarray(plan.pair_slot), data16, bias, ids,
+            plan.class_layout, kf, kf, -2.0, False))
+
+
+if __name__ == "__main__":
+    main()

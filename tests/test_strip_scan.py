@@ -150,3 +150,29 @@ class TestStripScan:
             len(set(np.asarray(i8)[r]) & set(want[r])) / k for r in range(q)
         ])
         assert overlap >= 0.9
+
+    def test_multi_class_region_remap(self, rng):
+        """Regression: device plans leave gaps between class regions; the
+        merge must remap into the densely concatenated kernel outputs.
+        Needs per-class padded counts BELOW the region size to trigger
+        (n_lists large relative to per-class strip counts)."""
+        n_lists, dim, q, k = 300, 8, 200, 5
+        lens = np.where(np.arange(n_lists) % 2 == 0, 100, 900)  # 2 classes
+        data, bias, ids = make_lists(rng, n_lists, dim, lens)
+        queries = rng.standard_normal((q, dim)).astype(np.float32)
+        probes = np.stack([rng.choice(n_lists, 4, replace=False)
+                           for _ in range(q)]).astype(np.int32)
+        v, i = strip_search(queries, probes, jnp.asarray(data),
+                            jnp.asarray(bias), jnp.asarray(ids), lens, k,
+                            interpret=True)
+        want = oracle_l2(queries, probes, data, ids, lens, k)
+        got = np.asarray(i)
+        v = np.asarray(v) + (queries ** 2).sum(1)[:, None]
+        for r in range(q):
+            if not (got[r] == want[r]).all():
+                wv = sorted(
+                    ((queries[r] - data[l, j]) ** 2).sum()
+                    for l in probes[r] for j in range(lens[l])
+                )[:k]
+                np.testing.assert_allclose(v[r][: len(wv)], wv,
+                                           rtol=2e-2, atol=2e-1)
