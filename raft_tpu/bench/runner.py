@@ -64,6 +64,14 @@ def _load_dataset(spec: Dict):
             center_box=(-8.0, 8.0),
         )
         return data[:n], data[n:]
+    if kind == "siftlike":
+        from raft_tpu.bench.datasets import sift_like
+
+        data, queries = sift_like(
+            int(spec["n"]), int(spec.get("dim", 128)),
+            int(spec.get("n_queries", 10_000)), int(spec.get("seed", 0)))
+        return (jnp.asarray(data, jnp.float32),
+                jnp.asarray(queries, jnp.float32))
     raise ValueError(f"unknown dataset kind {kind!r}")
 
 
@@ -157,6 +165,9 @@ def run_benchmark(config: Dict, reps: int = 3) -> List[Dict]:
 
 
 def main(argv=None):
+    from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("config", help="JSON config path")
     ap.add_argument("-o", "--output", default=None, help="results JSON path")
