@@ -171,18 +171,32 @@ def run_suite():
         return idx
 
     pq_index, cold_s, warm_s = timed_build(build_pq)
-    K_FETCH = 4 * K  # over-fetch then exact re-rank, refine-inl.cuh:70 style
+    # over-fetch then exact re-rank (refine-inl.cuh:70 style): escalate
+    # nprobe at 4x over-fetch until the recall gate holds, then shrink the
+    # over-fetch while the gate still holds — the fetch width sets the
+    # in-kernel top-kf cost and the merge width, so the smallest passing
+    # K_FETCH is the fastest configuration
     pq = None
     for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8):
-        _, cand = ivf_pq.search(pq_index, queries, K_FETCH, n_probes=nprobe)
+        _, cand = ivf_pq.search(pq_index, queries, 4 * K, n_probes=nprobe)
         vals, ids = refine.refine(dataset, queries, cand, K)
         recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
         if pq is None or recall > pq["recall"]:
-            pq = {"nprobe": nprobe, "recall": round(recall, 4)}
+            pq = {"nprobe": nprobe, "recall": round(recall, 4), "k_fetch": 4 * K}
         if recall >= 0.95:
             break
+    if pq["recall"] >= 0.95:
+        for kf in (2 * K, K):
+            _, cand = ivf_pq.search(pq_index, queries, kf, n_probes=pq["nprobe"])
+            vals, ids = refine.refine(dataset, queries, cand, K)
+            recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+            if recall < 0.95:
+                break
+            pq.update(recall=round(recall, 4), k_fetch=kf)
+
     def pq_timed(qs):
-        _, cand = ivf_pq.search(pq_index, qs, K_FETCH, n_probes=pq["nprobe"])
+        _, cand = ivf_pq.search(pq_index, qs, pq["k_fetch"],
+                                n_probes=pq["nprobe"])
         return refine.refine(dataset, qs, cand, K)
 
     pq["qps"] = round(_time_qps(pq_timed, queries, REPS), 1)
@@ -226,6 +240,8 @@ def run_suite():
             cq, max(1, REPS // 2)), 1)
         best["build_s"] = round(cbuild, 1)
         best["n"] = cn
+        best["q"] = int(cq.shape[0])  # smaller batch than the suite's Q —
+        # QPS amortizes the runtime's fixed dispatch cost differently
         extras["cagra"] = best
     except Exception as e:  # a cagra failure must not sink the headline
         extras["cagra"] = {"error": repr(e)[:300]}

@@ -26,8 +26,11 @@ coarse gemm, and a code→A lookup. The lookup itself has two backends:
   * the Pallas list-centric kernel (ops/pq_scan.py) — queries batched as the
     MXU N-dimension against in-VMEM one-hot code blocks (used on TPU).
 
-Codes are stored one byte per sub-dimension in padded dense lists like
-ivf_flat (XLA static shapes; kIndexGroupSize-aligned).
+Codes are stored tightly bit-packed (pq_bits 4..8, pack_codes) in padded
+dense lists like ivf_flat (XLA static shapes; kIndexGroupSize-aligned);
+search reads an int8 RESIDUAL reconstruction cache (rot_dim bytes/entry,
+see _decode_lists) through the strip kernel, with the exact per-pair
+center term applied at the merge.
 """
 
 from __future__ import annotations

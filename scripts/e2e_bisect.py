@@ -73,23 +73,6 @@ def main():
         queries, probes, idx.list_data, bias, idx.list_ids, lens, K,
         interpret=False), reps=3)
 
-    # single batch group: first two tiles (same layout?)
-    p0 = plans[0]
-    qs = jnp.stack([queries[0:4096]])
-    qids_t = jnp.asarray(np.stack([p0.qids]))
-    sl_t = jnp.asarray(np.stack([p0.strip_list]))
-    ps_t = jnp.asarray(np.stack([p0.pair_strip]))
-    slot_t = jnp.asarray(np.stack([p0.pair_slot]))
-    t("one-tile batch call (incl uploads)", lambda: ss._strip_tile_batch(
-        jnp.stack([queries[0:4096]]), jnp.asarray(np.stack([p0.qids])),
-        jnp.asarray(np.stack([p0.strip_list])),
-        jnp.asarray(np.stack([p0.pair_strip])),
-        jnp.asarray(np.stack([p0.pair_slot])),
-        idx.list_data, bias, idx.list_ids,
-        p0.class_layout, K, K, -2.0, False), reps=3)
-    t("one-tile batch call (pre-uploaded)", lambda: ss._strip_tile_batch(
-        qs, qids_t, sl_t, ps_t, slot_t, idx.list_data, bias, idx.list_ids,
-        p0.class_layout, K, K, -2.0, False), reps=5)
 
 
 if __name__ == "__main__":

@@ -54,10 +54,7 @@ class TestCompiledStrip:
         # top-(k+5) instead of exact top-k set equality
         vg, ig = ivf_flat.search(idx, qs, 15, n_probes=16, backend="gather")
         vr, ir = ivf_flat.search(idx, qs, 10, n_probes=16, backend="ragged")
-        ig_np, ir_np = np.asarray(ig), np.asarray(ir)
-        contained = np.mean([
-            len(set(ir_np[r]) & set(ig_np[r])) / 10 for r in range(ir_np.shape[0])
-        ])
+        contained = _overlap(ir, ig, 10)  # ir top-10 within ig top-15
         assert contained >= 0.98, contained
 
     def test_pq_strip_recall_on_chip(self, data):
@@ -68,13 +65,17 @@ class TestCompiledStrip:
         _, gt = brute_force.search(brute_force.build(ds), qs, 10)
         idx = ivf_pq.build(ds, ivf_pq.IvfPqParams(
             n_lists=64, pq_dim=32, group_size=512))
-        _, cand = ivf_pq.search(idx, qs, 40, n_probes=16, backend="ragged")
+        # 32-wide fetch engages the tournament top-k path on chip (its
+        # engagement window is 16 <= kf <= bs*_KEEP)
+        _, cand = ivf_pq.search(idx, qs, 32, n_probes=16, backend="ragged")
         _, ids = refine.refine(ds, qs, cand, 10)
         assert float(stats.neighborhood_recall(ids, gt)) >= 0.9
 
     def test_big_k_boundary(self, data):
         """k near the strip cap (512) exercises the widest kernel outputs
-        and the kf>=16 tournament path on chip."""
+        on the exact direct-extraction path (k=256 is above the tournament
+        cap by design — exact searches must never take the lossy route);
+        the tournament regime itself is covered by the PQ test's kf=32."""
         from raft_tpu.neighbors import ivf_flat
 
         ds, qs = data
