@@ -107,3 +107,49 @@ def refine(
     per_query = max(1, candidates.shape[1] * (dataset.shape[1] + 4) * 4)
     q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
     return _refine_impl(queries, dataset, candidates, int(k), metric, q_tile)
+
+
+def refine_host(dataset, queries, candidates, k: int,
+                metric: str = "sqeuclidean") -> Tuple:
+    """Pure-numpy exact re-rank for CPU serving pipelines (the reference's
+    refine_host, detail/refine_host-inl.hpp): same contract as
+    :func:`refine` but never touches an accelerator — the companion of the
+    HNSW export story (build on TPU, re-rank candidates wherever the
+    serving CPU lives).
+    """
+    import numpy as np
+
+    metric = dist_mod.canonical_metric(metric)
+    if metric not in SUPPORTED_METRICS:
+        raise ValueError(f"refine_host supports {SUPPORTED_METRICS}, got {metric!r}")
+    dataset = np.asarray(dataset, np.float32)
+    queries = np.asarray(queries, np.float32)
+    cand = np.asarray(candidates, np.int64)
+    if not 0 < k <= cand.shape[1]:
+        raise ValueError(f"k={k} out of range for n_candidates={cand.shape[1]}")
+    if metric == "cosine":
+        queries = queries / np.maximum(
+            np.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+        dataset = dataset / np.maximum(
+            np.linalg.norm(dataset, axis=1, keepdims=True), 1e-30)
+    rows = dataset[np.clip(cand, 0, dataset.shape[0] - 1)]  # (q, c, d)
+    ip = np.einsum("qd,qcd->qc", queries, rows)
+    if metric in ("sqeuclidean", "euclidean"):
+        d = (np.sum(queries**2, 1)[:, None] + np.sum(rows**2, 2) - 2.0 * ip)
+        d = np.maximum(d, 0.0)
+        if metric == "euclidean":
+            d = np.sqrt(d)
+    elif metric == "cosine":
+        d = 1.0 - ip
+    else:  # inner_product: rank by max → negate for the shared min-select
+        d = -ip
+    d = np.where(cand >= 0, d, np.inf)
+    sel = np.argsort(d, axis=1, kind="stable")[:, :k]
+    vals = np.take_along_axis(d, sel, axis=1)
+    ids = np.take_along_axis(cand, sel, axis=1).astype(np.int32)
+    ids = np.where(np.isfinite(vals), ids, -1)
+    if metric == "inner_product":
+        vals = np.where(ids >= 0, -vals, -np.inf)
+    else:
+        vals = np.where(ids >= 0, vals, np.inf)
+    return vals.astype(np.float32), ids

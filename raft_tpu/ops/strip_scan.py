@@ -235,12 +235,14 @@ def _topk_block(s, kf: int, w: int):
     bs = w // _NB
     # engage when the tournament's total work (build + pool extraction)
     # beats direct extraction (kf·w > _KEEP·w + kf·_KEEP·_NB) AND the
-    # collision loss stays a tail event: kf ≤ bs·_KEEP keeps the expected
-    # per-bin top-kf mass ≤ _KEEP/bs ≤ half the survivors (P(loss) ≤ ~1e-4
-    # per strip row at kf=32, w=1024); anything denser — including every
-    # exact large-k IVF-Flat search — takes the exact direct path
+    # collision loss stays a tail event. The loss is governed by the
+    # expected per-bin top-kf mass kf/_NB (width-independent!), so cap at
+    # kf ≤ _NB/4 = 32 (mass ≤ 0.25 of the _KEEP survivors, P(loss) ~1e-4
+    # per strip row); kf ≤ bs·_KEEP additionally guarantees the pool can
+    # hold kf at small widths. Anything denser — including every exact
+    # large-k IVF-Flat search — takes the exact direct path.
     wins = kf * w > _KEEP * w + kf * _KEEP * _NB
-    if kf < 16 or kf > bs * _KEEP or bs < 2 or not wins:
+    if kf < 16 or kf > min(bs * _KEEP, _NB // 4) or bs < 2 or not wins:
         cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
         return _extract_topk(s, cols, kf)
     sv = s.reshape(c, bs, _NB)

@@ -225,3 +225,19 @@ class TestClusterCodebooks:
     def test_bad_kind_rejected(self):
         with pytest.raises(ValueError, match="codebook_kind"):
             ivf_pq.IvfPqParams(codebook_kind="nope")
+
+
+class TestRefineHost:
+    """refine_host (detail/refine_host-inl.hpp analog): numpy-only re-rank
+    matching the device refine — the CPU-serving half of the export story."""
+
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product", "cosine"])
+    def test_matches_device_refine(self, data, metric):
+        ds, qs = data
+        rng = np.random.default_rng(9)
+        cand = rng.integers(0, ds.shape[0], (qs.shape[0], 40)).astype(np.int32)
+        cand[:, 5] = -1  # padding entries must be skipped
+        dv, di = refine.refine(ds, qs, cand, 10, metric=metric)
+        hv, hi = refine.refine_host(ds, qs, cand, 10, metric=metric)
+        np.testing.assert_array_equal(np.asarray(di), hi)
+        np.testing.assert_allclose(np.asarray(dv), hv, rtol=1e-4, atol=1e-4)
