@@ -147,13 +147,18 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha):
     return jax.jit(fn)
 
 
-def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
+def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                  alpha, dense, interpret, data, ids_arr, bias,
                  pair_const=None):
     """Query-tiled SPMD search loop shared by the distributed IVF indexes.
-    One host sync happened already (probes_np); every tile is one async
-    shard_map dispatch."""
-    from raft_tpu.ops.strip_scan import plan_strips
+
+    Plans are built ON DEVICE (ops/strip_scan._plan_device, replicated —
+    every shard runs the identical grid from the per-list MAX fill) and the
+    host fetches only the per-class strip counts; round-3: host-built plan
+    tables cost several MB of ~25 MB/s uploads per tile on the tunneled
+    runtime. ``probes`` is a device array — no host copy of it exists."""
+    from raft_tpu.core.resources import current_resources
+    from raft_tpu.ops.strip_scan import class_info, fit_q_tile, plan_tile
 
     if not dense and k > 512:
         raise ValueError(
@@ -161,21 +166,26 @@ def tiled_search(queries_mat, probes_np, lens_max, n_lists, k, comms,
         )
     kf = min(int(k), 512)
     q = queries_mat.shape[0]
+    probes = jnp.asarray(probes)
+    p = probes.shape[1]
     if pair_const is None:
-        pair_const = jnp.zeros(probes_np.shape, jnp.float32)
-    q_tile = min(q, 4096)
+        pair_const = jnp.zeros((q, p), jnp.float32)
+    classes, cls_ord_np = class_info(np.asarray(lens_max))
+    cls_ord = jnp.asarray(cls_ord_np)
+    q_tile = fit_q_tile(q, p, n_lists, len(classes), kf,
+                        current_resources().workspace_bytes)
     out_v, out_i = [], []
     start = 0
     while start < q:
         qt = min(q_tile, q - start)
-        plan = plan_strips(probes_np[start:start + qt], lens_max, n_lists)
-        fn = make_tile_fn(comms.mesh, comms.axis, plan.class_layout, int(k),
+        qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
+            probes, start, qt, cls_ord, classes, n_lists)
+        fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
                           kf, dense, interpret, alpha)
         v, i = fn(queries_mat[start:start + qt],
-                  jnp.asarray(probes_np[start:start + qt]),
+                  jax.lax.slice_in_dim(probes, start, start + qt, axis=0),
                   pair_const[start:start + qt],
-                  jnp.asarray(plan.qids), jnp.asarray(plan.strip_list),
-                  jnp.asarray(plan.pair_strip), jnp.asarray(plan.pair_slot),
+                  qids, strip_list, pair_strip, pair_slot,
                   data, ids_arr, bias)
         out_v.append(v)
         out_i.append(i)

@@ -176,9 +176,8 @@ def search(
 
     probes = _coarse_probes(queries, index.centers, n_probes, index.metric,
                             "exact", res.compute_dtype)
-    probes_np = np.asarray(probes)                     # the one host sync
     vals, ids = tiled_search(
-        queries, probes_np, index.lens_max, index.n_lists, int(k),
+        queries, probes, index.lens_max, index.n_lists, int(k),
         index.comms, -2.0 if l2 else -1.0,
         dense=not strip_eligible(index.max_list_size),
         interpret=jax.default_backend() != "tpu",

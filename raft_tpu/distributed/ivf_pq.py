@@ -249,9 +249,8 @@ def search(
         index.decoded_scale, None, n_probes, index.metric, "exact",
         res.compute_dtype, l2,
     )
-    probes_np = np.asarray(probes)                     # the one host sync
     vals, ids = tiled_search(
-        qr_scaled, probes_np, index.lens_max, index.n_lists,
+        qr_scaled, probes, index.lens_max, index.n_lists,
         int(k), index.comms, alpha,
         dense=not strip_eligible(index.max_list_size),
         interpret=jax.default_backend() != "tpu",

@@ -492,6 +492,41 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
             pair_slot.reshape(q, p), counts)
 
 
+def fit_q_tile(q: int, p: int, n_lists: int, n_classes: int, kf: int,
+               workspace_bytes: int) -> int:
+    """Largest query tile whose per-class region tables + kernel outputs
+    stay inside the workspace budget."""
+    q_tile = min(q, 16384)
+
+    def s_region_for(qt):
+        return _bucket(_ceil_div(qt * p, C) + n_lists)
+
+    while (s_region_for(q_tile) * n_classes * C * (kf * 8 + 4)
+           > workspace_bytes and q_tile > 512):
+        q_tile //= 2
+    return q_tile
+
+
+def plan_tile(probes_dev, start: int, qt: int, cls_ord, classes, n_lists: int):
+    """Device-plan one query tile and fix its static class layout (the ONE
+    host fetch is the per-class strip counts). Shared by strip_search and
+    the distributed tiled_search so the planning protocol cannot drift."""
+    p = probes_dev.shape[1]
+    n_classes = len(classes)
+    s_region = _bucket(_ceil_div(qt * p, C) + n_lists)
+    qids, strip_list, pair_strip, pair_slot, counts = _plan_device(
+        lax.slice_in_dim(probes_dev, start, start + qt, axis=0),
+        cls_ord, n_lists, n_classes, s_region,
+    )
+    counts_np = np.asarray(counts)  # ~n_classes ints — the only fetch
+    layout = tuple(
+        (classes[c][0], classes[c][1], c * s_region,
+         min(_bucket(int(counts_np[c])), s_region))
+        for c in range(n_classes) if counts_np[c] > 0
+    ) or ((1, 1, 0, 1),)
+    return qids, strip_list, pair_strip, pair_slot, layout
+
+
 def strip_search(
     queries_mat,
     probes,
@@ -539,36 +574,17 @@ def strip_search(
 
     classes, cls_ord_np = class_info(lens_np)
     cls_ord = jnp.asarray(cls_ord_np)  # 4 KB — the only per-search upload
-    n_classes = len(classes)
     probes_dev = jnp.asarray(probes)
-    p = probes_dev.shape[1]
-
-    # tile sizing: per-tile device tables + kernel outputs within workspace
-    q_tile = min(q, 16384)
-
-    def s_region_for(qt):
-        return _bucket(_ceil_div(qt * p, C) + n_lists)
-
-    while (s_region_for(q_tile) * n_classes * C * (kf * 8 + 4)
-           > workspace_bytes and q_tile > 512):
-        q_tile //= 2
+    q_tile = fit_q_tile(q, probes_dev.shape[1], n_lists, len(classes), kf,
+                        workspace_bytes)
 
     out_v, out_i = [], []
     start = 0
     while start < q:
         check_interrupt()
         qt = min(q_tile, q - start)
-        s_region = s_region_for(qt)
-        qids, strip_list, pair_strip, pair_slot, counts = _plan_device(
-            lax.slice_in_dim(probes_dev, start, start + qt, axis=0),
-            cls_ord, n_lists, n_classes, s_region,
-        )
-        counts_np = np.asarray(counts)  # ~n_classes ints — the only fetch
-        layout = tuple(
-            (classes[c][0], classes[c][1], c * s_region,
-             min(_bucket(int(counts_np[c])), s_region))
-            for c in range(n_classes) if counts_np[c] > 0
-        ) or ((1, 1, 0, 1),)
+        qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
+            probes_dev, start, qt, cls_ord, classes, n_lists)
         v, i = _strip_tile(
             queries_mat[start:start + qt], qids, strip_list, pair_strip,
             pair_slot, list_data, list_bias, list_ids,
