@@ -176,10 +176,18 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                         current_resources().workspace_bytes)
     out_v, out_i = [], []
     start = 0
+    zero = jnp.zeros((1,), jnp.int32)
+    zero2 = jnp.zeros((1, 1), jnp.int32)
     while start < q:
         qt = min(q_tile, q - start)
-        qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
-            probes, start, qt, cls_ord, classes, n_lists)
+        if dense:
+            # dense_local_scan never reads the strip tables: skip the
+            # planning dispatch + its counts round-trip entirely
+            qids, strip_list, pair_strip, pair_slot = zero2, zero, zero2, zero2
+            layout = ((1, 1, 0, 1),)
+        else:
+            qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
+                probes, start, qt, cls_ord, classes, n_lists)
         fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
                           kf, dense, interpret, alpha)
         v, i = fn(queries_mat[start:start + qt],

@@ -749,16 +749,12 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
         interpret=jax.default_backend() != "tpu",
         pair_const=pair_const,
     )
-    if l2:
-        # ‖Rq‖² == ‖q‖² (orthogonal rotation; zero-padding adds nothing)
-        vals = jnp.maximum(vals + dist_mod.sqnorm(queries)[:, None], 0.0)
-        if index.metric == "euclidean":
-            vals = jnp.sqrt(vals)
-        vals = jnp.where(ids >= 0, vals, jnp.inf)
-    else:
-        # match the gather backend: raw inner product, bigger = closer
-        vals = jnp.where(ids >= 0, -vals, -jnp.inf)
-    return vals, ids
+    # shared fused finalizer (ivf_flat._finalize_ragged): same score
+    # algebra — ‖Rq‖² == ‖q‖² (orthogonal rotation; padding adds nothing),
+    # and cosine/ip scan values use the same alpha=-1 convention
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    return _finalize_ragged(vals, ids, queries, index.metric)
 
 
 @functools.partial(
@@ -1047,12 +1043,10 @@ def search(
                 f"multiple of 512, got {index.max_list_size}; rebuild with "
                 "group_size=512 (or use backend='pallas'/'gather')"
             )
-        vals, ids = _search_ragged_pq(
+        # cosine included in _finalize_pq's fused dispatch
+        return _search_ragged_pq(
             index, queries, int(k), n_probes, filter, select_algo, res
         )
-        if index.metric == "cosine":
-            vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
-        return vals, ids
     if backend == "pallas":
         if not pallas_ok:
             raise ValueError(

@@ -64,12 +64,16 @@ def pairwise_distance(
     # in workspace-bounded tiles (round-2 review: y was densified whole,
     # which is quadratic-memory wrong for the wide matrices the reference's
     # hash-strategy SpMV serves, coo_spmv_strategies/hash_strategy.cuh)
+    if nx == 0 or ny == 0:
+        return jnp.zeros((nx, ny), jnp.float32)
     y_bytes = ny * m * 4
     if y_bytes <= res.workspace_bytes // 2:
         y_tile = ny
     else:
         y_tile = int(max(1, (res.workspace_bytes // 2) // max(m * 4, 1)))
-    bytes_per_row = max(1, (m + min(ny, y_tile)) * 4 * 2)
+    # the x tile holds full ny-wide output rows until the axis-1 concat, so
+    # size it against ny (not y_tile)
+    bytes_per_row = max(1, (m + ny) * 4 * 2)
     tile = int(max(1, min(nx, (res.workspace_bytes // 2) // bytes_per_row)))
 
     # hoist the densification when y fits whole (the common case) so the
