@@ -173,7 +173,8 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
     classes, cls_ord_np = class_info(np.asarray(lens_max))
     cls_ord = jnp.asarray(cls_ord_np)
     q_tile = fit_q_tile(q, p, n_lists, len(classes), kf,
-                        current_resources().workspace_bytes)
+                        current_resources().workspace_bytes,
+                        dim=queries_mat.shape[1])
     out_v, out_i = [], []
     start = 0
     zero = jnp.zeros((1,), jnp.int32)

@@ -339,15 +339,67 @@ def _finalize_ragged(vals, ids, queries, metric):
     return jnp.where(ids >= 0, -vals, -jnp.inf), ids
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "classes", "class_counts", "q_tile",
+                     "interpret"),
+)
+def _ragged_fused(queries, centers, list_data, bias, list_ids, cls_ord,
+                  k, n_probes, metric, select_algo, compute_dtype,
+                  classes, class_counts, q_tile, interpret):
+    """The ENTIRE ragged search — coarse gemm, device strip planning, strip
+    kernel, merge, finalize — as one jit: one runtime dispatch, zero host
+    syncs (round-4; the per-tile grid-count fetch used to serialize every
+    call at ~15-20 ms dispatch + RTT on the tunneled runtime, which is why
+    an index probing 3% of the data lost to brute force at 1M rows)."""
+    from raft_tpu.ops.strip_scan import strip_search_traced
+
+    # "exact" probe selection rides the packed iter (half the VPU passes;
+    # ≤1e-4 relative coarse-distance perturbation only reorders lists whose
+    # boundary contribution is itself a tie — recall-neutral, measured)
+    sa = "packed" if select_algo == "exact" and not interpret else select_algo
+    probes = _coarse_probes(queries, centers, n_probes, metric, sa,
+                            compute_dtype)
+    l2 = metric in ("sqeuclidean", "euclidean")
+    vals, ids = strip_search_traced(
+        queries, probes, list_data, bias, list_ids, cls_ord,
+        classes, class_counts, int(k), int(k), -2.0 if l2 else -1.0,
+        q_tile, interpret,
+    )
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
+def _ragged_plan_static(index, n_probes, k, res, dim):
+    """Host-cached static planning facts for the fused path: length classes,
+    per-class list counts, the device class-ordinal array, and the query
+    tile size. All derive from build-time state (list lengths), so they are
+    cached on the index instance."""
+    import numpy as np
+
+    from raft_tpu.ops import strip_scan as ss
+
+    cached = getattr(index, "_ragged_static_cache", None)
+    if cached is None:
+        lens_np = _lens_np(index)
+        classes, cls_ord_np = ss.class_info(lens_np)
+        classes = tuple(classes)  # hashable: jit static arg
+        cached = (classes, ss.class_counts_of(cls_ord_np, len(classes)),
+                  jnp.asarray(cls_ord_np))
+        try:
+            index._ragged_static_cache = cached
+        except AttributeError:
+            pass
+    classes, class_counts, cls_ord = cached
+    q_tile = ss.fit_q_tile(1 << 30, n_probes, index.n_lists, len(classes),
+                           int(k), res.workspace_bytes, dim=dim)
+    return classes, class_counts, cls_ord, q_tile
+
+
 def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
     """Strip-scan path (ops/strip_scan.py): work ∝ actual probed entries —
-    no per-list cap, no padded-length scan, per-pair top-k fused in-kernel."""
-    from raft_tpu.ops.strip_scan import strip_search
-
-    probes = _coarse_probes(
-        queries, index.centers, n_probes, index.metric, select_algo,
-        res.compute_dtype,
-    )
+    no per-list cap, no padded-length scan, per-pair top-k fused in-kernel,
+    the whole search one fused dispatch."""
     l2 = index.metric in ("sqeuclidean", "euclidean")
     # the unfiltered bias depends only on build-time state: cache it on the
     # index (one dispatch per search otherwise)
@@ -360,13 +412,15 @@ def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
     else:
         bias = _ragged_bias(index.list_ids, index.list_norms, filter,
                             "l2" if l2 else "ip")
-    vals, ids = strip_search(
-        queries, probes, index.list_data, bias, index.list_ids,
-        _lens_np(index), int(k), alpha=-2.0 if l2 else -1.0,
-        workspace_bytes=res.workspace_bytes,
-        interpret=jax.default_backend() != "tpu",
+    classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
+        index, n_probes, k, res, index.dim)
+    return _ragged_fused(
+        queries, index.centers, index.list_data, bias, index.list_ids,
+        cls_ord, int(k), n_probes, index.metric, select_algo,
+        res.compute_dtype, classes, class_counts,
+        min(q_tile, queries.shape[0]),
+        jax.default_backend() != "tpu",
     )
-    return _finalize_ragged(vals, ids, queries, index.metric)
 
 
 @functools.partial(

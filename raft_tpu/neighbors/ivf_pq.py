@@ -715,14 +715,48 @@ def _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2: bool):
     return bias
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "l2", "classes", "class_counts",
+                     "q_tile", "interpret"),
+)
+def _ragged_fused_pq(queries, centers, rotation, b_sum, list_ids, decoded,
+                     decoded_scale, filter, cls_ord,
+                     k, n_probes, metric, select_algo, compute_dtype, l2,
+                     classes, class_counts, q_tile, interpret):
+    """The whole PQ strip search as ONE dispatch (round-4; see ivf_flat.
+    _ragged_fused): prep + device planning + int8 strip kernel + finalize,
+    zero host syncs. The in-kernel tournament top-k is allowed
+    (approx_ok=True): this path over-fetches and exact-re-ranks via
+    neighbors/refine, which absorbs its ~1e-4/row bin-collision loss."""
+    from raft_tpu.ops.strip_scan import strip_search_traced
+
+    sa = "packed" if select_algo == "exact" and not interpret else select_algo
+    probes, qr_scaled, bias, pair_const = _pq_search_prep(
+        queries, centers, rotation, b_sum, list_ids, decoded_scale,
+        filter, n_probes, metric, sa, compute_dtype, l2,
+    )
+    vals, ids = strip_search_traced(
+        qr_scaled, probes, decoded, bias, list_ids, cls_ord,
+        classes, class_counts, int(k), int(k), -2.0 if l2 else -1.0,
+        q_tile, interpret, pair_const=pair_const, approx_ok=True,
+    )
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    # shared fused finalizer: same score algebra — ‖Rq‖² == ‖q‖²
+    # (orthogonal rotation; padding adds nothing), and cosine/ip scan
+    # values use the same alpha=-1 convention
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
 def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
     """int8 residual-cache strip scan (ops/strip_scan.py): same ranking as
     the LUT formulation, at 2·rot_dim MXU FLOPs and rot_dim HBM bytes per
     probed entry instead of 2·pq_dim·n_codes FLOPs. The dequant scale folds
     into the query operand; the exact −2⟨q, R·c_l⟩ pair term rides the
     merge's pair_const (see _decode_lists)."""
-    from raft_tpu.neighbors.ivf_flat import _coarse_probes, _lens_np
-    from raft_tpu.ops.strip_scan import strip_search
+    from raft_tpu.neighbors.ivf_flat import _ragged_plan_static
 
     if index.decoded is None:
         # lazy decode-cache fill, kept on the index instance
@@ -731,30 +765,15 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
             pq_bits=index.pq_bits, cluster=index.codebook_kind == "cluster",
         )
     l2 = index.metric in ("sqeuclidean", "euclidean")
-    alpha = -2.0 if l2 else -1.0
-    # one dispatch for the whole search-side prep: probes + rotated/scaled
-    # queries + bias + the exact per-pair center term (rotation is
-    # orthogonal, so ⟨q, c_l⟩ works in the unrotated space). Eager prep was
-    # ~6 separate dispatches at ~15-20 ms runtime overhead each (round 3).
-    probes, qr_scaled, bias, pair_const = _pq_search_prep(
+    classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
+        index, n_probes, k, res, index.rotation.shape[0])
+    return _ragged_fused_pq(
         queries, index.centers, index.rotation, index.b_sum, index.list_ids,
-        index.decoded_scale, filter, n_probes, index.metric, select_algo,
-        res.compute_dtype, l2,
+        index.decoded, index.decoded_scale, filter, cls_ord,
+        int(k), n_probes, index.metric, select_algo, res.compute_dtype, l2,
+        classes, class_counts, min(q_tile, queries.shape[0]),
+        jax.default_backend() != "tpu",
     )
-    vals, ids = strip_search(
-        qr_scaled, probes, index.decoded, bias,
-        index.list_ids, _lens_np(index),
-        int(k), alpha=alpha,
-        workspace_bytes=res.workspace_bytes,
-        interpret=jax.default_backend() != "tpu",
-        pair_const=pair_const,
-    )
-    # shared fused finalizer (ivf_flat._finalize_ragged): same score
-    # algebra — ‖Rq‖² == ‖q‖² (orthogonal rotation; padding adds nothing),
-    # and cosine/ip scan values use the same alpha=-1 convention
-    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
-
-    return _finalize_ragged(vals, ids, queries, index.metric)
 
 
 @functools.partial(

@@ -35,8 +35,14 @@ def iter_topk_min(values, k: int):
     ``lax.top_k(-values, k)`` semantics (ascending values, lowest index on
     ties, distinct indices even on +inf tails) without the sort. The
     per-pass work is ~4 elementwise VPU ops over the full block — for
-    k ≤ ~64 this beats TPU top_k's O(n log n) sort by a wide margin."""
+    k ≤ ~64 this beats TPU top_k's O(n log n) sort by a wide margin.
+
+    NaN inputs are sanitized to +inf at entry (ADVICE r3: an all-NaN row
+    used to emit out-of-range indices; lax.top_k's NaN order is
+    implementation-defined anyway, so +inf-tail semantics is the sane
+    contract)."""
     v = values
+    v = jnp.where(jnp.isnan(v), jnp.inf, v)
     n = v.shape[-1]
     cols = lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
     # explicit taken-mask (not just an inf overwrite): +inf input values are
@@ -54,6 +60,71 @@ def iter_topk_min(values, k: int):
     return jnp.stack(vs, -1), jnp.stack(idxs, -1).astype(jnp.int32)
 
 
+def _pack_bits_for(n: int) -> int:
+    b = 1
+    while (1 << b) < n:
+        b += 1
+    return b
+
+
+def pack_clamp_for(bits: int) -> float:
+    """Largest finite fp32 whose truncated mantissa survives OR-ing any
+    ``bits``-wide index without overflowing into the exponent."""
+    import numpy as _np
+
+    return float(_np.array((0x7F7FFFFF >> bits) << bits, _np.uint32)
+                 .view(_np.float32))
+
+
+def pack_values(v, bits: int):
+    """Pack per-position column ids into the low ``bits`` mantissa bits of
+    fp32 ``v`` (last axis). Shared by iter_topk_min_packed and the strip
+    kernel's in-kernel extraction (ops/strip_scan._pack_scores) so the
+    clamp/NaN/±inf invariants live in one place:
+
+    * NaN → +inf → clamped (a NaN would poison every min pass);
+    * ±inf → ±max-finite-packable (OR-ing bits into an inf mantissa mints
+      NaN — the code-review r4 -inf finding);
+    * packed values within a row are unique (distinct column bits), so a
+      min + equality-mask pass extracts exactly one element.
+    Perturbation ≤ 2^-(23-bits) relative, for negatives too (mantissa grows
+    → more negative, same bound)."""
+    clamp = pack_clamp_for(bits)
+    mask = (1 << bits) - 1
+    cols = lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
+    v = jnp.where(jnp.isnan(v), jnp.inf, v)
+    v = jnp.clip(v, -clamp, clamp)
+    return lax.bitcast_convert_type(
+        (lax.bitcast_convert_type(v, jnp.int32) & jnp.int32(~mask)) | cols,
+        jnp.float32)
+
+
+def iter_topk_min_packed(values, k: int):
+    """Approximate iter_topk_min at HALF the per-pass cost: the column index
+    rides the low mantissa bits of the fp32 value, so each pass is one min
+    reduction + one equality mask — no argmin reconstruction.
+
+    Values are perturbed by ≤ 2^-(23-b) relative (b = ceil(log2 n) index
+    bits; 10 bits → 1.2e-4) — noise on the order of this repo's bf16 scan
+    contract, NOT an exact select. Packed values within a row are unique,
+    so ties and +inf tails still yield distinct in-range indices. NaN → +inf.
+    """
+    v = values.astype(jnp.float32)
+    n = v.shape[-1]
+    b = _pack_bits_for(n)
+    mask = (1 << b) - 1
+    pv = pack_values(v, b)
+    vs, idxs = [], []
+    for _ in range(k):
+        mn = jnp.min(pv, axis=-1)
+        mb = lax.bitcast_convert_type(mn, jnp.int32)
+        idxs.append(mb & jnp.int32(mask))
+        vs.append(lax.bitcast_convert_type(mb & jnp.int32(~mask),
+                                           jnp.float32))
+        pv = jnp.where(pv == mn[..., None], jnp.inf, pv)
+    return jnp.stack(vs, -1), jnp.stack(idxs, -1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "algo", "recall_target"))
 def _select_k_impl(values, k, select_min, algo, recall_target):
     if algo == "approx":
@@ -61,6 +132,10 @@ def _select_k_impl(values, k, select_min, algo, recall_target):
             vals, idx = lax.approx_min_k(values, k, recall_target=recall_target)
         else:
             vals, idx = lax.approx_max_k(values, k, recall_target=recall_target)
+    elif algo == "packed":
+        vals, idx = iter_topk_min_packed(values if select_min else -values, k)
+        if not select_min:
+            vals = -vals
     elif algo == "iter":
         vals, idx = iter_topk_min(values if select_min else -values, k)
         if not select_min:
@@ -90,9 +165,11 @@ def select_k(
     detail/ivf_flat_search-inl.cuh:130,194).
 
     ``algo``: "exact" (lax.top_k) | "iter" (k masked-min passes; exact,
-    the fast TPU route for small k) | "approx" (TPU partial-reduce;
-    ``recall_target`` trades recall for speed). "exact" auto-routes to
-    "iter" for k <= 64 on TPU — same results, ~10x faster.
+    the fast TPU route for small k) | "packed" (mantissa-packed iter —
+    half the passes' cost, values perturbed ≤ ~1e-4 relative) | "approx"
+    (TPU partial-reduce; ``recall_target`` trades recall for speed).
+    "exact" auto-routes to "iter" for k <= 64 on TPU — same results,
+    ~10x faster.
     """
     values = jnp.asarray(values)
     squeeze = values.ndim == 1
@@ -100,7 +177,7 @@ def select_k(
         values = values[None, :]
     if not 0 < k <= values.shape[-1]:
         raise ValueError(f"k={k} out of range for n={values.shape[-1]}")
-    if algo not in ("exact", "iter", "approx"):
+    if algo not in ("exact", "iter", "approx", "packed"):
         raise ValueError(f"unknown select_k algo {algo!r}")
     # iter does k full passes over the row — a win over top_k's sort only
     # while the row is narrow (k·n stays small); wide rows (brute-force over
@@ -109,8 +186,13 @@ def select_k(
             and jax.default_backend() == "tpu"
             and jnp.issubdtype(values.dtype, jnp.floating)):
         algo = "iter"
-    if algo == "iter" and not jnp.issubdtype(values.dtype, jnp.floating):
+    if (algo in ("iter", "packed")
+            and not jnp.issubdtype(values.dtype, jnp.floating)):
         algo = "exact"  # the inf mask needs a floating dtype
+    if algo == "packed" and values.shape[-1] > (1 << 16):
+        # packing always happens in fp32 regardless of input dtype: past
+        # 16 index bits too few mantissa bits remain for the values
+        algo = "iter"
     vals, idx = _select_k_impl(values, int(k), bool(select_min), algo, float(recall_target))
     if indices is not None:
         indices = jnp.asarray(indices)

@@ -144,7 +144,7 @@ def plan_strips(probes: np.ndarray, lens: np.ndarray, n_lists: int) -> StripPlan
         lists_g = probed[keys == key]
         count = int(n_qc[lists_g].sum())
         pad = _bucket(count)
-        sl = np.zeros(pad, np.int32)
+        sl = np.full(pad, -1, np.int32)  # padding strips: kernel-skipped
         sl[:count] = np.repeat(lists_g.astype(np.int32), n_qc[lists_g])
         base = start + np.concatenate([[0], np.cumsum(n_qc[lists_g])[:-1]])
         strip_base[lists_g] = base
@@ -184,6 +184,54 @@ def plan_strips(probes: np.ndarray, lens: np.ndarray, n_lists: int) -> StripPlan
     )
 
 
+_PACK_BITS = 10          # low-mantissa bits carrying the column index
+_PACK_MASK = (1 << _PACK_BITS) - 1
+
+
+def _pack_scores(s, w: int):
+    """Pack column ids into the low mantissa bits of fp32 scores
+    (ops/select_k.pack_values — shared so the clamp/NaN/±inf invariants
+    live in one place).
+
+    A min pass over the packed values yields the winning VALUE and its
+    COLUMN in one reduction — the per-pass argmin reconstruction
+    (compare-to-min + one-hot sum) that dominated the round-3 kernel cost
+    drops out entirely. The ≤ 2⁻¹³ relative perturbation sits inside this
+    path's documented bf16 (~3 significant digits) ranking contract.
+    """
+    assert w <= (1 << _PACK_BITS), w
+    from raft_tpu.ops.select_k import pack_values
+
+    return pack_values(s, _PACK_BITS)
+
+
+def _extract_topk_packed(pv, kf: int):
+    """kf min passes over packed scores (C, n) → ((C, kf) values, (C, kf)
+    columns). Two full-width VPU ops per pass (min + mask) vs the generic
+    _extract_topk's five — the packed trick halves-to-thirds the kernel's
+    dominant cost."""
+    c, n = pv.shape
+    kcols = lax.broadcasted_iota(jnp.int32, (c, kf), 1)
+
+    def body(i, carry):
+        pv, vals, es = carry
+        mn = jnp.min(pv, axis=1)                      # packed winner
+        mb = lax.bitcast_convert_type(mn, jnp.int32)
+        e = mb & jnp.int32(_PACK_MASK)
+        v = lax.bitcast_convert_type(mb & jnp.int32(~_PACK_MASK), jnp.float32)
+        sel = kcols == i
+        vals = jnp.where(sel, v[:, None], vals)
+        es = jnp.where(sel, e[:, None], es)
+        return jnp.where(pv == mn[:, None], jnp.inf, pv), vals, es
+
+    _, vals, es = lax.fori_loop(
+        0, kf, body,
+        (pv, jnp.full((c, kf), jnp.inf, jnp.float32),
+         jnp.zeros((c, kf), jnp.int32)),
+    )
+    return vals, es
+
+
 def _extract_topk(v, offs, kf: int):
     """kf masked-min passes over (C, n): (vals (C, kf), offsets (C, kf)).
     Offset picks use a one-hot sum — no gathers in-kernel. A fori_loop (not
@@ -218,7 +266,7 @@ _NB = 128   # tournament bin count (strided: bin j = cols ≡ j mod _NB —
 _KEEP = 4   # per-bin survivors in the tournament pool
 
 
-def _topk_block(s, kf: int, w: int):
+def _topk_block(s, kf: int, w: int, approx_ok: bool):
     """Top-kf of a (C, w) score block.
 
     Direct kf masked-min passes cost kf·C·w VPU work — the kernel's
@@ -229,7 +277,10 @@ def _topk_block(s, kf: int, w: int):
     _KEEP·_NB pool: (_KEEP·w + kf·_KEEP·_NB) vs kf·w work, ~1.7× at kf=40,
     w=1024. Exact unless > _KEEP of a row's true top-kf collide in one bin
     (entries land in bins by storage position, arbitrary w.r.t. distance —
-    a small tail event, and the kf ≥ 16 callers over-fetch + re-rank).
+    a small tail event). The tournament only engages when the caller
+    declares the loss acceptable via ``approx_ok`` (ADVICE r3: IVF-PQ
+    over-fetches + exact-re-ranks, so it opts in; IVF-Flat's contract is
+    exact-within-probes, so it never takes the lossy route at any k).
     """
     c = s.shape[0]
     bs = w // _NB
@@ -239,88 +290,105 @@ def _topk_block(s, kf: int, w: int):
     # expected per-bin top-kf mass kf/_NB (width-independent!), so cap at
     # kf ≤ _NB/4 = 32 (mass ≤ 0.25 of the _KEEP survivors, P(loss) ~1e-4
     # per strip row); kf ≤ bs·_KEEP additionally guarantees the pool can
-    # hold kf at small widths. Anything denser — including every exact
-    # large-k IVF-Flat search — takes the exact direct path.
+    # hold kf at small widths.
     wins = kf * w > _KEEP * w + kf * _KEEP * _NB
-    if kf < 16 or kf > min(bs * _KEEP, _NB // 4) or bs < 2 or not wins:
-        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        return _extract_topk(s, cols, kf)
-    sv = s.reshape(c, bs, _NB)
-    rows3 = lax.broadcasted_iota(jnp.int32, sv.shape, 1)
-    binc = lax.broadcasted_iota(jnp.int32, (c, _NB), 1)
-    pool_v, pool_o = [], []
+    pv = _pack_scores(s, w)
+    if (not approx_ok or kf < 16 or kf > min(bs * _KEEP, _NB // 4)
+            or bs < 2 or not wins):
+        return _extract_topk_packed(pv, kf)
+    # tournament on packed values: the bin survivors carry their own column
+    # ids in the mantissa, so the pool extraction needs no offset tables
+    sv = pv.reshape(c, bs, _NB)
+    pool = []
     for _ in range(_KEEP):
-        mn = jnp.min(sv, axis=1)                       # (C, _NB)
-        am = jnp.min(jnp.where(sv <= mn[:, None, :], rows3, bs), axis=1)
-        pool_v.append(mn)
-        pool_o.append(am * _NB + binc)                 # strided col index
-        sv = jnp.where(rows3 == am[:, None, :], jnp.inf, sv)
-    pv = jnp.concatenate(pool_v, axis=1)               # (C, _KEEP·_NB)
-    po = jnp.concatenate(pool_o, axis=1)
-    return _extract_topk(pv, po, kf)
+        mn = jnp.min(sv, axis=1)                       # (C, _NB) packed
+        pool.append(mn)
+        sv = jnp.where(sv == mn[:, None, :], jnp.inf, sv)
+    return _extract_topk_packed(jnp.concatenate(pool, axis=1), kf)
 
 
-def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref, oute_ref, *,
-                  alpha, kf, w, n_sub):
+def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref,
+                  oute_ref, *, alpha, kf, w, n_sub, approx_ok):
     """One strip (× one sub-block when n_sub > 1): matmul + fused top-kf.
 
-    Scores = alpha·(A @ Bᵀ) + bias, smaller is better; the tournament
-    top-k (_topk_block) extracts per-row top-kf values and within-list
-    entry offsets. Sub-block revisits merge the running top-kf via a
-    concat + kf passes over the 2·kf-wide block."""
-    a = a_ref[0]                                   # (C, dim) bf16
-    b = b_ref[0].astype(jnp.bfloat16)              # (w, dim)
-    s = lax.dot_general(a, b, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-    s = alpha * s + bias_ref[0]                    # (C, w)
-    nv, ne = _topk_block(s, kf, w)                 # (C, kf) each
-    if n_sub > 1:
-        ne = ne + pl.program_id(1) * w
+    Scores = alpha·(A @ Bᵀ) + bias, smaller is better; the (packed)
+    tournament top-k (_topk_block) extracts per-row top-kf values and
+    within-list entry offsets. Sub-block revisits merge the running top-kf
+    via a concat + kf passes over the 2·kf-wide block.
 
-    if n_sub == 1:
-        outv_ref[0] = nv
-        oute_ref[0] = ne
-        return
+    Strips with ``strip_list == -1`` are static-layout padding (round-4
+    sync-free planning, static_layout): the whole body is skipped via
+    ``pl.when``, so worst-case grid padding costs only the block DMA —
+    their outputs stay unwritten garbage and the merge never reads them.
+    (program_id/sl_ref reads happen at kernel top level — the CPU interpret
+    path rejects primitive calls inside a ``pl.when`` region.)"""
+    slv = sl_ref[pl.program_id(0)]
+    j = pl.program_id(1) if n_sub > 1 else 0
 
-    j = pl.program_id(1)
+    @pl.when(slv >= 0)
+    def _compute():
+        a = a_ref[0]                                   # (C, dim) bf16
+        b = b_ref[0].astype(jnp.bfloat16)              # (w, dim)
+        s = lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        s = alpha * s + bias_ref[0]                    # (C, w)
+        nv, ne = _topk_block(s, kf, w, approx_ok)      # (C, kf) each
 
-    @pl.when(j == 0)
-    def _():
-        outv_ref[0] = nv
-        oute_ref[0] = ne
+        if n_sub == 1:
+            outv_ref[0] = nv
+            oute_ref[0] = ne
+            return
 
-    @pl.when(j > 0)
-    def _():
-        cv = jnp.concatenate([outv_ref[0], nv], axis=1)    # (C, 2kf)
-        ce = jnp.concatenate([oute_ref[0], ne], axis=1)
-        mv, me = _extract_topk(cv, ce, kf)
-        outv_ref[0] = mv
-        oute_ref[0] = me
+        ne = ne + j * w
+
+        @pl.when(j == 0)
+        def _():
+            outv_ref[0] = nv
+            oute_ref[0] = ne
+
+        @pl.when(j > 0)
+        def _():
+            cv = jnp.concatenate([outv_ref[0], nv], axis=1)    # (C, 2kf)
+            ce = jnp.concatenate([oute_ref[0], ne], axis=1)
+            mv, me = _extract_topk(cv, ce, kf)
+            outv_ref[0] = mv
+            oute_ref[0] = me
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("w_blocks", "n_sub", "alpha", "kf", "interpret"),
+    static_argnames=("w_blocks", "n_sub", "alpha", "kf", "interpret",
+                     "approx_ok"),
 )
 def _strip_class_call(strip_list, a_grouped, list_data, bias3,
                       w_blocks: int, n_sub: int, alpha: float, kf: int,
-                      interpret: bool):
+                      interpret: bool, approx_ok: bool = False):
     """Run one length-class: grid (S,) or (S, n_sub) over (C, W) strips."""
     s_pad, c, dim = a_grouped.shape
     w = w_blocks * MC
 
+    # Padding strips (sl = -1, kernel-skipped) get ALL their block maps
+    # collapsed to constants — consecutive identical block indices make
+    # Pallas skip the refetch, so a padding step costs only grid
+    # bookkeeping (~1-2 µs), not the 512 KB list DMA + output writeback.
+    # Outputs for padding route to a dedicated trash row (s_pad) so real
+    # rows are never clobbered by stale-buffer writebacks.
     if n_sub > 1:
         grid = (s_pad, n_sub)
-        a_map = lambda i, j, sl: (i, 0, 0)
-        b_map = lambda i, j, sl: (sl[i], j, 0)
-        bias_map = lambda i, j, sl: (sl[i], 0, j)
-        o_map = lambda i, j, sl: (i, 0, 0)
+        pad_ = lambda i, sl: sl[i] < 0
+        a_map = lambda i, j, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, j, sl: (jnp.maximum(sl[i], 0),
+                                  jnp.where(pad_(i, sl), 0, j), 0)
+        bias_map = lambda i, j, sl: (jnp.maximum(sl[i], 0), 0,
+                                     jnp.where(pad_(i, sl), 0, j))
+        o_map = lambda i, j, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
     else:
         grid = (s_pad,)
-        a_map = lambda i, sl: (i, 0, 0)
-        b_map = lambda i, sl: (sl[i], 0, 0)
-        bias_map = lambda i, sl: (sl[i], 0, 0)
-        o_map = lambda i, sl: (i, 0, 0)
+        pad_ = lambda i, sl: sl[i] < 0
+        a_map = lambda i, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
+        bias_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
+        o_map = lambda i, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -332,21 +400,25 @@ def _strip_class_call(strip_list, a_grouped, list_data, bias3,
         ],
         out_specs=[pl.BlockSpec((1, c, kf), o_map)] * 2,
     )
-    return pl.pallas_call(
-        functools.partial(_strip_kernel, alpha=alpha, kf=kf, w=w, n_sub=n_sub),
+    ov, oe = pl.pallas_call(
+        functools.partial(_strip_kernel, alpha=alpha, kf=kf, w=w, n_sub=n_sub,
+                          approx_ok=approx_ok),
         grid_spec=grid_spec,
         out_shape=(
-            jax.ShapeDtypeStruct((s_pad, c, kf), jnp.float32),
-            jax.ShapeDtypeStruct((s_pad, c, kf), jnp.int32),
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.float32),
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
         ),
         interpret=interpret,
     )(strip_list, a_grouped, list_data, bias3)
+    return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
+            lax.slice_in_dim(oe, 0, s_pad, axis=0))
 
 
 def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
                      list_data, bias, list_ids,
                      class_layout, k: int, kf: int, alpha: float,
-                     interpret: bool, pair_const=None):
+                     interpret: bool, pair_const=None,
+                     approx_ok: bool = False):
     """One query tile: group the query side per strip, run every length
     class, then the two-gather merge. Plain traceable function so SPMD
     callers can run it inside shard_map (distributed/ivf_*).
@@ -369,6 +441,7 @@ def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
             lax.slice_in_dim(strip_list, start, start + count, axis=0),
             lax.slice_in_dim(a_grouped, start, start + count, axis=0),
             list_data, bias3, w_blocks, n_sub, alpha, kf, interpret,
+            approx_ok,
         )
         outs_v.append(ov)
         outs_e.append(oe)
@@ -399,11 +472,13 @@ def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
         cand_v = cand_v + pair_const[:, :, None]
     cand_v = cand_v.reshape(q, p * kf)
     cand_e = out_e[pair_strip_c, pair_slot].reshape(q, p * kf)
-    from raft_tpu.ops.select_k import iter_topk_min
+    from raft_tpu.ops.select_k import iter_topk_min_packed
 
     kk = min(k, p * kf)
     if kk <= 64 and not interpret:
-        vals, sel = iter_topk_min(cand_v, kk)
+        # packed passes: half the VPU cost of iter_topk_min; the ≤1e-4
+        # relative perturbation sits inside this path's bf16 score contract
+        vals, sel = iter_topk_min_packed(cand_v, kk)
     else:
         nv, sel = lax.top_k(-cand_v, kk)
         vals = -nv
@@ -419,7 +494,8 @@ def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
 
 _strip_tile = jax.jit(
     _strip_tile_body,
-    static_argnames=("class_layout", "k", "kf", "alpha", "interpret"),
+    static_argnames=("class_layout", "k", "kf", "alpha", "interpret",
+                     "approx_ok"),
 )
 
 
@@ -458,7 +534,11 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
     flat = probes.reshape(-1)
     order = jnp.argsort(flat, stable=True)
     sorted_lists = flat[order]
-    r = jnp.bincount(flat, length=n_lists)
+    # per-list pair counts from the sorted array (binary search): bincount's
+    # scatter-add measured 8 ms at 320K pairs on TPU, searchsorted ~none
+    bounds = jnp.searchsorted(sorted_lists,
+                              jnp.arange(n_lists + 1, dtype=jnp.int32))
+    r = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
     n_qc = -(-r // C)                                  # strips per list
 
     # class-major list layout: lists sorted by (class, id); each list's
@@ -484,7 +564,9 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
     pair_slot = jnp.zeros(qp, jnp.int32).at[order].set(slot_sorted)
 
     s_tot = n_classes * s_region
-    strip_list = jnp.zeros(s_tot, jnp.int32).at[ps_sorted].set(
+    # padding slots = -1: the kernel skips them entirely (round-4; with the
+    # static worst-case layout the padded grid would otherwise do real work)
+    strip_list = jnp.full(s_tot, -1, jnp.int32).at[ps_sorted].set(
         sorted_lists.astype(jnp.int32))
     qids = jnp.full((s_tot, C), -1, jnp.int32).at[ps_sorted, slot_sorted].set(
         (order // p).astype(jnp.int32))
@@ -493,15 +575,19 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
 
 
 def fit_q_tile(q: int, p: int, n_lists: int, n_classes: int, kf: int,
-               workspace_bytes: int) -> int:
+               workspace_bytes: int, dim: int = 0) -> int:
     """Largest query tile whose per-class region tables + kernel outputs
-    stay inside the workspace budget."""
+    stay inside the workspace budget. Per strip slot: kf fp32+int32 output
+    pairs (kf·8), the qids int32 entry (4), and — the round-3 undercount
+    (ADVICE) — the (S_pad, C, dim) bf16 ``a_grouped`` query-side buffer
+    (2·dim bytes) built in _strip_tile_body."""
     q_tile = min(q, 16384)
+    per_slot = kf * 8 + 4 + 2 * dim
 
     def s_region_for(qt):
         return _bucket(_ceil_div(qt * p, C) + n_lists)
 
-    while (s_region_for(q_tile) * n_classes * C * (kf * 8 + 4)
+    while (s_region_for(q_tile) * n_classes * C * per_slot
            > workspace_bytes and q_tile > 512):
         q_tile //= 2
     return q_tile
@@ -527,6 +613,71 @@ def plan_tile(probes_dev, start: int, qt: int, cls_ord, classes, n_lists: int):
     return qids, strip_list, pair_strip, pair_slot, layout
 
 
+def class_counts_of(cls_ord_np: np.ndarray, n_classes: int) -> Tuple[int, ...]:
+    """Static per-class list counts (hashable, for jit static args)."""
+    return tuple(int(x) for x in np.bincount(cls_ord_np, minlength=n_classes))
+
+
+def static_layout(classes, class_counts: Tuple[int, ...], qt: int, p: int,
+                  n_lists: int):
+    """Host-static worst-case layout for a qt-query tile — no device fetch.
+
+    Region stride ``s_region`` bounds any class's strip count: a tile has at
+    most ceil(qt·p/C) full strips plus one partial strip per probed list.
+    Per class the bound tightens to ceil(qt·p/C) + (lists in that class).
+    With one length class (the common large-index case) this equals the
+    bucketed dynamic plan's size, so the static grid costs nothing extra.
+    """
+    n_classes = len(classes)
+    s_region = _bucket(_ceil_div(qt * p, C) + n_lists)
+    return s_region, tuple(
+        (classes[c][0], classes[c][1], c * s_region,
+         min(s_region, _bucket(_ceil_div(qt * p, C) + class_counts[c])))
+        for c in range(n_classes)
+    )
+
+
+def strip_search_traced(queries_mat, probes, list_data, bias, list_ids,
+                        cls_ord, classes, class_counts, k: int, kf: int,
+                        alpha: float, q_tile: int, interpret: bool,
+                        pair_const=None, approx_ok: bool = False):
+    """Sync-free strip search: fully traceable, so callers can fuse coarse
+    quantizer + device planning + strip kernel + finalization into ONE
+    dispatch with zero host round-trips.
+
+    Round-4 rationale: the dynamic plan (plan_tile) fetches per-class strip
+    counts to size the kernel grid — a blocking device→host sync in the
+    middle of every search that (a) costs an RTT on the tunneled runtime and
+    (b) prevents back-to-back searches from pipelining. Here the grid is
+    fixed at the static worst case (static_layout); padding strips scan
+    list 0 with qids=-1 and are never read by the merge.
+    """
+    q, p = probes.shape
+    n_lists = list_data.shape[0]
+    out_v, out_i = [], []
+    for start in range(0, q, q_tile):
+        qt = min(q_tile, q - start)
+        s_region, layout = static_layout(classes, class_counts, qt, p,
+                                         n_lists)
+        qids, strip_list, pair_strip, pair_slot, _ = _plan_device(
+            lax.slice_in_dim(probes, start, start + qt, axis=0),
+            cls_ord, n_lists, len(classes), s_region,
+        )
+        v, i = _strip_tile_body(
+            lax.slice_in_dim(queries_mat, start, start + qt, axis=0),
+            qids, strip_list, pair_strip, pair_slot, list_data, bias,
+            list_ids, layout, int(k), kf, float(alpha), bool(interpret),
+            None if pair_const is None
+            else lax.slice_in_dim(pair_const, start, start + qt, axis=0),
+            approx_ok,
+        )
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
+
+
 def strip_search(
     queries_mat,
     probes,
@@ -539,6 +690,7 @@ def strip_search(
     workspace_bytes: int = 1 << 30,
     interpret: bool = False,
     pair_const=None,
+    approx_ok: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Full strip scan: probes (q, p) int32 → per-query top-k over the
     probed lists' entries. Drop-in contract of round 2's ragged_search:
@@ -576,7 +728,7 @@ def strip_search(
     cls_ord = jnp.asarray(cls_ord_np)  # 4 KB — the only per-search upload
     probes_dev = jnp.asarray(probes)
     q_tile = fit_q_tile(q, probes_dev.shape[1], n_lists, len(classes), kf,
-                        workspace_bytes)
+                        workspace_bytes, dim=queries_mat.shape[1])
 
     out_v, out_i = [], []
     start = 0
@@ -590,6 +742,7 @@ def strip_search(
             pair_slot, list_data, list_bias, list_ids,
             layout, int(k), kf, float(alpha), bool(interpret),
             None if pair_const is None else pair_const[start:start + qt],
+            approx_ok,
         )
         out_v.append(v)
         out_i.append(i)

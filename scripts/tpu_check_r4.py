@@ -1,0 +1,100 @@
+"""Round-4 TPU validation: fused IVF dispatch + rebuilt CAGRA loop.
+
+Measures amortized QPS the bench way (R back-to-back calls, one scalar
+fetch) and recall vs exact ground truth at the 1M bench shape.
+"""
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from raft_tpu import stats
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
+
+
+def force(x):
+    return float(jnp.sum(x))
+
+
+def time_qps(run, queries, reps=5):
+    v, _ = run(queries)
+    force(v)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        v, _ = run(queries)
+    force(v)
+    return queries.shape[0] / ((time.perf_counter() - t0) / reps)
+
+
+def main():
+    which = set(sys.argv[1:]) or {"ivf", "cagra"}
+    N, DIM, Q, K = 1_000_000, 128, 10_000, 10
+    data_u8, queries_u8 = sift_like(N, DIM, Q)
+    dataset = jnp.asarray(data_u8, jnp.float32)
+    queries = jnp.asarray(queries_u8, jnp.float32)
+
+    bf_index = brute_force.build(dataset, metric="sqeuclidean")
+    gt_vals, gt_ids = brute_force.search(bf_index, queries, K,
+                                         select_algo="exact")
+    force(gt_vals)
+    print("gt done", flush=True)
+
+    if "ivf" in which:
+        t0 = time.perf_counter()
+        flat_index = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+            n_lists=1024, kmeans_trainset_fraction=0.2))
+        force(flat_index.list_norms)
+        print(f"flat build {time.perf_counter()-t0:.1f}s", flush=True)
+        vals, ids = ivf_flat.search(flat_index, queries, K, n_probes=32)
+        rec = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+        qps = time_qps(lambda qs: ivf_flat.search(flat_index, qs, K,
+                                                  n_probes=32), queries)
+        print(f"IVF-Flat np=32: recall {rec:.4f} QPS {qps:,.0f}", flush=True)
+        del flat_index
+
+        t0 = time.perf_counter()
+        pq_index = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
+            n_lists=1024, pq_dim=DIM // 2, pq_bits=8,
+            kmeans_trainset_fraction=0.2))
+        force(pq_index.b_sum)
+        print(f"pq build {time.perf_counter()-t0:.1f}s", flush=True)
+
+        def pq_run(qs):
+            _, cand = ivf_pq.search(pq_index, qs, 2 * K, n_probes=32)
+            return refine.refine(dataset, qs, cand, K)
+
+        vals, ids = pq_run(queries)
+        rec = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+        qps = time_qps(pq_run, queries)
+        print(f"IVF-PQ np=32 kf=20: recall {rec:.4f} QPS {qps:,.0f}",
+              flush=True)
+        del pq_index
+
+    if "cagra" in which:
+        cq = queries[:2000]
+        t0 = time.perf_counter()
+        cidx = cagra.build(dataset, cagra.CagraParams(
+            intermediate_graph_degree=64, graph_degree=32,
+            build_algo="ivf_pq"))
+        force(cidx.graph)
+        print(f"cagra ivf_pq build 1M {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        for itopk, w in ((64, 1), (64, 4), (96, 4), (128, 8)):
+            p = cagra.CagraSearchParams(itopk_size=itopk, search_width=w)
+            cv, ci = cagra.search(cidx, cq, K, p)
+            rec = float(stats.neighborhood_recall(ci, gt_ids[:2000], cv,
+                                                  gt_vals[:2000]))
+            qps = time_qps(
+                lambda qs, p=p: cagra.search(cidx, qs, K, p), cq, reps=3)
+            print(f"CAGRA 1M itopk={itopk} w={w}: recall {rec:.4f} "
+                  f"QPS {qps:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
