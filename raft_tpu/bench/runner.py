@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from typing import Dict, List
 
@@ -51,9 +52,28 @@ def _force(x):
 def _load_dataset(spec: Dict):
     kind = spec.get("kind", "blobs")
     if kind == "files":
-        base = np.load(spec["base"], mmap_mode="r")
-        queries = np.load(spec["queries"])
-        return jnp.asarray(np.asarray(base, np.float32)), jnp.asarray(queries, jnp.float32)
+        # any supported on-disk format: .npy, TEXMEX .fvecs/.bvecs,
+        # big-ann .fbin/.u8bin/... (bench/io.py readers)
+        from raft_tpu.bench.io import read_any
+
+        base = read_any(spec["base"], spec.get("max_rows"))
+        queries = read_any(spec["queries"])
+        return (jnp.asarray(np.asarray(base, np.float32)),
+                jnp.asarray(queries, jnp.float32))
+    if kind == "real":
+        # resolve a standard dataset directory (TEXMEX / big-ann / hdf5);
+        # errors out rather than silently benching synthetic data
+        from raft_tpu.bench.io import load_real_dataset
+
+        found = load_real_dataset(
+            spec.get("root", os.environ.get("RAFT_TPU_DATA_DIR", "")),
+            spec.get("name", "sift"), spec.get("max_rows"))
+        if found is None:
+            raise FileNotFoundError(
+                f"real dataset {spec.get('name', 'sift')!r} not found")
+        base, queries, _ = found
+        return (jnp.asarray(np.asarray(base, np.float32)),
+                jnp.asarray(np.asarray(queries, np.float32)))
     if kind == "blobs":
         n, dim = int(spec["n"]), int(spec["dim"])
         q = int(spec.get("n_queries", 1000))

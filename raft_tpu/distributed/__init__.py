@@ -10,6 +10,6 @@ data-sharded k-means, and multi-device IVF-Flat (global quantizer + local
 per-device indexes, the raft-dask one-model-per-worker architecture).
 """
 
-from raft_tpu.distributed import brute_force, ivf_flat, ivf_pq, kmeans
+from raft_tpu.distributed import brute_force, cagra, ivf_flat, ivf_pq, kmeans
 
-__all__ = ["brute_force", "ivf_flat", "ivf_pq", "kmeans"]
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "kmeans"]

@@ -1,0 +1,160 @@
+"""Sharded CAGRA: per-shard local graphs, replicated queries, one
+``shard_map`` search with an all-gather candidate merge.
+
+Reference pattern: the raft-dask MNMG ANN layout
+(python/raft-dask/raft_dask/common/comms.py:40 — every worker owns an
+independent index over its data partition, queries broadcast, results
+merged with knn_merge_parts, neighbors/detail/knn_merge_parts.cuh:140).
+CAGRA has no intra-index distribution in the reference either: the graph's
+irregular traversal makes cross-worker hops latency-bound, so the MNMG
+recipe is shard-local graphs + a k-way merge, which scales the DATA (each
+chip holds n/world rows and walks a graph that fits its HBM) while the
+merge rides one ICI all-gather of (world·k) candidates per query.
+
+Build here loops shards on the host (this process owns the whole virtual
+mesh); on a real multi-host pod each process builds only its local shard —
+the per-shard builds are embarrassingly parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.neighbors import cagra as sl
+from raft_tpu.ops.select_k import select_k
+
+# padded shard rows get this coordinate value: any query's distance to the
+# sentinel row is ~1e36, so it can never enter a top-k
+_PAD_SENTINEL = 1e18
+
+
+@dataclass
+class ShardedCagraIndex:
+    """Row-sharded CAGRA: one local graph per shard, stacked on a leading
+    (world,) mesh dimension. Graph ids are shard-LOCAL; the search maps
+    them to global ids (rank · rows_per + local)."""
+
+    dataset: jax.Array   # (world, rows_per, dim) fp32, P(axis)
+    graph: jax.Array     # (world, rows_per, graph_degree) int32, P(axis)
+    n_total: int
+    comms: Comms
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[2]
+
+    @property
+    def size(self) -> int:
+        return self.n_total
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[2]
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.dataset.shape[1]
+
+
+def build(
+    dataset,
+    params: sl.CagraParams = sl.CagraParams(),
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+) -> ShardedCagraIndex:
+    """Per-shard CAGRA builds over a row partition (host loop; parallel
+    across processes on a real pod)."""
+    res = res or current_resources()
+    comms = comms or make_comms(res)
+    world = comms.size
+    X = jnp.asarray(dataset, jnp.float32)
+    n, dim = X.shape
+    rows_per = -(-n // world)
+    if rows_per <= params.graph_degree:
+        raise ValueError(
+            f"shard rows {rows_per} must exceed graph_degree "
+            f"{params.graph_degree}")
+    ds_parts, g_parts = [], []
+    for r in range(world):
+        Xr = X[r * rows_per: min((r + 1) * rows_per, n)]
+        li = sl.build(Xr, params, res=res)
+        pad = rows_per - Xr.shape[0]
+        d = li.dataset.astype(jnp.float32)
+        g = li.graph
+        if pad:
+            d = jnp.pad(d, ((0, pad), (0, 0)),
+                        constant_values=_PAD_SENTINEL)
+            g = jnp.pad(g, ((0, pad), (0, 0)), constant_values=-1)
+        ds_parts.append(d)
+        g_parts.append(g)
+    dataset_sh = jax.device_put(jnp.stack(ds_parts),
+                                comms.sharding(comms.axis, None, None))
+    graph_sh = jax.device_put(jnp.stack(g_parts),
+                              comms.sharding(comms.axis, None, None))
+    return ShardedCagraIndex(dataset_sh, graph_sh, n, comms)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
+                    n_total, seed):
+    def body(shard, graph, queries):
+        rows = shard.shape[1]
+        rank = jax.lax.axis_index(axis)
+        key = jax.random.key(seed)
+        vals, local_ids = sl._search_impl(
+            shard[0], graph[0], queries, key, None, rows,
+            k, itopk, width, max_iter, min_iter, n_rand)
+        gids = jnp.where(local_ids >= 0,
+                         rank * rows + local_ids, -1).astype(jnp.int32)
+        # padded sentinel rows carry ~1e36 distances already; also mask any
+        # global id beyond the true row count
+        bad = (gids < 0) | (gids >= n_total)
+        vals = jnp.where(bad, jnp.inf, vals)
+        gids = jnp.where(bad, -1, gids)
+        all_vals = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
+        all_ids = jax.lax.all_gather(gids, axis, axis=1, tiled=True)
+        out_v, out_i = select_k(all_vals, k, select_min=True,
+                                indices=all_ids)
+        return out_v, jnp.where(jnp.isinf(out_v), -1, out_i)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def search(
+    index: ShardedCagraIndex,
+    queries,
+    k: int,
+    params: sl.CagraSearchParams = sl.CagraSearchParams(),
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD CAGRA search: every shard walks its local graph, one all-gather
+    merges the (world·k) candidates exactly. Returns (distances (q, k),
+    GLOBAL row ids (q, k)), replicated."""
+    queries = jnp.asarray(queries, jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim})")
+    itopk = int(min(params.itopk_size, index.rows_per_shard))
+    if not 0 < k <= itopk:
+        raise ValueError(f"k={k} must be in (0, itopk_size={itopk}]")
+    width = int(params.search_width)
+    max_iter = int(params.max_iterations) or max(16, itopk // width)
+    min_iter = int(min(params.min_iterations, max_iter))
+    fn = _make_search_fn(
+        index.comms.mesh, index.comms.axis, int(k), itopk, width, max_iter,
+        min_iter, int(max(1, params.num_random_samplings)), index.n_total,
+        int(params.seed))
+    return fn(index.dataset, index.graph, queries)

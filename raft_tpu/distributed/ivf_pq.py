@@ -97,9 +97,7 @@ def build(
     n, dim = dataset.shape
     if params.n_lists * world > n:
         raise ValueError(f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
-    if params.codebook_kind != "subspace":
-        raise NotImplementedError(
-            "distributed ivf_pq supports codebook_kind='subspace' only")
+    cluster = params.codebook_kind == "cluster"
     pq_dim = params.pq_dim or sl._auto_pq_dim(dim)
     dsub = -(-dim // pq_dim)
     rot_dim = pq_dim * dsub
@@ -136,9 +134,17 @@ def build(
         sub, centers, kmeans_balanced.KMeansBalancedParams(metric=km_metric),
         res=res)
     resid = sl._pad_rot(sub - centers[sub_labels], rot_dim) @ rotation.T
-    resid_cb = resid.reshape(cb_rows, pq_dim, dsub).transpose(1, 0, 2)
-    codebooks = sl._train_codebooks(resid_cb, k_cb, n_codes,
-                                    params.codebook_n_iters)
+    if cluster:
+        # PER_CLUSTER (ivf_pq_types.hpp:36): one codebook per IVF list,
+        # trained on the replicated subsample — every shard computes the
+        # identical (n_lists, n_codes, dsub) tensor with no collective
+        codebooks = sl._train_codebooks_cluster(
+            resid.reshape(cb_rows, pq_dim, dsub), sub_labels, k_cb,
+            n_codes, params.codebook_n_iters, params.n_lists)
+    else:
+        resid_cb = resid.reshape(cb_rows, pq_dim, dsub).transpose(1, 0, 2)
+        codebooks = sl._train_codebooks(resid_cb, k_cb, n_codes,
+                                        params.codebook_n_iters)
 
     # --- shard rows + SPMD assign/spill phase (shared helpers) -------------
     from raft_tpu.distributed._sharding import (assign_phase, round_mls,
@@ -170,16 +176,18 @@ def build(
         rp = rows.shape[0]
         safe_labels = jnp.minimum(labels, n_lists - 1)
         residual = sl._pad_rot(rows - centers[safe_labels], rot_dim) @ rotation.T
-        codes = sl.pack_codes(
-            sl._encode(residual.reshape(rp, pq_dim, dsub), codebooks),
-            params.pq_bits)
+        resid3 = residual.reshape(rp, pq_dim, dsub)
+        raw = (sl._encode_cluster(resid3, safe_labels, codebooks) if cluster
+               else sl._encode(resid3, codebooks))
+        codes = sl.pack_codes(raw, params.pq_bits)
         lc, li = scatter_pack(
             labels,
             [(jnp.zeros((n_lists, mls, code_w), jnp.uint8), codes),
              (jnp.full((n_lists, mls), -1, jnp.int32), ids)],
             n_lists, mls)
         b_sum = sl._compute_b_sum(centers, rotation, codebooks, lc, li,
-                                  params.metric, pq_dim, params.pq_bits)
+                                  params.metric, pq_dim, params.pq_bits,
+                                  cluster=cluster)
         if l2:  # fold the coarse-center norm in once (b_sum is +inf at pad)
             rc2 = dist_mod.sqnorm(sl._pad_rot(centers, rot_dim) @ rotation.T)
             bias = rc2[:, None] + b_sum
@@ -200,7 +208,7 @@ def build(
     # stays in one place)
     def decode_body(lc):
         return sl._decode_lists_scaled(codebooks, lc[0], scale, pq_dim,
-                                       params.pq_bits)[None]
+                                       params.pq_bits, cluster=cluster)[None]
 
     decode_fn = jax.jit(jax.shard_map(
         decode_body, mesh=comms.mesh,

@@ -27,6 +27,7 @@ from typing import Iterator, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.ops import distance as dist_mod
@@ -56,6 +57,73 @@ def _merge_running(best_v, best_i, vals, ids, k: int):
     alli = jnp.concatenate([best_i, ids], axis=1)
     v, sel = jax.lax.top_k(-allv, k)
     return -v, jnp.take_along_axis(alli, sel, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk_rows", "metric"))
+def search_device_chunked(dataset, queries, k: int,
+                          chunk_rows: int = 131072,
+                          metric: str = "sqeuclidean"):
+    """Exact kNN over a DEVICE-resident dataset too large for one (q, n)
+    score matrix (e.g. 10M rows: the full fp32 block would be tens of GB).
+
+    One dispatch: a ``fori_loop`` slides a (chunk_rows, dim) window over
+    the dataset, each step one MXU gemm + an exact iterative top-k merged
+    into the running (q, k) state. The complement of ``search_out_of_core``
+    (host-resident streaming) for datasets that fit HBM but whose score
+    matrix does not. Returns (distances (q, k), indices (q, k))."""
+    metric = dist_mod.canonical_metric(metric)
+    if metric not in SUPPORTED_METRICS:
+        raise ValueError(
+            f"supported metrics {SUPPORTED_METRICS}, got {metric!r}")
+    n, dim = dataset.shape
+    q = queries.shape[0]
+    chunk_rows = min(chunk_rows, n)
+    queries = queries.astype(jnp.float32)
+    if metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    qn = dist_mod.sqnorm(queries)
+    n_chunks = -(-n // chunk_rows)
+    inf = jnp.float32(jnp.inf)
+
+    def body(c, carry):
+        best_v, best_i = carry
+        # dynamic_slice clamps an out-of-range start: mirror the clamp so
+        # the tail chunk's ids match the rows actually sliced (the last
+        # chunk re-scans some rows — duplicates merge away exactly)
+        start = jnp.minimum(c * chunk_rows, max(n - chunk_rows, 0))
+        chunk = lax.dynamic_slice(
+            dataset, (start, 0), (chunk_rows, dim)).astype(jnp.float32)
+        rows = start + jnp.arange(chunk_rows, dtype=jnp.int32)
+        if metric == "cosine":
+            chunk = chunk / jnp.maximum(
+                jnp.linalg.norm(chunk, axis=1, keepdims=True), 1e-30)
+        ip = jnp.einsum("qd,cd->qc", queries, chunk,
+                        preferred_element_type=jnp.float32)
+        if metric == "inner_product":
+            d = -ip
+        elif metric == "cosine":
+            d = 1.0 - ip
+        else:
+            cn = jnp.sum(chunk * chunk, axis=1)
+            d = jnp.maximum(qn[:, None] + cn[None, :] - 2.0 * ip, 0.0)
+        # tail-chunk overlap rows were already scanned: mask them so no id
+        # can enter the running top-k twice
+        d = jnp.where((rows >= c * chunk_rows)[None, :], d, inf)
+        from raft_tpu.ops.select_k import iter_topk_min
+
+        vals, sel = iter_topk_min(d, k)
+        ids = jnp.where(jnp.isinf(vals), -1, rows[sel])
+        return _merge_running(best_v, best_i, vals, ids, k)
+
+    best_v = jnp.full((q, k), inf, jnp.float32)
+    best_i = jnp.full((q, k), -1, jnp.int32)
+    best_v, best_i = lax.fori_loop(0, n_chunks, body, (best_v, best_i))
+    if metric == "inner_product":
+        best_v = jnp.where(best_i >= 0, -best_v, -inf)
+    elif metric == "euclidean":
+        best_v = jnp.where(best_i >= 0, jnp.sqrt(best_v), inf)
+    return best_v, best_i
 
 
 def search_out_of_core(

@@ -259,10 +259,24 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Optional[Resourc
             and new_vectors.dtype != index.list_data.dtype):
         # keep the integer-storage invariant (4× HBM) instead of silently
         # promoting the whole index to fp32; integer datasets extend with
-        # integer rows, so the round/clip is exact in the expected case
+        # integer rows, so the round/clip is exact in the expected case —
+        # and warn when it is NOT (ADVICE r3: fractional / out-of-range
+        # vectors used to lose precision with no signal)
         info = jnp.iinfo(index.list_data.dtype)
         new_store = jnp.clip(jnp.round(new_vectors), info.min, info.max) \
             .astype(index.list_data.dtype)
+        # one scalar fetch — extend() is a whole-index repack with host
+        # syncs already, so the round-trip is noise here (review r4 noted)
+        err = float(jnp.max(jnp.abs(new_store.astype(jnp.float32)
+                                    - new_vectors)))
+        if err > 0.5:
+            from raft_tpu.core.logger import get_logger
+
+            get_logger().warning(
+                f"ivf_flat.extend: quantizing float vectors into "
+                f"{index.list_data.dtype} storage loses up to {err:.3g} "
+                "per component (out-of-range or fractional inputs); "
+                "rebuild with fp32 storage if that matters")
     else:
         new_store = new_vectors.astype(index.list_data.dtype) \
             if new_vectors.dtype != index.list_data.dtype else new_vectors
@@ -408,7 +422,15 @@ def _search_ragged(index, queries, k, n_probes, filter, select_algo, res):
         if bias is None:
             bias = _ragged_bias(index.list_ids, index.list_norms, None,
                                 "l2" if l2 else "ip")
-            index._bias_cache = bias
+            try:
+                # lazy caches are instance attrs OUTSIDE tree_flatten: they
+                # drop on any tree_map/jit round-trip (rebuilt) and assume
+                # the index is immutable-after-build — mutate list_data /
+                # list_ids only through extend(), which returns a NEW index
+                # (ADVICE r3)
+                index._bias_cache = bias
+            except AttributeError:
+                pass
     else:
         bias = _ragged_bias(index.list_ids, index.list_norms, filter,
                             "l2" if l2 else "ip")

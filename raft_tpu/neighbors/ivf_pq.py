@@ -510,13 +510,24 @@ def build(
         labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
 
     # --- encode + pack, pq_bits-tight (ivf_pq_build.cuh:1319) --------------
-    resid_all = _pad_rot(work - centers[labels], rot_dim) @ rotation.T
-    if params.codebook_kind == "cluster":
-        codes = _encode_cluster(resid_all.reshape(n, pq_dim, dsub), labels,
-                                codebooks)
-    else:
-        codes = _encode(resid_all.reshape(n, pq_dim, dsub), codebooks)
-    codes = pack_codes(codes, params.pq_bits)
+    # residuals + encode in row chunks: one (n, rot_dim) fp32 residual
+    # array is ~4 GB at 10M x 96 — materializing it whole next to `work`
+    # OOM'd the 10M bench (round-4); chunking bounds the transient to the
+    # workspace while `codes` (uint8) stays small
+    enc_chunk = int(max(65536, res.workspace_bytes // max(rot_dim * 16, 1)))
+    codes_parts = []
+    for s in range(0, n, enc_chunk):
+        e = min(s + enc_chunk, n)
+        wch = lax.slice_in_dim(work, s, e, axis=0)
+        lch = lax.slice_in_dim(labels, s, e, axis=0)
+        resid = _pad_rot(wch - centers[lch], rot_dim) @ rotation.T
+        resid = resid.reshape(e - s, pq_dim, dsub)
+        raw = (_encode_cluster(resid, lch, codebooks)
+               if params.codebook_kind == "cluster"
+               else _encode(resid, codebooks))
+        codes_parts.append(pack_codes(raw, params.pq_bits))
+    codes = (jnp.concatenate(codes_parts) if len(codes_parts) > 1
+             else codes_parts[0])
     row_ids = jnp.arange(n, dtype=jnp.int32)
     list_codes, list_ids = _pack_lists(codes, row_ids, labels, params.n_lists, group)
 

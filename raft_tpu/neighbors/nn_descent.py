@@ -27,6 +27,17 @@ gather / matmul:
 * The whole iteration is one jitted program; the host loop only reads the
   scalar update counter for the termination test (termination_threshold) and
   the interruptible cancellation point.
+
+**Status on the TPU runtime (round-4 decision, VERDICT r3 #8):** this
+host-driven loop is CPU-capable but NOT the production TPU graph builder —
+its per-iteration dispatch pattern measured impractical on the tunneled
+runtime and its large sort/gather working set can fault the TPU worker at
+bench scale (round 3). The production CAGRA builder on TPU is the IVF
+candidate search + device-resident neighbor-of-neighbor sweeps
+(cagra._build_knn_ivf_pq + cagra.refine_knn_graph — the latter IS the
+NN-descent local join recast as fixed-shape device blocks). This module
+remains for CPU builds and API parity with
+raft::neighbors::experimental::nn_descent.
 """
 
 from __future__ import annotations

@@ -113,6 +113,7 @@ def iter_topk_min_packed(values, k: int):
     n = v.shape[-1]
     b = _pack_bits_for(n)
     mask = (1 << b) - 1
+    clamp = pack_clamp_for(b)
     pv = pack_values(v, b)
     vs, idxs = [], []
     for _ in range(k):
@@ -122,7 +123,16 @@ def iter_topk_min_packed(values, k: int):
         vs.append(lax.bitcast_convert_type(mb & jnp.int32(~mask),
                                            jnp.float32))
         pv = jnp.where(pv == mn[..., None], jnp.inf, pv)
-    return jnp.stack(vs, -1), jnp.stack(idxs, -1).astype(jnp.int32)
+    out_v = jnp.stack(vs, -1)
+    # restore the ±inf the packing clamped away (code-review r4: a clamped
+    # +inf sentinel — filtered/padding entries — must NOT come back as a
+    # finite ~3.4e38 "hit"; downstream isfinite masks depend on it)
+    tclamp = lax.bitcast_convert_type(
+        lax.bitcast_convert_type(jnp.float32(clamp), jnp.int32)
+        & jnp.int32(~mask), jnp.float32)
+    out_v = jnp.where(out_v >= tclamp, jnp.inf, out_v)
+    out_v = jnp.where(out_v <= -tclamp, -jnp.inf, out_v)
+    return out_v, jnp.stack(idxs, -1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "algo", "recall_target"))

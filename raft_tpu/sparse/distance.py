@@ -40,25 +40,126 @@ def _densify_rows(csr: CSR, start, n_rows_tile: int) -> jax.Array:
     return jnp.zeros((n_rows_tile, m), csr.data.dtype).at[local, cid].add(v)
 
 
+def _to_ell(csr: CSR, width_round: int = 8):
+    """CSR → padded ELL: (cols (n, w), vals (n, w)) with w = max row nnz
+    rounded up. Static shapes (padding cols point at column 0 with value 0),
+    so every downstream op is a fixed-shape gather/reduce — the TPU
+    replacement for per-row variable-length iteration."""
+    n, m = csr.shape
+    rid = csr.row_ids()
+    counts = jnp.bincount(rid, length=n)
+    w = int(jnp.max(counts)) if csr.indices.shape[0] else 1
+    w = max(width_round, -(-w // width_round) * width_round)
+    offsets = csr.indptr[:-1]
+    pos = jnp.arange(csr.indices.shape[0], dtype=jnp.int32) - offsets[rid]
+    cols = jnp.zeros((n, w), jnp.int32).at[rid, pos].set(
+        jnp.clip(csr.indices, 0, m - 1))
+    vals = jnp.zeros((n, w), csr.data.dtype).at[rid, pos].set(csr.data)
+    return cols, vals, w
+
+
+def _expand_ip(x: CSR, y: CSR, res) -> jax.Array:
+    """Sparse×sparse inner products via nnz expansion — the COO-SpMV
+    analog (reference sparse/distance/detail/coo_spmv.cuh hash strategy),
+    recast for TPU: x rides a padded ELL layout, y a transposed dense
+    tile, and each x-row's ⟨x, y_j⟩ is one fixed-width gather + contraction
+
+        ip[i, :] = Σ_k vals[i, k] · Yᵀ[cols[i, k], :]
+
+    Work is nx·w·ny (w = max row nnz) instead of the dense path's
+    nx·m·ny — at ≥95% sparsity the ~20× FLOP reduction beats the MXU's
+    unit-cost advantage on wide feature spaces. Static shapes throughout:
+    no scatter, no segment ops (padding contributes exact zeros)."""
+    nx, m = x.shape
+    ny = y.shape[0]
+    cols, vals, w = _to_ell(x)
+    # y transposed dense tile: (m, ny_tile); the gather below reads rows
+    y_bytes = m * ny * 4
+    ny_tile = (ny if y_bytes <= res.workspace_bytes // 4
+               else max(1, (res.workspace_bytes // 4) // max(m * 4, 1)))
+    # x tile bounds the (tile, w, ny_tile) gathered block
+    per_row = max(1, w * ny_tile * 4 * 2)
+    x_tile = int(max(1, min(nx, (res.workspace_bytes // 2) // per_row)))
+
+    out_rows = []
+    for sx in range(0, nx, x_tile):
+        tx = min(x_tile, nx - sx)
+        c_t = jax.lax.slice_in_dim(cols, sx, sx + tx, axis=0)
+        v_t = jax.lax.slice_in_dim(vals, sx, sx + tx, axis=0)
+        cols_out = []
+        for sy in range(0, ny, ny_tile):
+            ty = min(ny_tile, ny - sy)
+            yT = _densify_rows(y, sy, ty).T              # (m, ty)
+            g = yT[c_t.reshape(-1)].reshape(tx, w, ty)   # (tx, w, ty)
+            cols_out.append(jnp.einsum(
+                "rk,rkn->rn", v_t, g, preferred_element_type=jnp.float32))
+        out_rows.append(jnp.concatenate(cols_out, axis=1)
+                        if len(cols_out) > 1 else cols_out[0])
+    return jnp.concatenate(out_rows, axis=0) if len(out_rows) > 1 else out_rows[0]
+
+
+def _row_sqnorms(csr: CSR) -> jax.Array:
+    n = csr.shape[0]
+    return jax.ops.segment_sum(csr.data * csr.data, csr.row_ids(),
+                               num_segments=n)
+
+
+_EXPAND_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
+
+
 def pairwise_distance(
     x: CSR,
     y: Optional[CSR] = None,
     metric: str = "sqeuclidean",
     p: float = 2.0,
     res: Optional[Resources] = None,
+    backend: str = "auto",
 ) -> jax.Array:
     """All-pairs (x_rows, y_rows) distance matrix between CSR operands.
 
     Any metric of :func:`raft_tpu.ops.distance.pairwise_distance` is valid
     (superset of the reference's sparse metric list,
     sparse/distance/distance.cuh).
+
+    ``backend``: "dense" (densify-by-tiles + MXU — every metric),
+    "expand" (nnz-expansion over a padded ELL layout — the coo_spmv
+    analog; l2/ip/cosine only, wins on very sparse wide data), or "auto"
+    (expand when the FLOP model favors it: mean-row-nnz ≤ dim/48 from the
+    static stored-capacity bound — ≳98% effective sparsity, accounting the
+    VPU/MXU unit-cost gap and ELL max-row padding).
     """
     res = res or current_resources()
     y = x if y is None else y
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"dim mismatch: {x.shape} vs {y.shape}")
+    if backend not in ("auto", "dense", "expand"):
+        raise ValueError(f"unknown sparse distance backend {backend!r}")
     nx, m = x.shape
     ny = y.shape[0]
+
+    canon = dense_distance.canonical_metric(metric)
+    if backend == "expand" and canon not in _EXPAND_METRICS:
+        raise ValueError(
+            f"backend='expand' supports {_EXPAND_METRICS}, got {metric!r} "
+            "(use backend='dense')")
+    if backend != "dense" and canon in _EXPAND_METRICS and nx and ny:
+        # auto-routing from STATIC facts only (capacity = stored nnz bound):
+        # the mean row width proxies max row width without the device sync
+        # a bincount-max would cost on every call (code-review r4); _to_ell
+        # computes the exact max only once the expand path is taken
+        mean_w = max(1, x.indices.shape[0] // max(nx, 1))
+        if backend == "expand" or mean_w * 48 <= m:
+            ip = _expand_ip(x, y, res)
+            if canon == "inner_product":
+                return ip
+            xs = _row_sqnorms(x)
+            ys = _row_sqnorms(y)
+            if canon == "cosine":
+                denom = jnp.sqrt(jnp.maximum(
+                    xs[:, None] * ys[None, :], 1e-30))
+                return 1.0 - ip / denom
+            d = jnp.maximum(xs[:, None] + ys[None, :] - 2.0 * ip, 0.0)
+            return jnp.sqrt(d) if canon == "euclidean" else d
 
     # densify-by-tiles strategy: BOTH operands are materialized densely only
     # in workspace-bounded tiles (round-2 review: y was densified whole,

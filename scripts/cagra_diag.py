@@ -1,0 +1,68 @@
+"""Diagnose CAGRA@1M recall: graph quality vs search budget."""
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from raft_tpu import stats
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.neighbors import brute_force, cagra
+
+
+def force(x):
+    return float(jnp.sum(x))
+
+
+def main():
+    N, DIM, Q, K = 1_000_000, 128, 2000, 10
+    data_u8, queries_u8 = sift_like(N, DIM, 10_000)
+    dataset = jnp.asarray(data_u8, jnp.float32)
+    queries = jnp.asarray(queries_u8[:Q], jnp.float32)
+
+    bf = brute_force.build(dataset, metric="sqeuclidean")
+    gt_vals, gt_ids = brute_force.search(bf, queries, K, select_algo="exact")
+    force(gt_vals)
+
+    t0 = time.perf_counter()
+    deg = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    ideg = 2 * deg
+    cidx = cagra.build(dataset, cagra.CagraParams(
+        intermediate_graph_degree=ideg, graph_degree=deg,
+        build_algo="ivf_pq"))
+    force(cidx.graph)
+    print(f"build deg={deg} {time.perf_counter()-t0:.0f}s", flush=True)
+
+    # graph quality: overlap of graph rows with true deg-NN on a sample
+    sample = jnp.asarray(np.random.default_rng(0).integers(0, N, 1000))
+    sq = dataset[sample]
+    _, true_nn = brute_force.search(bf, sq, deg + 1, select_algo="exact")
+    true_nn = jnp.where(
+        true_nn == sample[:, None], -2, true_nn)[:, :deg]  # drop self
+    grec = float(stats.neighborhood_recall(cidx.graph[sample], true_nn))
+    print(f"graph recall vs true {deg}-NN: {grec:.4f}", flush=True)
+
+    for itopk, w, mi in ((64, 4, 0), (64, 4, 48), (128, 4, 0), (128, 4, 64),
+                         (128, 8, 32), (192, 8, 48)):
+        p = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
+                                    max_iterations=mi)
+        t0 = time.perf_counter()
+        cv, ci = cagra.search(cidx, queries, K, p)
+        rec = float(stats.neighborhood_recall(ci, gt_ids, cv, gt_vals))
+        # amortized QPS over 3 calls
+        t0 = time.perf_counter()
+        for _ in range(3):
+            cv, ci = cagra.search(cidx, queries, K, p)
+        force(cv)
+        qps = Q / ((time.perf_counter() - t0) / 3)
+        print(f"itopk={itopk} w={w} mi={mi}: recall {rec:.4f} "
+              f"QPS {qps:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Degree sweep: pruned-graph navigability vs search budget at 1M."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from raft_tpu import stats
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.neighbors import brute_force, cagra
+
+
+def main():
+    N, DIM, Q, K = 1_000_000, 128, 2000, 10
+    deg = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    data_u8, queries_u8 = sift_like(N, DIM, 10_000)
+    dataset = jnp.asarray(data_u8, jnp.float32)
+    queries = jnp.asarray(queries_u8[:Q], jnp.float32)
+    bf = brute_force.build(dataset, metric="sqeuclidean")
+    gt_vals, gt_ids = brute_force.search(bf, queries, K, select_algo="exact")
+    float(jnp.sum(gt_vals))
+    t0 = time.perf_counter()
+    cidx = cagra.build(dataset, cagra.CagraParams(
+        intermediate_graph_degree=2 * deg, graph_degree=deg,
+        build_algo="ivf_pq", graph_refine_iters=0))
+    float(jnp.sum(cidx.graph[:1, :1].astype(jnp.float32)))
+    print(f"build deg={deg}: {time.perf_counter()-t0:.0f}s", flush=True)
+    for itopk, w in ((64, 2), (64, 4), (96, 4), (128, 4), (128, 8)):
+        p = cagra.CagraSearchParams(itopk_size=itopk, search_width=w)
+        cv, ci = cagra.search(cidx, queries, K, p)
+        rec = float(stats.neighborhood_recall(ci, gt_ids, cv, gt_vals))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            cv, ci = cagra.search(cidx, queries, K, p)
+        float(jnp.sum(cv))
+        qps = Q / ((time.perf_counter() - t0) / 3)
+        print(f"deg={deg} itopk={itopk} w={w}: recall {rec:.4f} "
+              f"QPS {qps:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

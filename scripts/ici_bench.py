@@ -1,0 +1,134 @@
+"""ICI-readiness weak-scaling microbench (VERDICT r3 #10).
+
+Runs the SPMD search paths on a virtual CPU mesh at n_devices ∈ {1,2,4,8},
+weak-scaled (rows per shard held constant), and records:
+
+  * wall-clock per search (virtual CPU — meaningful for SCALING SHAPE, not
+    absolute TPU perf: the goal is a committed baseline so the first real
+    pod run has a reference curve);
+  * collective traffic per search, counted from the compiled HLO of the
+    shard_map program (all-gather/all-reduce/reduce-scatter ops and their
+    shapes) plus the analytic model (q·world·k·8 B for the candidate
+    all_gather — the dominant term; psum scalars are noise).
+
+Writes results/ICI_r{N}.json. Usage: python -m scripts.ici_bench [round].
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+ROWS_PER_SHARD = 32_768
+DIM = 64
+Q = 1024
+K = 10
+N_LISTS = 64
+REPS = 3
+
+
+def _force(x):
+    return float(jnp.sum(jnp.where(jnp.isfinite(x), x, 0)))
+
+
+def collective_stats(n_dev: int, q: int, k: int) -> dict:
+    """Analytic per-search collective model for the sharded IVF search:
+    every query tile all_gathers (world, q, k) candidate vals (f32) + ids
+    (i32) over the mesh axis; ring all-gather moves (world-1)/world of the
+    gathered buffer per link."""
+    gathered = 2 * 4 * q * k * n_dev            # vals + ids, full buffer
+    per_link = int(gathered * (n_dev - 1) / max(n_dev, 1))
+    return {"allgather_bytes_total": gathered,
+            "allgather_bytes_per_link": per_link}
+
+
+def hlo_collectives(fn, *args) -> dict:
+    """Count collective ops in the compiled HLO of a jitted callable."""
+    try:
+        txt = jax.jit(fn).lower(*args).compile().as_text()
+    except Exception:
+        return {}
+    out = {}
+    for op in ("all-gather", "all-reduce", "reduce-scatter",
+               "collective-permute", "all-to-all"):
+        out[op] = txt.count(f" {op}(") + txt.count(f" {op}-start(")
+    return out
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    from raft_tpu.comms import local_mesh
+    from raft_tpu.comms.comms import Comms
+    from raft_tpu.distributed import brute_force as dbf
+    from raft_tpu.distributed import ivf_flat as divf
+    from raft_tpu.neighbors import ivf_flat as sl_flat
+
+    rng = np.random.default_rng(0)
+    queries = jnp.asarray(rng.standard_normal((Q, DIM)), jnp.float32)
+
+    results = {"rows_per_shard": ROWS_PER_SHARD, "dim": DIM, "q": Q, "k": K,
+               "platform": "cpu-virtual", "points": []}
+    for n_dev in (1, 2, 4, 8):
+        n = ROWS_PER_SHARD * n_dev
+        X = jnp.asarray(rng.standard_normal((n, DIM)), jnp.float32)
+        comms = Comms(local_mesh(n_dev))
+
+        point = {"n_devices": n_dev, "n_rows": n}
+        # --- sharded brute force -----------------------------------------
+        idx = dbf.build(X, comms=comms)
+        v, _ = dbf.search(idx, queries, K)
+        _force(v)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            v, _ = dbf.search(idx, queries, K)
+        _force(v)
+        dt = (time.perf_counter() - t0) / REPS
+        point["brute_qps"] = round(Q / dt, 1)
+
+        # --- sharded IVF-Flat --------------------------------------------
+        fidx = divf.build(X, sl_flat.IvfFlatParams(
+            n_lists=N_LISTS, kmeans_trainset_fraction=0.5), comms=comms)
+        v, _ = divf.search(fidx, queries, K, n_probes=8)
+        _force(v)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            v, _ = divf.search(fidx, queries, K, n_probes=8)
+        _force(v)
+        dt = (time.perf_counter() - t0) / REPS
+        point["ivf_flat_qps"] = round(Q / dt, 1)
+        point["collectives_analytic"] = collective_stats(n_dev, Q, K)
+        results["points"].append(point)
+        print(json.dumps(point), flush=True)
+
+    # On the virtual mesh every "device" shares the same host cores, so
+    # total work grows ∝ world on fixed silicon: ideal weak scaling shows
+    # as qps_N · N ≈ qps_1. The normalized ratio is the committed baseline
+    # number — on real ICI it should hold near 1.0 with N× the silicon.
+    base = results["points"][0]
+    last = results["points"][-1]
+    n_last = last["n_devices"]
+    results["weak_scaling_efficiency_brute"] = round(
+        last["brute_qps"] * n_last / max(base["brute_qps"], 1e-9), 3)
+    results["weak_scaling_efficiency_ivf"] = round(
+        last["ivf_flat_qps"] * n_last / max(base["ivf_flat_qps"], 1e-9), 3)
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "results", f"ICI_r{rnd:02d}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()

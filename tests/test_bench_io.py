@@ -1,0 +1,107 @@
+"""Dataset IO: TEXMEX/big-ann/hdf5 readers + ground-truth generation
+(reference: raft-ann-bench get_dataset / generate_groundtruth tooling)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.bench import io as bio
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+class TestVecs:
+    def test_fvecs_roundtrip(self, rng, tmp_path):
+        arr = rng.normal(size=(37, 24)).astype(np.float32)
+        p = tmp_path / "x.fvecs"
+        bio.write_vecs(p, arr)
+        back = bio.read_vecs(p)
+        np.testing.assert_array_equal(back, arr)
+
+    def test_bvecs_and_count(self, rng, tmp_path):
+        arr = rng.integers(0, 256, size=(20, 128)).astype(np.uint8)
+        p = tmp_path / "x.bvecs"
+        bio.write_vecs(p, arr)
+        np.testing.assert_array_equal(bio.read_vecs(p, count=5), arr[:5])
+
+    def test_ivecs_groundtruth_shape(self, rng, tmp_path):
+        gt = rng.integers(0, 1000, size=(11, 100)).astype(np.int32)
+        p = tmp_path / "gt.ivecs"
+        bio.write_vecs(p, gt)
+        np.testing.assert_array_equal(bio.read_vecs(p), gt)
+
+    def test_corrupt_size_raises(self, tmp_path):
+        p = tmp_path / "bad.fvecs"
+        p.write_bytes(b"\x04\x00\x00\x00" + b"\x00" * 10)  # dim 4, short row
+        with pytest.raises(ValueError, match="row size"):
+            bio.read_vecs(p)
+
+
+class TestBin:
+    def test_fbin_roundtrip(self, rng, tmp_path):
+        arr = rng.normal(size=(9, 96)).astype(np.float32)
+        p = tmp_path / "base.fbin"
+        bio.write_bin(p, arr)
+        np.testing.assert_array_equal(bio.read_bin(p), arr)
+
+    def test_u8bin_count(self, rng, tmp_path):
+        arr = rng.integers(0, 256, size=(30, 16)).astype(np.uint8)
+        p = tmp_path / "base.u8bin"
+        bio.write_bin(p, arr)
+        np.testing.assert_array_equal(bio.read_bin(p, count=4), arr[:4])
+
+
+class TestHdf5:
+    def test_bundle(self, rng, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        p = tmp_path / "toy.hdf5"
+        with h5py.File(p, "w") as f:
+            f["train"] = rng.normal(size=(50, 8)).astype(np.float32)
+            f["test"] = rng.normal(size=(5, 8)).astype(np.float32)
+            f["neighbors"] = rng.integers(0, 50, size=(5, 10))
+        z = bio.read_hdf5(p)
+        assert z["train"].shape == (50, 8)
+        assert z["neighbors"].shape == (5, 10)
+
+
+class TestGroundtruth:
+    def test_matches_sklearn(self, rng):
+        from sklearn.neighbors import NearestNeighbors
+
+        X = rng.normal(size=(300, 12)).astype(np.float32)
+        Q = rng.normal(size=(9, 12)).astype(np.float32)
+        ids, d = bio.generate_groundtruth(X, Q, k=5, batch=4)
+        ref = NearestNeighbors(n_neighbors=5).fit(X)
+        _, ref_ids = ref.kneighbors(Q)
+        np.testing.assert_array_equal(ids, ref_ids)
+
+
+class TestDiscovery:
+    def test_texmex_layout(self, rng, tmp_path):
+        d = tmp_path / "sift"
+        d.mkdir()
+        base = rng.integers(0, 255, size=(64, 32)).astype(np.float32)
+        qs = rng.integers(0, 255, size=(7, 32)).astype(np.float32)
+        gt = rng.integers(0, 64, size=(7, 10)).astype(np.int32)
+        bio.write_vecs(d / "sift_base.fvecs", base)
+        bio.write_vecs(d / "sift_query.fvecs", qs)
+        bio.write_vecs(d / "sift_groundtruth.ivecs", gt)
+        got = bio.load_real_dataset(tmp_path, "sift")
+        assert got is not None
+        b, q, g = got
+        np.testing.assert_array_equal(b, base)
+        np.testing.assert_array_equal(g, gt)
+
+    def test_bigann_layout(self, rng, tmp_path):
+        d = tmp_path / "deep"
+        d.mkdir()
+        bio.write_bin(d / "base.fbin", rng.normal(size=(16, 8)).astype(np.float32))
+        bio.write_bin(d / "query.fbin", rng.normal(size=(3, 8)).astype(np.float32))
+        got = bio.load_real_dataset(tmp_path, "deep")
+        assert got is not None and got[2] is None
+        assert got[0].shape == (16, 8)
+
+    def test_missing_returns_none(self, tmp_path):
+        assert bio.load_real_dataset(tmp_path, "nope") is None

@@ -162,3 +162,27 @@ class TestIntegerDtypes:
         vf, jf = brute_force.search(brute_force.build(X.astype(np.float32)), Q, 5)
         np.testing.assert_array_equal(np.asarray(i8), np.asarray(jf))
         np.testing.assert_allclose(np.asarray(v8), np.asarray(vf), rtol=1e-5)
+
+
+class TestRaggedFilterSparse:
+    def test_filter_sparser_than_k_stays_masked(self):
+        """Code-review r4 regression: the mantissa-packed in-kernel top-k
+        clamped the +inf filtered/padding sentinel to a finite ~3.4e38, so
+        disallowed ids leaked back as 'hits'. With fewer allowed ids than
+        k, every surplus slot must be (-1, inf)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from raft_tpu.core.bitset import Bitset
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2000, 32)).astype(np.float32)
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8,
+                                                       group_size=512))
+        keep = np.zeros(2000, bool)
+        keep[:3] = True
+        v, i = ivf_flat.search(idx, X[:4], 10, n_probes=8,
+                               filter=Bitset.from_mask(keep),
+                               backend="ragged")
+        ids = np.asarray(i)
+        assert set(ids.ravel().tolist()) <= {0, 1, 2, -1}, ids
+        assert np.all(np.isinf(np.asarray(v)[:, 3:]))
