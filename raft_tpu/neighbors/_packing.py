@@ -120,20 +120,50 @@ def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
     free = jnp.maximum(cap - (base + counts), 0)
     labels_out = labels
     remaining = over
-    for r in range(n_alt):
-        target = jnp.where(remaining, alt[:, r], n_lists)
+
+    def admit(labels_out, remaining, free, targets):
+        target = jnp.where(remaining, targets, n_lists)
         s_order = jnp.argsort(target)
         t_sorted = target[s_order]
         t_counts = jnp.bincount(t_sorted, length=n_lists + 1)
         t_off = jnp.cumsum(t_counts) - t_counts
-        rank_sorted = jnp.arange(n, dtype=jnp.int32) - t_off[t_sorted].astype(jnp.int32)
+        rank_sorted = (jnp.arange(n, dtype=jnp.int32)
+                       - t_off[t_sorted].astype(jnp.int32))
         t_rank = jnp.zeros(n, jnp.int32).at[s_order].set(rank_sorted)
-        admitted = remaining & (t_rank < free[jnp.clip(target, 0, n_lists - 1)]) \
+        admitted = remaining \
+            & (t_rank < free[jnp.clip(target, 0, n_lists - 1)]) \
             & (target < n_lists)
-        labels_out = jnp.where(admitted, alt[:, r], labels_out)
-        free = free - jnp.bincount(jnp.where(admitted, alt[:, r], n_lists),
+        labels_out = jnp.where(admitted, targets, labels_out)
+        free = free - jnp.bincount(jnp.where(admitted, targets, n_lists),
                                    length=n_lists + 1)[:n_lists]
-        remaining = remaining & ~admitted
+        return labels_out, remaining & ~admitted, free
+
+    for r in range(n_alt):
+        labels_out, remaining, free = admit(labels_out, remaining, free,
+                                            alt[:, r])
+    # pressure valve (round-4): a Zipf mega-cluster can exhaust all n_alt
+    # NEAREST alternatives and leave the cap soft — at 10M rows a handful
+    # of stragglers pow2-inflated every padded array 4×. Remaining rows
+    # bid for the globally EMPTIEST lists. NOTE the weaker placement
+    # property: unlike the nearest-alternative rounds, an emptiest list may
+    # be far from the row, making those few rows unlikely to be probed —
+    # the price of a hard memory bound (affects only the residue the local
+    # rounds could not place; ranking the emptiest-K per row by distance
+    # would restore locality if it ever matters).
+    def admit_uniform(labels_out, remaining, free, list_id):
+        # all bidders share one target: rank = position among remaining —
+        # one cumsum, not the full sort/scatter admission (review r4)
+        t_rank = jnp.cumsum(remaining.astype(jnp.int32)) - 1
+        admitted = remaining & (t_rank < free[list_id])
+        labels_out = jnp.where(admitted, list_id, labels_out)
+        free = free.at[list_id].add(-jnp.sum(admitted.astype(jnp.int32)))
+        return labels_out, remaining & ~admitted, free
+
+    for _ in range(2):
+        emptiest = jnp.argsort(-free)[: min(8, n_lists)]
+        for r in range(emptiest.shape[0]):
+            labels_out, remaining, free = admit_uniform(
+                labels_out, remaining, free, emptiest[r])
     return labels_out
 
 

@@ -406,7 +406,8 @@ def _ragged_plan_static(index, n_probes, k, res, dim):
             pass
     classes, class_counts, cls_ord = cached
     q_tile = ss.fit_q_tile(1 << 30, n_probes, index.n_lists, len(classes),
-                           int(k), res.workspace_bytes, dim=dim)
+                           int(k), res.workspace_bytes, dim=dim,
+                           class_counts=class_counts)
     return classes, class_counts, cls_ord, q_tile
 
 

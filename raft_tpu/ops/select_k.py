@@ -126,12 +126,11 @@ def iter_topk_min_packed(values, k: int):
     out_v = jnp.stack(vs, -1)
     # restore the ±inf the packing clamped away (code-review r4: a clamped
     # +inf sentinel — filtered/padding entries — must NOT come back as a
-    # finite ~3.4e38 "hit"; downstream isfinite masks depend on it)
-    tclamp = lax.bitcast_convert_type(
-        lax.bitcast_convert_type(jnp.float32(clamp), jnp.int32)
-        & jnp.int32(~mask), jnp.float32)
-    out_v = jnp.where(out_v >= tclamp, jnp.inf, out_v)
-    out_v = jnp.where(out_v <= -tclamp, -jnp.inf, out_v)
+    # finite ~3.4e38 "hit"; downstream isfinite masks depend on it).
+    # clamp's low mantissa bits are zero, so clamped unpacked values equal
+    # it exactly; the compare uses the static python float
+    out_v = jnp.where(out_v >= clamp, jnp.inf, out_v)
+    out_v = jnp.where(out_v <= -clamp, -jnp.inf, out_v)
     return out_v, jnp.stack(idxs, -1).astype(jnp.int32)
 
 

@@ -68,7 +68,7 @@ from __future__ import annotations
 import functools
 import math
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -250,11 +250,10 @@ def _extract_topk_packed(pv, kf: int):
     )
     from raft_tpu.ops.select_k import pack_clamp_for
 
-    tclamp = lax.bitcast_convert_type(
-        lax.bitcast_convert_type(jnp.float32(pack_clamp_for(_PACK_BITS)),
-                                 jnp.int32) & jnp.int32(~_PACK_MASK),
-        jnp.float32)
-    return jnp.where(vals >= tclamp, jnp.inf, vals), es
+    # pack_clamp_for's value already has zero low mantissa bits, so the
+    # unpacked winner of a clamped entry equals it exactly (static python
+    # float: Mosaic rejects scalar bitcast ops in-kernel)
+    return jnp.where(vals >= pack_clamp_for(_PACK_BITS), jnp.inf, vals), es
 
 
 def _extract_topk(v, offs, kf: int):
@@ -545,22 +544,26 @@ def class_info(lens_np: np.ndarray):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_lists", "n_classes", "s_region"),
+    static_argnames=("n_lists", "region_starts", "s_tot"),
 )
-def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
-                 s_region: int):
+def _plan_device(probes, cls_ord, n_lists: int,
+                 region_starts: Tuple[int, ...], s_tot: int):
     """Device-side strip planning (round-3 v3): the host↔device link on the
     tunneled TPU measured ~25 MB/s, so host-built plan tables (a few MB per
     tile) dominated search latency. This builds the same tables with jnp
     sorts/scatters ON DEVICE; the host only fetches the per-class strip
-    counts (a few ints) to fix the static grid sizes.
+    counts (a few ints) to fix the static grid sizes — or nothing at all on
+    the static-layout path.
 
-    Strips live in fixed per-class regions of ``s_region`` slots (region c
-    starts at c·s_region); unused slots carry qids=-1 / strip_list=0 and are
+    Strips live in per-class regions starting at ``region_starts[c]``
+    (round-4: per-class sizes — a uniform n_lists-wide stride made the
+    query-side tables scale as n_classes · n_lists, which OOM'd many-list /
+    few-query shapes); unused slots carry qids=-1 / strip_list=-1 and are
     never read by the merge. Returns (qids, strip_list, pair_strip,
     pair_slot, counts_per_class)."""
     q, p = probes.shape
     qp = q * p
+    n_classes = len(region_starts)
     flat = probes.reshape(-1)
     order = jnp.argsort(flat, stable=True)
     sorted_lists = flat[order]
@@ -581,7 +584,8 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
     counts = jax.ops.segment_sum(n_qc_sorted, cls_sorted,
                                  num_segments=n_classes)
     class_first = jnp.cumsum(counts) - counts          # exclusive
-    base_sorted = cls_sorted * s_region + (csum - class_first[cls_sorted])
+    starts = jnp.asarray(region_starts, jnp.int32)
+    base_sorted = starts[cls_sorted] + (csum - class_first[cls_sorted])
     strip_base = jnp.zeros(n_lists, jnp.int32).at[list_order].set(
         base_sorted.astype(jnp.int32))
 
@@ -593,7 +597,6 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
     pair_strip = jnp.zeros(qp, jnp.int32).at[order].set(ps_sorted)
     pair_slot = jnp.zeros(qp, jnp.int32).at[order].set(slot_sorted)
 
-    s_tot = n_classes * s_region
     # padding slots = -1: the kernel skips them entirely (round-4; with the
     # static worst-case layout the padded grid would otherwise do real work)
     strip_list = jnp.full(s_tot, -1, jnp.int32).at[ps_sorted].set(
@@ -605,7 +608,8 @@ def _plan_device(probes, cls_ord, n_lists: int, n_classes: int,
 
 
 def fit_q_tile(q: int, p: int, n_lists: int, n_classes: int, kf: int,
-               workspace_bytes: int, dim: int = 0) -> int:
+               workspace_bytes: int, dim: int = 0,
+               class_counts: Optional[Tuple[int, ...]] = None) -> int:
     """Largest query tile whose per-class region tables + kernel outputs
     stay inside the workspace budget. Per strip slot: kf fp32+int32 output
     pairs (kf·8), the qids int32 entry (4), and — the round-3 undercount
@@ -613,12 +617,14 @@ def fit_q_tile(q: int, p: int, n_lists: int, n_classes: int, kf: int,
     (2·dim bytes) built in _strip_tile_body."""
     q_tile = min(q, 16384)
     per_slot = kf * 8 + 4 + 2 * dim
+    if class_counts is None:
+        class_counts = tuple([n_lists] * max(n_classes, 1))
 
-    def s_region_for(qt):
-        return _bucket(_ceil_div(qt * p, C) + n_lists)
+    def rows_for(qt):
+        return sum(static_caps(class_counts, qt, p))
 
-    while (s_region_for(q_tile) * n_classes * C * per_slot
-           > workspace_bytes and q_tile > 512):
+    while (rows_for(q_tile) * C * per_slot > workspace_bytes
+           and q_tile > 512):
         q_tile //= 2
     return q_tile
 
@@ -629,10 +635,11 @@ def plan_tile(probes_dev, start: int, qt: int, cls_ord, classes, n_lists: int):
     the distributed tiled_search so the planning protocol cannot drift."""
     p = probes_dev.shape[1]
     n_classes = len(classes)
-    s_region = _bucket(_ceil_div(qt * p, C) + n_lists)
+    s_region = _bucket(min(qt * p, _ceil_div(qt * p, C) + n_lists))
+    region_starts = tuple(c * s_region for c in range(n_classes))
     qids, strip_list, pair_strip, pair_slot, counts = _plan_device(
         lax.slice_in_dim(probes_dev, start, start + qt, axis=0),
-        cls_ord, n_lists, n_classes, s_region,
+        cls_ord, n_lists, region_starts, n_classes * s_region,
     )
     counts_np = np.asarray(counts)  # ~n_classes ints — the only fetch
     layout = tuple(
@@ -648,23 +655,35 @@ def class_counts_of(cls_ord_np: np.ndarray, n_classes: int) -> Tuple[int, ...]:
     return tuple(int(x) for x in np.bincount(cls_ord_np, minlength=n_classes))
 
 
-def static_layout(classes, class_counts: Tuple[int, ...], qt: int, p: int,
-                  n_lists: int):
+def static_caps(class_counts: Tuple[int, ...], qt: int, p: int):
+    """Per-class worst-case strip counts for a qt-query tile: a class holds
+    at most ceil(qt·p/C) full strips + one partial per list IN THAT CLASS,
+    and never more strips than pairs (the qt·p bound bites at small tiles).
+    """
+    full = _ceil_div(qt * p, C)
+    return tuple(_bucket(min(qt * p, full + int(nc)))
+                 for nc in class_counts)
+
+
+def static_layout(classes, class_counts: Tuple[int, ...], qt: int, p: int):
     """Host-static worst-case layout for a qt-query tile — no device fetch.
 
-    Region stride ``s_region`` bounds any class's strip count: a tile has at
-    most ceil(qt·p/C) full strips plus one partial strip per probed list.
-    Per class the bound tightens to ceil(qt·p/C) + (lists in that class).
-    With one length class (the common large-index case) this equals the
-    bucketed dynamic plan's size, so the static grid costs nothing extra.
-    """
-    n_classes = len(classes)
-    s_region = _bucket(_ceil_div(qt * p, C) + n_lists)
-    return s_region, tuple(
-        (classes[c][0], classes[c][1], c * s_region,
-         min(s_region, _bucket(_ceil_div(qt * p, C) + class_counts[c])))
-        for c in range(n_classes)
+    Regions are sized PER CLASS (round-4: a uniform n_lists-wide stride
+    made the query-side tables scale as n_classes · n_lists and OOM'd
+    many-list shapes). With one length class (the common large-index case)
+    this equals the bucketed dynamic plan's size, so the static grid costs
+    nothing extra. Returns (region_starts, s_tot, layout)."""
+    caps = static_caps(class_counts, qt, p)
+    starts = []
+    acc = 0
+    for cap in caps:
+        starts.append(acc)
+        acc += cap
+    layout = tuple(
+        (classes[c][0], classes[c][1], starts[c], caps[c])
+        for c in range(len(classes))
     )
+    return tuple(starts), acc, layout
 
 
 def strip_search_traced(queries_mat, probes, list_data, bias, list_ids,
@@ -687,11 +706,11 @@ def strip_search_traced(queries_mat, probes, list_data, bias, list_ids,
     out_v, out_i = [], []
     for start in range(0, q, q_tile):
         qt = min(q_tile, q - start)
-        s_region, layout = static_layout(classes, class_counts, qt, p,
-                                         n_lists)
+        region_starts, s_tot, layout = static_layout(
+            classes, class_counts, qt, p)
         qids, strip_list, pair_strip, pair_slot, _ = _plan_device(
             lax.slice_in_dim(probes, start, start + qt, axis=0),
-            cls_ord, n_lists, len(classes), s_region,
+            cls_ord, n_lists, region_starts, s_tot,
         )
         v, i = _strip_tile_body(
             lax.slice_in_dim(queries_mat, start, start + qt, axis=0),

@@ -1,0 +1,74 @@
+"""Experiment: MAX_CLASS=4 (2048-entry strips) — fewer grid steps per
+search at the cost of wider in-kernel blocks. Packed extraction holds one
+live copy, so VMEM should now fit the (192, 2048) score block."""
+import time
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import strip_scan as ss
+
+MAXC = 4
+ss.MAX_CLASS = MAXC
+ss._PACK_BITS = 11
+ss._PACK_MASK = (1 << 11) - 1
+
+from raft_tpu import stats
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine
+
+
+def timeq(run, queries, reps=5):
+    v, _ = run(queries)
+    float(jnp.sum(v))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        v, _ = run(queries)
+    float(jnp.sum(v))
+    return queries.shape[0] / ((time.perf_counter() - t0) / reps)
+
+
+def main():
+    N, DIM, Q, K = 1_000_000, 128, 10_000, 10
+    data_u8, queries_u8 = sift_like(N, DIM, Q)
+    dataset = jnp.asarray(data_u8, jnp.float32)
+    queries = jnp.asarray(queries_u8, jnp.float32)
+    bf = brute_force.build(dataset, metric="sqeuclidean")
+    gt_vals, gt_ids = brute_force.search(bf, queries, K, select_algo="exact")
+    float(jnp.sum(gt_vals))
+
+    idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+        n_lists=1024, kmeans_trainset_fraction=0.2))
+    float(jnp.sum(idx.list_norms))
+    import numpy as np
+
+    lens = np.asarray(idx.list_sizes())
+    classes, ordn = ss.class_info(lens)
+    print(f"MAX_CLASS={MAXC} classes {classes} counts "
+          f"{np.bincount(ordn).tolist()}", flush=True)
+    vals, ids = ivf_flat.search(idx, queries, K, n_probes=32)
+    rec = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+    qps = timeq(lambda qs: ivf_flat.search(idx, qs, K, n_probes=32), queries)
+    print(f"IVF-Flat np=32: recall {rec:.4f} QPS {qps:,.0f}", flush=True)
+    del idx
+
+    pidx = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
+        n_lists=1024, pq_dim=64, pq_bits=8, kmeans_trainset_fraction=0.2))
+    float(jnp.sum(pidx.b_sum))
+
+    def pq_run(qs):
+        _, cand = ivf_pq.search(pidx, qs, 2 * K, n_probes=32)
+        return refine.refine(dataset, qs, cand, K)
+
+    vals, ids = pq_run(queries)
+    rec = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+    qps = timeq(pq_run, queries)
+    print(f"IVF-PQ np=32 kf=20: recall {rec:.4f} QPS {qps:,.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Sparse pairwise-distance backend microbench (VERDICT r3 #9 'done'
+criterion): identical results + the expand path winning at high sparsity.
+
+Writes results/SPARSE_r{N}.json. Usage: python -m scripts.sparse_bench [N].
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse import convert, types
+from raft_tpu.sparse import distance as sdist
+
+
+def bench_one(nx, ny, m, density, rng, reps=5):
+    def make(n):
+        nnz_row = max(1, int(density * m))
+        rows = np.repeat(np.arange(n), nnz_row)
+        cols = rng.integers(0, m, n * nnz_row)
+        vals = rng.normal(size=n * nnz_row).astype(np.float32)
+        dense = np.zeros((n, m), np.float32)
+        dense[rows, cols] = vals
+        return types.coo_from_dense(dense,
+                                    capacity=int(np.count_nonzero(dense)) + 8)
+
+    x = convert.coo_to_csr(make(nx))
+    y = convert.coo_to_csr(make(ny))
+    out = {"nx": nx, "ny": ny, "dim": m, "density": density}
+    ref = None
+    for backend in ("dense", "expand"):
+        d = sdist.pairwise_distance(x, y, "sqeuclidean", backend=backend)
+        got = np.asarray(d)
+        if ref is None:
+            ref = got
+        else:
+            err = float(np.max(np.abs(got - ref))
+                        / max(1e-9, float(np.max(np.abs(ref)))))
+            out["max_rel_diff"] = round(err, 6)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            d = sdist.pairwise_distance(x, y, "sqeuclidean", backend=backend)
+        float(jnp.sum(d))
+        out[f"{backend}_ms"] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 2)
+    out["expand_speedup"] = round(out["dense_ms"] / max(out["expand_ms"],
+                                                        1e-9), 2)
+    return out
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    rng = np.random.default_rng(0)
+    results = {"platform": jax.devices()[0].platform, "points": []}
+    for density in (0.05, 0.01, 0.002):
+        p = bench_one(2048, 2048, 16384, density, rng)
+        results["points"].append(p)
+        print(json.dumps(p), flush=True)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "results", f"SPARSE_r{rnd:02d}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
