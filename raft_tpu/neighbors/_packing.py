@@ -56,11 +56,15 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     with padded dense blocks a single runaway cluster would inflate the
     whole (n_lists, max_list_size, ·) allocation AND every scan's chunk
     count, so a hard cap matters more here. Rows ranked >= cap within their
-    cluster move to their second-nearest center when that list has room
-    (pre-spill occupancy — a one-level, best-effort spill: a second list
-    that also overflows keeps the row, so the cap is soft). Recall impact is
-    bounded: a spilled row is found whenever its second-best list is probed,
-    and n_probes >> 1 in practice.
+    cluster first bid for their nearest alternative centers with room; any
+    residue is then packed into free slots across all lists (emptiest
+    first), so the cap is HARD whenever total capacity covers the rows
+    (n_lists·cap >= n — true for every auto cap). With insufficient total
+    capacity the unplaceable overflow keeps its original label. Recall
+    impact of the nearest-alternative rounds is bounded (a spilled row is
+    found whenever its second-best list is probed, n_probes >> 1 in
+    practice); the final packing trades that locality for the memory bound
+    on the residue only.
 
     Shapes are data-independent (second-nearest is computed for every row
     in static tiles): one extra assignment-scale pass, but the compiled
@@ -144,26 +148,24 @@ def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
     # pressure valve (round-4): a Zipf mega-cluster can exhaust all n_alt
     # NEAREST alternatives and leave the cap soft — at 10M rows a handful
     # of stragglers pow2-inflated every padded array 4×. Remaining rows
-    # bid for the globally EMPTIEST lists. NOTE the weaker placement
-    # property: unlike the nearest-alternative rounds, an emptiest list may
-    # be far from the row, making those few rows unlikely to be probed —
-    # the price of a hard memory bound (affects only the residue the local
-    # rounds could not place; ranking the emptiest-K per row by distance
-    # would restore locality if it ever matters).
-    def admit_uniform(labels_out, remaining, free, list_id):
-        # all bidders share one target: rank = position among remaining —
-        # one cumsum, not the full sort/scatter admission (review r4)
-        t_rank = jnp.cumsum(remaining.astype(jnp.int32)) - 1
-        admitted = remaining & (t_rank < free[list_id])
-        labels_out = jnp.where(admitted, list_id, labels_out)
-        free = free.at[list_id].add(-jnp.sum(admitted.astype(jnp.int32)))
-        return labels_out, remaining & ~admitted, free
-
-    for _ in range(2):
-        emptiest = jnp.argsort(-free)[: min(8, n_lists)]
-        for r in range(emptiest.shape[0]):
-            labels_out, remaining, free = admit_uniform(
-                labels_out, remaining, free, emptiest[r])
+    # are packed into free slots across ALL lists, emptiest first: row
+    # rank t among the remainder goes to the list owning the t-th free
+    # slot (searchsorted over the cumulative free-capacity profile). This
+    # makes the cap HARD whenever total capacity covers the rows
+    # (n_lists·cap ≥ n + base — true for every auto cap, which is ≥ 1.5×
+    # mean occupancy). NOTE the weaker placement property: unlike the
+    # nearest-alternative rounds, the receiving list may be far from the
+    # row, making those few rows unlikely to be probed — the price of the
+    # memory bound, paid only by the residue the local rounds could not
+    # place (ranking candidate lists by distance per row would restore
+    # locality if it ever matters).
+    order_lists = jnp.argsort(-free)                    # emptiest first
+    cumfree = jnp.cumsum(free[order_lists])
+    t_rank = jnp.cumsum(remaining.astype(jnp.int32)) - 1
+    slot = jnp.searchsorted(cumfree, t_rank, side="right")
+    ok = remaining & (t_rank < cumfree[-1]) & (slot < n_lists)
+    labels_out = jnp.where(
+        ok, order_lists[jnp.clip(slot, 0, n_lists - 1)], labels_out)
     return labels_out
 
 

@@ -56,7 +56,7 @@ Round-4 changes (measured on the 1M bench shape):
     (`pl.when`) and their block maps collapse to constants so the
     pipeline skips the re-fetches.
   * **Mantissa-packed extraction** — the in-kernel top-kf packs the
-    column id into the low 10 mantissa bits of the fp32 score
+    column id into the low 12 mantissa bits of the fp32 score
     (select_k.pack_values): each pass is one min + one equality mask (2
     full-width VPU ops vs 5), which was the kernel's dominant cost.
   * Together: IVF-Flat 43K → 92K QPS, IVF-PQ 33K → 54K at unchanged
@@ -81,9 +81,12 @@ C = 192          # queries per strip (MXU M dim; fewer, fatter strips
                  # amortize the measured ~25 µs fixed per-strip cost;
                  # 256 measured a VMEM stack OOM at kf=40)
 MC = 512         # base entry block; class-L strips read L*MC entries at once
-MAX_CLASS = 2    # biggest single-fetch strip: at C=256 queries, the
-                 # (C, W) score block + tournament temporaries must stay
-                 # inside ~16 MB VMEM; w=4 measured OOM at kf=40
+MAX_CLASS = 8    # biggest single-fetch strip (w = 4096 entries). Round 4:
+                 # the packed extraction holds ONE live score copy, so wide
+                 # blocks now fit VMEM where round 3's unrolled extraction
+                 # OOM'd at w=2048 — cutting grid steps for 1-4K-entry
+                 # lists measured IVF-Flat 97→111K and IVF-PQ 63→92K QPS
+                 # at the 1M bench shape (validated up to kf=129 in-kernel)
 
 
 def _ceil_div(a, b):
@@ -201,7 +204,9 @@ def plan_strips(probes: np.ndarray, lens: np.ndarray, n_lists: int) -> StripPlan
     )
 
 
-_PACK_BITS = 10          # low-mantissa bits carrying the column index
+_PACK_BITS = 12          # low-mantissa bits carrying the column index
+                         # (covers w = MAX_CLASS·MC = 4096; ≤ 2⁻¹¹ relative
+                         # value perturbation — inside the bf16 contract)
 _PACK_MASK = (1 << _PACK_BITS) - 1
 
 
@@ -213,8 +218,9 @@ def _pack_scores(s, w: int):
     A min pass over the packed values yields the winning VALUE and its
     COLUMN in one reduction — the per-pass argmin reconstruction
     (compare-to-min + one-hot sum) that dominated the round-3 kernel cost
-    drops out entirely. The ≤ 2⁻¹³ relative perturbation sits inside this
-    path's documented bf16 (~3 significant digits) ranking contract.
+    drops out entirely. The ≤ 2⁻¹¹ relative perturbation (12 index bits)
+    sits inside this path's documented bf16 (~3 significant digits)
+    ranking contract.
     """
     assert w <= (1 << _PACK_BITS), w
     from raft_tpu.ops.select_k import pack_values
@@ -698,8 +704,8 @@ def strip_search_traced(queries_mat, probes, list_data, bias, list_ids,
     counts to size the kernel grid — a blocking device→host sync in the
     middle of every search that (a) costs an RTT on the tunneled runtime and
     (b) prevents back-to-back searches from pipelining. Here the grid is
-    fixed at the static worst case (static_layout); padding strips scan
-    list 0 with qids=-1 and are never read by the merge.
+    fixed at the static worst case (static_layout); padding strips carry
+    strip_list = -1 and are skipped entirely in-kernel.
     """
     q, p = probes.shape
     n_lists = list_data.shape[0]

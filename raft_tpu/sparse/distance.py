@@ -121,12 +121,12 @@ def pairwise_distance(
     (superset of the reference's sparse metric list,
     sparse/distance/distance.cuh).
 
-    ``backend``: "dense" (densify-by-tiles + MXU — every metric),
-    "expand" (nnz-expansion over a padded ELL layout — the coo_spmv
-    analog; l2/ip/cosine only, wins on very sparse wide data), or "auto"
-    (expand when the FLOP model favors it: mean-row-nnz ≤ dim/48 from the
-    static stored-capacity bound — ≳98% effective sparsity, accounting the
-    VPU/MXU unit-cost gap and ELL max-row padding).
+    ``backend``: "dense" (densify-by-tiles + MXU — every metric; the
+    measured winner on TPU at every sparsity tested, see
+    results/SPARSE_r04.json), "expand" (nnz-expansion over a padded ELL
+    layout — the coo_spmv analog; l2/ip/cosine only, kept for API parity
+    and shapes where gathers beat redundant FLOPs), or "auto" (currently
+    = dense).
     """
     res = res or current_resources()
     y = x if y is None else y
@@ -142,24 +142,25 @@ def pairwise_distance(
         raise ValueError(
             f"backend='expand' supports {_EXPAND_METRICS}, got {metric!r} "
             "(use backend='dense')")
-    if backend != "dense" and canon in _EXPAND_METRICS and nx and ny:
-        # auto-routing from STATIC facts only (capacity = stored nnz bound):
-        # the mean row width proxies max row width without the device sync
-        # a bincount-max would cost on every call (code-review r4); _to_ell
-        # computes the exact max only once the expand path is taken
-        mean_w = max(1, x.indices.shape[0] // max(nx, 1))
-        if backend == "expand" or mean_w * 48 <= m:
-            ip = _expand_ip(x, y, res)
-            if canon == "inner_product":
-                return ip
-            xs = _row_sqnorms(x)
-            ys = _row_sqnorms(y)
-            if canon == "cosine":
-                denom = jnp.sqrt(jnp.maximum(
-                    xs[:, None] * ys[None, :], 1e-30))
-                return 1.0 - ip / denom
-            d = jnp.maximum(xs[:, None] + ys[None, :] - 2.0 * ip, 0.0)
-            return jnp.sqrt(d) if canon == "euclidean" else d
+    # measured (results/SPARSE_r04.json, v5e): the expand path LOSES to
+    # the dense MXU route at every tested density down to 99.8% sparse
+    # at (2048² × 16384) — TPU row gathers are op-bound (~12 ns/row),
+    # so nnz-expansion pays per-gather what the MXU amortizes away.
+    # "auto" therefore always takes dense; "expand" stays available for
+    # explicit use (API parity with the coo_spmv strategy family, and the
+    # place a future host-offload variant would slot in).
+    if backend == "expand" and nx and ny:
+        ip = _expand_ip(x, y, res)
+        if canon == "inner_product":
+            return ip
+        xs = _row_sqnorms(x)
+        ys = _row_sqnorms(y)
+        if canon == "cosine":
+            denom = jnp.sqrt(jnp.maximum(
+                xs[:, None] * ys[None, :], 1e-30))
+            return 1.0 - ip / denom
+        d = jnp.maximum(xs[:, None] + ys[None, :] - 2.0 * ip, 0.0)
+        return jnp.sqrt(d) if canon == "euclidean" else d
 
     # densify-by-tiles strategy: BOTH operands are materialized densely only
     # in workspace-bounded tiles (round-2 review: y was densified whole,

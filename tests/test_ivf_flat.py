@@ -186,3 +186,28 @@ class TestRaggedFilterSparse:
         ids = np.asarray(i)
         assert set(ids.ravel().tolist()) <= {0, 1, 2, -1}, ids
         assert np.all(np.isinf(np.asarray(v)[:, 3:]))
+
+
+class TestSpillHardCap:
+    def test_mega_cluster_capped_when_capacity_suffices(self):
+        """Round-4: a Zipf mega-cluster must not leave lists over cap when
+        total capacity covers the rows — the residue packs into free slots
+        across all lists (pow2 list padding used to inflate 4x on the
+        stragglers the nearest-alternative spill could not place)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import _packing
+
+        rng = np.random.default_rng(0)
+        n_lists = 64
+        work = rng.normal(size=(2000 + 63 * 90, 8)).astype(np.float32)
+        labels = np.concatenate([np.zeros(2000, np.int64),
+                                 np.repeat(np.arange(1, 64), 90)])
+        centers = rng.normal(size=(n_lists, 8)).astype(np.float32)
+        for cap in (121, 200):  # 64*121 = 7744 >= 7670 rows (99% full)
+            out = _packing.spill_to_cap(
+                jnp.asarray(work), jnp.asarray(centers),
+                jnp.asarray(labels), "sqeuclidean", cap)
+            counts = np.bincount(np.asarray(out), minlength=n_lists)
+            assert counts.max() <= cap, (cap, counts.max())
+            assert counts.sum() == len(labels)
