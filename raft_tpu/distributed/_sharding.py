@@ -170,7 +170,8 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
     p = probes.shape[1]
     if pair_const is None:
         pair_const = jnp.zeros((q, p), jnp.float32)
-    classes, cls_ord_np = class_info(np.asarray(lens_max))
+    classes, cls_ord_np = class_info(np.asarray(lens_max),
+                                     dim=queries_mat.shape[1])
     cls_ord = jnp.asarray(cls_ord_np)
     q_tile = fit_q_tile(q, p, n_lists, len(classes), kf,
                         current_resources().workspace_bytes,

@@ -396,7 +396,7 @@ def _ragged_plan_static(index, n_probes, k, res, dim):
     cached = getattr(index, "_ragged_static_cache", None)
     if cached is None:
         lens_np = _lens_np(index)
-        classes, cls_ord_np = ss.class_info(lens_np)
+        classes, cls_ord_np = ss.class_info(lens_np, dim=dim)
         classes = tuple(classes)  # hashable: jit static arg
         cached = (classes, ss.class_counts_of(cls_ord_np, len(classes)),
                   jnp.asarray(cls_ord_np))

@@ -34,10 +34,10 @@ shrinks to one gather + one small select over (q, n_probes·kf).
     (q, k) id-translate gather.
 
 Work remains ∝ Σ_pairs len(list): no per-list query cap, zero candidate
-drops by construction (pairs beyond one strip's 128 query slots get their
+drops by construction (pairs beyond one strip's query slots get their
 own strip). Strip counts per class are bucketed (two buckets per octave) to
-bound compiled-shape count; padding strips scan list 0 and are never read
-by the merge.
+bound compiled-shape count; padding strips carry strip_list = -1 and are
+skipped entirely in-kernel (round 4 — they used to scan list 0 unread).
 
 The B operand can be fp32/bf16 (IVF-Flat raw vectors, IVF-PQ bf16 decoded
 cache) or int8 (IVF-PQ's quantized decoded cache at rot_dim bytes/entry —
@@ -534,13 +534,29 @@ _strip_tile = jax.jit(
 )
 
 
-def class_info(lens_np: np.ndarray):
+def max_class_for(dim: int) -> int:
+    """Largest fetch class whose (1, w, dim) fp32 B-block stays inside a
+    ~6 MB double-buffered VMEM budget (review r4: MAX_CLASS=8 was only
+    validated at dim=128 — a dim-768 index would request 12.6 MB blocks).
+    dim=128 → 8; dim≈256 → 4; dim≈512 → 2; dim ≥ ~1024 → 1."""
+    if dim <= 0:
+        return MAX_CLASS
+    w_max = max(MC, (6 << 20) // (dim * 4 * 2))
+    cls = 1
+    while cls * 2 <= MAX_CLASS and cls * 2 * MC <= w_max:
+        cls *= 2
+    return cls
+
+
+def class_info(lens_np: np.ndarray, dim: int = 0):
     """Static per-index class table from per-list lengths: ordered distinct
-    (w_blocks, n_sub) classes and each list's class ordinal."""
+    (w_blocks, n_sub) classes and each list's class ordinal. ``dim`` caps
+    the fetch class so wide-row indexes keep their blocks inside VMEM."""
+    max_class = min(MAX_CLASS, max_class_for(dim)) if dim else MAX_CLASS
     n_mc = np.maximum(-(-np.maximum(lens_np, 0) // MC), 1)
     cls_full = (1 << np.ceil(np.log2(n_mc)).astype(np.int64))
-    w = np.minimum(cls_full, MAX_CLASS)
-    sub = np.maximum(cls_full // MAX_CLASS, 1)
+    w = np.minimum(cls_full, max_class)
+    sub = np.maximum(cls_full // max_class, 1)
     keys = w * (1 << 20) + sub
     uniq = np.unique(keys)
     ordinal = np.searchsorted(uniq, keys).astype(np.int32)
@@ -779,7 +795,7 @@ def strip_search(
 
     from raft_tpu.core.interruptible import check_interrupt
 
-    classes, cls_ord_np = class_info(lens_np)
+    classes, cls_ord_np = class_info(lens_np, dim=queries_mat.shape[1])
     cls_ord = jnp.asarray(cls_ord_np)  # 4 KB — the only per-search upload
     probes_dev = jnp.asarray(probes)
     q_tile = fit_q_tile(q, probes_dev.shape[1], n_lists, len(classes), kf,
