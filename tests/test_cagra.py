@@ -1,10 +1,12 @@
 """CAGRA + NN-descent tests: recall-gated vs the exact oracle (tier-3,
 SURVEY.md §4.3 — mirrors cpp/test/neighbors/ann_cagra recall thresholds)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from raft_tpu.core.bitset import Bitset
+from raft_tpu import stats
 from raft_tpu.neighbors import brute_force, cagra, nn_descent
 
 
@@ -170,3 +172,35 @@ class TestCagraSearch:
             cagra.search(index, Q, 5, filter=Bitset.create(10))
         with pytest.raises(ValueError, match="unknown build_algo"):
             cagra.CagraParams(build_algo="hnsw")
+
+class TestRefineKnnGraph:
+    """Device-resident NN-descent sweep (cagra.refine_knn_graph)."""
+
+    @pytest.fixture(scope="class")
+    def graph_case(self):
+        from raft_tpu.core.resources import current_resources
+
+        rng = np.random.default_rng(0)
+        n, dim, ideg = 1500, 16, 16
+        X = jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32))
+        _, nn = brute_force.search(brute_force.build(X), X, ideg + 1,
+                                   select_algo="exact")
+        exact = cagra._drop_self(nn, 0, ideg)
+        return rng, X, exact, ideg, n, current_resources()
+
+    def test_preserves_exact_graph_and_degree(self, graph_case):
+        rng, X, exact, ideg, n, res = graph_case
+        out = cagra.refine_knn_graph(X, exact, 1, 64, 0, res)
+        # an already-exact graph must survive a sweep (the round-4 dup
+        # collapse bug halved the degree here)
+        assert float(jnp.mean(jnp.sum(out >= 0, axis=1))) == ideg
+        rec = float(stats.neighborhood_recall(out, exact))
+        assert rec > 0.95, rec
+
+    def test_improves_random_graph(self, graph_case):
+        rng, X, exact, ideg, n, res = graph_case
+        bad = jnp.asarray(rng.integers(0, n, (n, ideg)).astype(np.int32))
+        before = float(stats.neighborhood_recall(bad, exact))
+        out = cagra.refine_knn_graph(X, bad, 3, 64, 0, res)
+        after = float(stats.neighborhood_recall(out, exact))
+        assert after > before + 0.1, (before, after)
