@@ -70,9 +70,18 @@ def load_arrays(path_or_stream) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
         (version,) = struct.unpack("<I", stream.read(4))
         if version > _VERSION:
             raise ValueError(f"unsupported container version {version}")
-        (meta_len,) = struct.unpack("<Q", stream.read(8))
-        meta = json.loads(stream.read(meta_len).decode("utf-8"))
-        arrays = {name: deserialize_array(stream) for name in meta["arrays"]}
+        try:
+            (meta_len,) = struct.unpack("<Q", stream.read(8))
+            meta = json.loads(stream.read(meta_len).decode("utf-8"))
+            arrays = {name: deserialize_array(stream)
+                      for name in meta["arrays"]}
+        except ValueError:
+            raise
+        except Exception as e:
+            # np.load's header parser leaks tokenize/struct/unicode errors
+            # on garbage bytes past a valid magic — surface one stable
+            # exception type for corrupt files
+            raise ValueError(f"corrupt raft_tpu container: {e!r}") from e
         return meta, arrays
     finally:
         if own:

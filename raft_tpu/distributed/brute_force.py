@@ -75,7 +75,7 @@ def build(
 
 @functools.lru_cache(maxsize=64)
 def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
-                    has_filter, has_norms, compute_dtype):
+                    has_filter, has_norms, compute_dtype, world=0):
     select_min = metric not in _MAX_METRICS
     bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
     needs_norms = metric in ("sqeuclidean", "euclidean", "cosine")
@@ -100,10 +100,11 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
             gids = jnp.pad(gids, (0, k - rows), constant_values=-1)
         vals, sel = select_k(d, k, select_min=select_min, algo=select_algo)
         ids = jnp.where(vals == bad, -1, jnp.take(gids, sel))
-        # cross-shard candidate exchange + exact re-select (knn_merge_parts)
-        all_vals = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
-        all_ids = jax.lax.all_gather(ids, axis, axis=1, tiled=True)
-        return select_k(all_vals, k, select_min=select_min, indices=all_ids)
+        # cross-shard butterfly merge (knn_merge_parts analog; per-link
+        # bytes k·log2(world) — see _sharding.merge_shards)
+        from raft_tpu.distributed._sharding import merge_shards
+
+        return merge_shards(vals, ids, k, axis, world, select_min)
 
     nspec = P(axis) if has_norms else P()
     fn = jax.shard_map(
@@ -148,6 +149,7 @@ def search(
         filter is not None,
         index.norms is not None,
         res.compute_dtype if index.metric in dist_mod.EXPANDED_METRICS else None,
+        comms.size,
     )
     fwords = filter.bits if filter is not None else jnp.zeros((1,), jnp.uint32)
     norms = (

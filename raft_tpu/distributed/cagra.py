@@ -1,5 +1,5 @@
 """Sharded CAGRA: per-shard local graphs, replicated queries, one
-``shard_map`` search with an all-gather candidate merge.
+``shard_map`` search with a butterfly (recursive-doubling) candidate merge.
 
 Reference pattern: the raft-dask MNMG ANN layout
 (python/raft-dask/raft_dask/common/comms.py:40 — every worker owns an
@@ -29,7 +29,6 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu.comms.comms import Comms, make_comms
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.neighbors import cagra as sl
-from raft_tpu.ops.select_k import select_k
 
 # padded shard rows get this coordinate value: any query's distance to the
 # sentinel row is ~1e36, so it can never enter a top-k
@@ -104,7 +103,7 @@ def build(
 
 @functools.lru_cache(maxsize=64)
 def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
-                    n_total, seed):
+                    n_total, seed, world=0):
     def body(shard, graph, queries):
         rows = shard.shape[1]
         rank = jax.lax.axis_index(axis)
@@ -119,11 +118,9 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
         bad = (gids < 0) | (gids >= n_total)
         vals = jnp.where(bad, jnp.inf, vals)
         gids = jnp.where(bad, -1, gids)
-        all_vals = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
-        all_ids = jax.lax.all_gather(gids, axis, axis=1, tiled=True)
-        out_v, out_i = select_k(all_vals, k, select_min=True,
-                                indices=all_ids)
-        return out_v, jnp.where(jnp.isinf(out_v), -1, out_i)
+        from raft_tpu.distributed._sharding import merge_shards
+
+        return merge_shards(vals, gids, k, axis, world)
 
     fn = jax.shard_map(
         body, mesh=mesh,
@@ -156,5 +153,5 @@ def search(
     fn = _make_search_fn(
         index.comms.mesh, index.comms.axis, int(k), itopk, width, max_iter,
         min_iter, int(max(1, params.num_random_samplings)), index.n_total,
-        int(params.seed))
+        int(params.seed), index.comms.size)
     return fn(index.dataset, index.graph, queries)

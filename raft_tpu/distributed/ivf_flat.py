@@ -19,7 +19,7 @@ addressable:
     from the per-list MAX length across shards (every shard runs the same
     grid — the padding this adds over per-shard plans is the shard-to-shard
     length variance, small under random row sharding), and one shard_map
-    runs the strip kernel on the local shard + all_gathers the (world·k)
+    runs the strip kernel on the local shard + butterfly-merges the (world·k)
     candidates + re-selects. Output is replicated.
 """
 

@@ -18,9 +18,10 @@ Division of labor:
     with no collective (the −2⟨q, R·c_l⟩ center term rides the merge's
     exact pair_const instead of the cache).
   * **Search**: identical strip-scan plan on every shard (per-list MAX fill
-    across shards), local scan, all_gather of (world·k) candidates, exact
-    re-select. Pipe through neighbors/refine (sharded refine: the candidate
-    ids are global) for the re-ranked headline configuration.
+    across shards), local scan, butterfly candidate merge (k·log2(world)
+    per-link bytes — _sharding.merge_shards). Pipe through
+    neighbors/refine (sharded refine: the candidate ids are global) for
+    the re-ranked headline configuration.
 """
 
 from __future__ import annotations

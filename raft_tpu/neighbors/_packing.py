@@ -13,6 +13,10 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+# nearest-alternative rounds the spill runs before its pressure valve
+# (round 3: one alternative was not enough — see _spill_core)
+_N_ALT = 4
+
 
 def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,
                pow2_chunks: bool = False) -> Tuple:
@@ -79,12 +83,31 @@ def spill_to_cap(work, centers, labels, metric: str, cap: int,
     counts = jnp.bincount(labels, length=n_lists)
     if int(jnp.max(counts + base)) <= cap:
         return labels
-    return _spill_core(work, centers, labels, metric, cap, base, counts, chunk)
+    out, n_residue = _spill_core(work, centers, labels, metric, cap, base,
+                                 counts, chunk)
+    n_res = int(n_residue)
+    if n_res > 0:
+        # ADVICE r4: the pressure valve places these rows irrespective of
+        # distance — essentially never probed for nearby queries. Surface
+        # the recall tradeoff at build time instead of hiding it in a
+        # comment.
+        from raft_tpu.core.logger import get_logger
+
+        get_logger().warning(
+            "spill_to_cap: %d row(s) exhausted all %d nearest alternative "
+            "lists and were packed into distant free slots (emptiest "
+            "first); these rows are unlikely to be probed by nearby "
+            "queries. Consider raising list_cap_factor or n_lists for "
+            "this data distribution.", n_res, min(_N_ALT, n_lists - 1))
+    return out
 
 
 def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
     """Jittable spill body (no host syncs) — usable inside shard_map
-    (distributed builds spill each shard in-SPMD)."""
+    (distributed builds spill each shard in-SPMD). Returns
+    ``(labels_out, n_residue)`` where n_residue counts rows the pressure
+    valve placed non-locally (distance-blind) — callers on the host path
+    surface it as a warning."""
     n = labels.shape[0]
     n_lists = centers.shape[0]
     # rank of each row within its cluster (arrival order, after the base)
@@ -100,7 +123,7 @@ def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
     from raft_tpu.ops import distance as dist_mod
     from raft_tpu.ops.select_k import select_k
 
-    n_alt = min(4, n_lists - 1)
+    n_alt = min(_N_ALT, n_lists - 1)
     if n_alt <= 0:
         return labels  # a single list has nowhere to spill
     alts = []
@@ -166,7 +189,7 @@ def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
     ok = remaining & (t_rank < cumfree[-1]) & (slot < n_lists)
     labels_out = jnp.where(
         ok, order_lists[jnp.clip(slot, 0, n_lists - 1)], labels_out)
-    return labels_out
+    return labels_out, jnp.sum(ok.astype(jnp.int32))
 
 
 def auto_group_size(n: int, n_lists: int, floor: int = 64) -> int:

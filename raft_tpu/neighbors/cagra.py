@@ -23,11 +23,37 @@ static shapes):
   unvisited entries, gathers their graph rows, computes distances with one
   batched einsum across the whole query batch (MXU-friendly: the per-query
   matvec becomes a (Q, w·deg, dim) batched contraction), and merges via
-  sort-based dedup (``merge_topk_dedup``) — the hashmap+bitonic-sort
+  compare-matrix dedup + a narrow top-k — the hashmap+bitonic-sort
   replacement. Termination: all itopk entries visited, or max_iterations.
 * The visited set is the buffer's per-slot flag (the single-CTA parent bit);
   a node evicted and later re-inserted may be re-expanded — a bounded waste
   the GPU hashmap avoids, accepted here to keep shapes static.
+
+**Round-5 compressed traversal** (the production path at scale; the
+reference's CAGRA-Q compressed-dataset search is the analog,
+cagra_types.hpp's int8/uint8 dataset + vpq compression):
+
+XLA row gathers on this hardware are op-bound (~12 ns/row regardless of
+row width or dtype), so the exact loop's q·w·deg per-iteration
+neighbor-vector gathers — not FLOPs or HBM bytes — are the entire cost.
+The round-5 layout makes the gather count per iteration q·w instead:
+
+* each node's record inlines its neighbors' vectors, compressed to
+  ``compress_dim``-d int8 via a random orthonormal projection
+  (``nbr_codes[i, j] = quantize(proj(X[graph[i, j]]))``) — one contiguous
+  per-parent fetch yields all deg candidate vectors, 64× fewer gather ops
+  at graph_degree 64;
+* traversal distances are computed from the codes on the MXU
+  (projected-space ranking only); the final answer is exactly re-ranked
+  over the itopk buffer against the raw dataset — the same
+  compressed-search + refine split as CAGRA-Q;
+* seeding is centroid-guided: one (q, n_centroids) MXU gemm against the
+  build-time coarse centroids picks per-query entry points (their stored
+  nearest-dataset-row representatives), replacing random seeds and their
+  gather storm — fewer iterations to reach the query's neighborhood;
+* the itopk merge runs on the mantissa-packed iter select
+  (ops/select_k.iter_topk_min_packed) — 2 VPU ops per pass over a
+  (q, itopk + w·deg) row instead of lax.top_k's full sort.
 """
 
 from __future__ import annotations
@@ -80,6 +106,15 @@ class CagraParams:
     # candidate recall is lower
     graph_refine_iters: int = -1
     graph_refine_sample: int = 448
+    # compressed-traversal payload (round 5, the CAGRA-Q analog): inline
+    # each node's neighbors as compress_dim-d int8 codes so search gathers
+    # one record per expanded parent instead of one row per neighbor.
+    # "auto" = on above compress_threshold rows (the payload costs
+    # n·graph_degree·compress_dim bytes of HBM — worth it exactly when the
+    # gather count dominates, i.e. at scale).
+    compress: str = "auto"  # "auto" | "on" | "off"
+    compress_dim: int = 0  # 0 = auto: min(64, dim)
+    compress_threshold: int = 200_000
     seed: int = 0
 
     def __post_init__(self):
@@ -89,6 +124,8 @@ class CagraParams:
             raise ValueError("intermediate_graph_degree < graph_degree")
         if self.build_algo not in ("auto", "ivf_pq", "nn_descent", "brute"):
             raise ValueError(f"unknown build_algo {self.build_algo!r}")
+        if self.compress not in ("auto", "on", "off"):
+            raise ValueError(f"unknown compress mode {self.compress!r}")
 
 
 @dataclass(frozen=True)
@@ -100,21 +137,49 @@ class CagraSearchParams:
     min_iterations: int = 0
     search_width: int = 1
     num_random_samplings: int = 1
+    # "auto" rides the compressed (inlined-int8-codes) loop whenever the
+    # index carries the payload; "exact" forces full-precision traversal
+    # (the pre-round-5 loop); "compressed" errors if the payload is absent
+    traversal: str = "auto"  # "auto" | "compressed" | "exact"
+    # exact re-rank depth for the compressed loop: the final answer ranks
+    # the best refine_topk buffer entries against the raw dataset
+    # (0 = the whole itopk buffer — safest; shrink to trade a little
+    # recall for q·refine_topk fewer exit gathers)
+    refine_topk: int = 0
     seed: int = 0
 
     def __post_init__(self):
         if self.itopk_size <= 0 or self.search_width <= 0:
             raise ValueError("itopk_size and search_width must be positive")
+        if self.traversal not in ("auto", "compressed", "exact"):
+            raise ValueError(f"unknown traversal mode {self.traversal!r}")
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class CagraIndex:
-    """Graph index: dataset + fixed-degree kNN graph (cagra_types.hpp:55-134)."""
+    """Graph index: dataset + fixed-degree kNN graph (cagra_types.hpp:55-134).
 
-    dataset: jax.Array  # (n, dim) fp32
+    The optional round-5 fields carry the compressed-traversal payload
+    (None on indexes built with ``compress="off"`` or loaded from pre-r5
+    files — those search via the exact loop):
+
+    * ``proj``/``code_scale``: the (dim, p) random orthonormal projection
+      and int8 quantization scale;
+    * ``nbr_codes``: (n, graph_degree, p) int8 — node i's record inlines
+      the projected codes of all its graph neighbors;
+    * ``centroids``/``centroid_reps``: coarse centers from the IVF builder
+      + each center's nearest dataset row, for guided seeding.
+    """
+
+    dataset: jax.Array  # (n, dim) fp32 (or uint8/int8 for integer inputs)
     graph: jax.Array  # (n, graph_degree) int32 neighbor ids
     norms: jax.Array  # (n,) squared L2 norms
+    proj: Optional[jax.Array] = None  # (dim, p) fp32
+    code_scale: Optional[jax.Array] = None  # () fp32
+    nbr_codes: Optional[jax.Array] = None  # (n, graph_degree, p) int8
+    centroids: Optional[jax.Array] = None  # (c, dim) fp32
+    centroid_reps: Optional[jax.Array] = None  # (c,) int32
 
     @property
     def size(self) -> int:
@@ -129,7 +194,9 @@ class CagraIndex:
         return self.graph.shape[1]
 
     def tree_flatten(self):
-        return (self.dataset, self.graph, self.norms), None
+        return (self.dataset, self.graph, self.norms, self.proj,
+                self.code_scale, self.nbr_codes, self.centroids,
+                self.centroid_reps), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -137,21 +204,31 @@ class CagraIndex:
 
     # -- persistence (cagra_serialize.cuh analog) ---------------------------
     def save(self, path) -> None:
-        save_arrays(
-            path,
-            {"kind": "cagra", "metric": "sqeuclidean"},
-            {"dataset": self.dataset, "graph": self.graph, "norms": self.norms},
-        )
+        arrays = {"dataset": self.dataset, "graph": self.graph,
+                  "norms": self.norms}
+        for name in ("proj", "code_scale", "nbr_codes", "centroids",
+                     "centroid_reps"):
+            v = getattr(self, name)
+            if v is not None:
+                arrays[name] = v
+        save_arrays(path, {"kind": "cagra", "metric": "sqeuclidean"}, arrays)
 
     @classmethod
     def load(cls, path) -> "CagraIndex":
         meta, arrays = load_arrays(path)
         if meta.get("kind") != "cagra":
             raise ValueError(f"not a cagra index: {meta.get('kind')}")
+        opt = {
+            name: jnp.asarray(arrays[name])
+            for name in ("proj", "code_scale", "nbr_codes", "centroids",
+                         "centroid_reps")
+            if name in arrays
+        }
         return cls(
             jnp.asarray(arrays["dataset"]),
             jnp.asarray(arrays["graph"]),
             jnp.asarray(arrays["norms"]),
+            **opt,
         )
 
 
@@ -264,7 +341,7 @@ def _flat_builder_fits(n: int, dim: int) -> bool:
     return n * dim * 4 <= (2 << 30)
 
 
-def _build_knn_ivf_pq(X, ideg: int, params: "CagraParams", res) -> jax.Array:
+def _build_knn_ivf_pq(X, ideg: int, params: "CagraParams", res):
     """Intermediate kNN graph via an IVF candidate search — the reference's
     scalable builder (cagra_build.cuh:87 build_knn_graph: ivf_pq::build,
     batched ivf_pq::search over the dataset itself, refine at
@@ -322,7 +399,9 @@ def _build_knn_ivf_pq(X, ideg: int, params: "CagraParams", res) -> jax.Array:
             _, cand = pqm.search(idx, qb, kf, n_probes=n_probes, res=res)
             _, ids = refm.refine(X, qb, cand, min(ideg + 1, kf), res=res)
             out.append(_drop_self(ids, s, ideg))
-    return jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    graph = jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    # the coarse centers double as the search's guided-seeding table
+    return graph, idx.centers
 
 
 @functools.partial(jax.jit, static_argnames=("sample", "block"))
@@ -411,6 +490,7 @@ def build(
     if algo == "auto":
         algo = "brute" if n <= params.brute_threshold else "ivf_pq"
 
+    centroids = None
     if algo == "brute" or n <= 2048:
         # exact graph for small datasets: one tiled MXU pass beats training
         # an IVF index at this scale
@@ -419,7 +499,7 @@ def build(
         _, ids = knn(X, X, ideg + 1, metric="sqeuclidean", res=res)
         graph = _drop_self(ids, 0, ideg)
     elif algo == "ivf_pq":
-        graph = _build_knn_ivf_pq(X, ideg, params, res)
+        graph, centroids = _build_knn_ivf_pq(X, ideg, params, res)
         sweeps = params.graph_refine_iters
         if sweeps < 0:  # auto: the flat candidate scan is already ~exact
             sweeps = 0 if _flat_builder_fits(n, dim) else 2
@@ -452,7 +532,71 @@ def build(
     store = jnp.asarray(dataset)
     if not jnp.issubdtype(store.dtype, jnp.integer):
         store = X
-    return CagraIndex(store, pruned, norms)
+
+    compress = params.compress == "on" or (
+        params.compress == "auto" and n >= params.compress_threshold)
+    if not compress:
+        return CagraIndex(store, pruned, norms)
+    return _attach_compression(
+        CagraIndex(store, pruned, norms), X, params, centroids, res)
+
+
+def _attach_compression(index: CagraIndex, X, params: CagraParams,
+                        centroids, res) -> CagraIndex:
+    """Build the round-5 compressed-traversal payload: a random orthonormal
+    projection to ``compress_dim``, per-node inlined neighbor codes, and the
+    centroid seeding table (computing centers with a quick balanced k-means
+    when the builder didn't produce any)."""
+    n, dim = X.shape
+    p = int(params.compress_dim) or min(64, dim)
+    p = min(p, dim)
+    key = jax.random.key(params.seed ^ 0xC0DE)
+    # QR of a Gaussian → orthonormal columns: inner products are preserved
+    # in expectation scaled by p/dim (Johnson–Lindenstrauss; ranking-only
+    # use, the exit re-rank is exact)
+    g = jax.random.normal(key, (dim, p), jnp.float32)
+    proj, _ = jnp.linalg.qr(g)
+    # seeding table first: its brute kNN runs with a workspace-sized score
+    # tile, and doing it BEFORE the n·deg·p code payload exists keeps the
+    # two HBM spikes from stacking (1M×128/deg=64/p=64 peaked out a 16 GB
+    # chip otherwise)
+    reps = None
+    if centroids is None and n > 4 * 1024:
+        from raft_tpu.cluster import kmeans_balanced
+
+        c = int(max(16, min(1024, n // 256)))
+        frac = float(min(1.0, max(0.05, 100_000 / n)))
+        # with-replacement draw: choice(replace=False) compiles an
+        # O(n log n) permutation (the round-3 kmeans_balanced finding);
+        # duplicate trainset rows are harmless to k-means
+        rows = (jax.random.randint(jax.random.key(params.seed ^ 0x5EED5),
+                                   (int(frac * n),), 0, n)
+                if frac < 1.0 else slice(None))
+        centroids = kmeans_balanced.fit(
+            X[rows], c, kmeans_balanced.KMeansBalancedParams(), res=res)
+    if centroids is not None:
+        from raft_tpu.neighbors.brute_force import knn
+
+        _, rep_ids = knn(centroids, X, 1, metric="sqeuclidean", res=res)
+        reps = rep_ids[:, 0].astype(jnp.int32)
+
+    xp = X @ proj  # (n, p)
+    scale = jnp.maximum(jnp.max(jnp.abs(xp)) / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(xp / scale), -127, 127).astype(jnp.int8)
+    del xp
+    # inline the neighbors' codes in row blocks (one big gather would hold
+    # gather temporaries on top of the 4 GB output at 1M×64×64)
+    blk = int(max(65536, res.workspace_bytes
+                  // max(index.graph_degree * p * 2, 1)))
+    parts = []
+    for s in range(0, n, blk):
+        gb = index.graph[s:s + blk]
+        nc = codes[jnp.maximum(gb, 0)]
+        parts.append(jnp.where(gb[..., None] >= 0, nc, jnp.int8(0)))
+    nbr_codes = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+    return CagraIndex(index.dataset, index.graph, index.norms,
+                      proj=proj, code_scale=scale, nbr_codes=nbr_codes,
+                      centroids=centroids, centroid_reps=reps)
 
 
 def build_from_graph(dataset, graph) -> CagraIndex:
@@ -599,6 +743,176 @@ def _search_impl(
     return out_d, out_ids
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "n_rand",
+                     "refine_topk"),
+)
+def _search_impl_compressed(
+    dataset, graph, nbr_codes, proj, code_scale, centroids, reps,
+    queries, key, filter_bits, n_bits,
+    k, itopk, width, max_iter, min_iter, n_rand, refine_topk,
+):
+    """Round-5 traversal over inlined neighbor codes (module docstring).
+
+    Cost shape per iteration at (q, w, deg, p): q·w graph-row gathers +
+    q·w code-record gathers (the ONLY per-row-op-bound work — the exact
+    loop paid q·w·deg), one (q, w·deg, p) int8→bf16 MXU contraction, a
+    compare-matrix dedup, and a mantissa-packed itopk select over
+    itopk + w·deg entries. Distances are projected-space ranking scores;
+    the exit re-ranks the best ``refine_topk`` buffer entries exactly.
+    """
+    from raft_tpu.ops.select_k import iter_topk_min_packed
+
+    n, dim = dataset.shape
+    q = queries.shape[0]
+    deg = graph.shape[1]
+    p = proj.shape[1]
+    b = width * deg
+    qf = queries.astype(jnp.float32)
+    qp = (qf @ proj) / code_scale  # query in code units
+    inf = jnp.float32(jnp.inf)
+    iota_itopk = jnp.arange(itopk, dtype=jnp.int32)
+
+    def code_dists(codes, ids):
+        """(q, m) projected ranking scores ‖c‖² − 2⟨qp, c⟩ from int8 codes
+        (query-norm term dropped: constant per query)."""
+        cf = codes.astype(jnp.bfloat16)
+        ip = jnp.einsum("qmp,qp->qm", cf, qp.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+        nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
+                         preferred_element_type=jnp.float32)
+        return jnp.where(ids >= 0, nrm - 2.0 * ip, inf)
+
+    def merge(bids, bd, bvis, cids, cd):
+        """Buffer ∪ candidates → new (ids, d, vis): compare-matrix dedup +
+        one packed-iter select (2 VPU ops/pass — ADVICE r4 cagra.py:536:
+        candidate-side dups are masked pre-select for every width, so
+        duplicate copies can no longer occupy itopk slots)."""
+        dup_buf = jnp.any(cids[:, :, None] == bids[:, None, :], axis=2)
+        # within-candidate dedup, linear-ish: mask any candidate equal to an
+        # earlier candidate. (q, b, b) bool compares are VPU-cheap up to
+        # b=512; beyond that fall back to post-select masking + re-select.
+        bb = cids.shape[1]
+        if bb <= 512:
+            eq = cids[:, :, None] == cids[:, None, :]
+            tri = jnp.tril(jnp.ones((bb, bb), jnp.bool_), k=-1)
+            dup_self = jnp.any(eq & tri[None], axis=2)
+            cd = jnp.where(dup_buf | dup_self | (cids < 0), inf, cd)
+        else:
+            cd = jnp.where(dup_buf | (cids < 0), inf, cd)
+        allv = jnp.concatenate([bd, cd], axis=1)
+        alli = jnp.concatenate([bids, cids], axis=1)
+        allvis = jnp.concatenate(
+            [bvis, jnp.zeros(cids.shape, jnp.bool_)], axis=1)
+        sel_slack = 0 if bb <= 512 else max(8, itopk // 4)
+        nv, sel = iter_topk_min_packed(allv, itopk + sel_slack)
+        ni = jnp.take_along_axis(alli, sel, axis=1)
+        nvis = jnp.take_along_axis(allvis, sel, axis=1)
+        if sel_slack:
+            # wide case: drop later duplicate copies among the survivors,
+            # then compact back to itopk with one narrow re-select
+            w2 = itopk + sel_slack
+            dup = jnp.any(
+                (ni[:, :, None] == ni[:, None, :])
+                & (jnp.arange(w2)[None, None, :]
+                   < jnp.arange(w2)[None, :, None]), axis=2)
+            nv = jnp.where(dup, inf, nv)
+            nv2, sel2 = iter_topk_min_packed(nv, itopk)
+            ni = jnp.take_along_axis(ni, sel2, axis=1)
+            nvis = jnp.take_along_axis(nvis, sel2, axis=1)
+            nv = nv2
+        ni = jnp.where(jnp.isinf(nv), -1, ni)
+        return ni, nv, nvis
+
+    # ---- seeds ------------------------------------------------------------
+    if centroids is not None:
+        # guided: one (q, c) MXU gemm, zero gathers. Centroid distances live
+        # in the FULL space; scale by p/dim (the projection's expected
+        # contraction) and shift into the buffer's code-unit convention
+        # (‖·‖² − 2⟨qp,·⟩ == (proj dist − ‖qp·s‖²)/s²) so seed scores merge
+        # monotonically with code scores.
+        c = centroids.shape[0]
+        cd_full = (jnp.sum(centroids * centroids, axis=1)[None, :]
+                   - 2.0 * qf @ centroids.T)  # + ‖q‖², constant, dropped
+        n_seed = min(itopk, c)
+        s2 = code_scale * code_scale
+        qp_n = jnp.sum(qp * qp, axis=1)
+        cd_code = (cd_full * (p / dim)) / s2 + (
+            jnp.sum(qf * qf, axis=1) * (p / dim) / s2 - qp_n)[:, None]
+        sv, spos = iter_topk_min_packed(cd_code, n_seed)
+        seed_ids = reps[spos].astype(jnp.int32)
+        seed_d = sv
+    else:
+        # random seeding (num_random_samplings analog): gather raw rows,
+        # project on the fly
+        n_seed = min(itopk * n_rand, n)
+        seed_ids = jax.random.randint(key, (q, n_seed), 0, n,
+                                      dtype=jnp.int32)
+        xv = dataset[jnp.maximum(seed_ids, 0)].astype(jnp.float32)
+        xp = jnp.einsum("qmd,dp->qmp", xv, proj,
+                        preferred_element_type=jnp.float32) / code_scale
+        seed_d = jnp.sum(xp * xp, axis=2) - 2.0 * jnp.einsum(
+            "qmp,qp->qm", xp, qp, preferred_element_type=jnp.float32)
+
+    buf_ids, buf_d, buf_vis = merge(
+        jnp.full((q, itopk), -1, jnp.int32),
+        jnp.full((q, itopk), inf, jnp.float32),
+        jnp.ones((q, itopk), jnp.bool_),
+        seed_ids, seed_d,
+    )
+
+    def cond(state):
+        ids_b, _, vis, it = state
+        frontier_open = jnp.any(~vis & (ids_b >= 0))
+        return (it < max_iter) & (frontier_open | (it < min_iter))
+
+    def body(state):
+        ids_b, d_b, vis, it = state
+        from raft_tpu.ops.select_k import iter_topk_min_packed as topk_p
+
+        pkey = jnp.where(vis | (ids_b < 0), inf, d_b)
+        pv, ppos = topk_p(pkey, width)
+        parent_ids = jnp.take_along_axis(ids_b, ppos, axis=1)  # (q, w)
+        parent_ok = ~jnp.isinf(pv)
+        vis = vis | jnp.any(
+            iota_itopk[None, None, :] == ppos[:, :, None], axis=1)
+        pid_c = jnp.maximum(parent_ids, 0)
+        gr = graph[pid_c]  # (q, w, deg) — q·w row gathers
+        codes = nbr_codes[pid_c]  # (q, w, deg, p) — q·w record gathers
+        nbrs = jnp.where(parent_ok[:, :, None] & (gr >= 0), gr, -1)
+        nbrs = nbrs.reshape(q, b)
+        nd = code_dists(codes.reshape(q, b, p), nbrs)
+        ids2, d2, vis2 = merge(ids_b, d_b, vis, nbrs, nd)
+        return ids2, d2, vis2, it + 1
+
+    buf_ids, buf_d, _, _ = lax.while_loop(
+        cond, body, (buf_ids, buf_d, buf_vis, jnp.int32(0))
+    )
+
+    # ---- exit: exact re-rank of the buffer head against the raw dataset ---
+    # (the CAGRA-Q refinement step; buffer is ascending post-merge, so the
+    # head IS the best refine_topk candidates)
+    rt = refine_topk
+    r_ids = buf_ids[:, :rt]
+    xv = dataset[jnp.maximum(r_ids, 0)].astype(jnp.float32)  # (q, rt, dim)
+    ip = jnp.einsum("qmd,qd->qm", xv, qf, preferred_element_type=jnp.float32)
+    d_exact = jnp.sum(xv * xv, axis=2) - 2.0 * ip
+    d_exact = jnp.where(r_ids >= 0, d_exact, inf)
+    if filter_bits is not None:
+        allowed = Bitset(filter_bits, n_bits).test(r_ids)
+        d_exact = jnp.where(allowed, d_exact, inf)
+    from raft_tpu.ops.select_k import iter_topk_min
+
+    out_d, sel = iter_topk_min(d_exact, k)
+    out_ids = jnp.take_along_axis(r_ids, sel, axis=1)
+    qn = jnp.sum(qf * qf, axis=1)
+    out_ids = jnp.where(jnp.isinf(out_d), -1, out_ids)
+    out_d = jnp.where(jnp.isinf(out_d), inf,
+                      jnp.maximum(out_d + qn[:, None], 0.0))
+    return out_d, out_ids
+
+
 @traced("cagra::search")
 def search(
     index: CagraIndex,
@@ -629,17 +943,59 @@ def search(
     max_iter = int(params.max_iterations) or max(16, itopk // width)
     min_iter = int(min(params.min_iterations, max_iter))
     key = jax.random.key(params.seed)
-    return _search_impl(
-        index.dataset,
-        index.graph,
-        queries,
-        key,
-        filter.bits if filter is not None else None,
-        index.size,
-        int(k),
-        itopk,
-        width,
-        max_iter,
-        min_iter,
-        int(max(1, params.num_random_samplings)),
-    )
+    mode = params.traversal
+    if mode == "auto":
+        mode = "compressed" if index.nbr_codes is not None else "exact"
+    elif mode == "compressed" and index.nbr_codes is None:
+        raise ValueError(
+            "traversal='compressed' needs an index built with the "
+            "compression payload (CagraParams.compress)")
+
+    # query tiling: one traversal's live set is ~per_q bytes/query (the
+    # (b, b) dedup compares + gathered codes/vectors + merge passes);
+    # un-tiled q=10k runs RESOURCE_EXHAUST a 16 GB chip. Tiles dispatch
+    # back-to-back (no host sync between them), so the loop costs no
+    # dispatch-amortization at large q.
+    b = width * index.graph_degree
+    p = index.proj.shape[1] if index.proj is not None else index.dim
+    if mode == "compressed":
+        per_q = b * b + 4 * b * p + 8 * (itopk + b) + 4 * itopk * index.dim
+    else:
+        per_q = b * b + 6 * b * index.dim + 8 * (itopk + b)
+    nq = queries.shape[0]
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32), jnp.zeros((0, k), jnp.int32))
+    q_tile = int(max(256, min(nq, res.workspace_bytes // max(per_q, 1))))
+    n_tiles = ceil_div(nq, q_tile)
+    q_tile = ceil_div(nq, n_tiles)  # equalize; pad the tail tile below so
+    # every dispatch shares ONE compiled shape
+
+    fb = filter.bits if filter is not None else None
+    outs = []
+    for ti, s in enumerate(range(0, nq, q_tile)):
+        qs = queries[s:s + q_tile]
+        if qs.shape[0] < q_tile:
+            qs = jnp.pad(qs, ((0, q_tile - qs.shape[0]), (0, 0)))
+        tkey = jax.random.fold_in(key, ti) if ti else key
+        if mode == "compressed":
+            rt = int(params.refine_topk) or itopk
+            if not k <= rt <= itopk:
+                raise ValueError(
+                    f"refine_topk={rt} must be in [k={k}, itopk={itopk}]")
+            outs.append(_search_impl_compressed(
+                index.dataset, index.graph, index.nbr_codes, index.proj,
+                index.code_scale, index.centroids, index.centroid_reps,
+                qs, tkey, fb, index.size,
+                int(k), itopk, width, max_iter, min_iter,
+                int(max(1, params.num_random_samplings)), rt,
+            ))
+        else:
+            outs.append(_search_impl(
+                index.dataset, index.graph, qs, tkey, fb, index.size,
+                int(k), itopk, width, max_iter, min_iter,
+                int(max(1, params.num_random_samplings)),
+            ))
+    if len(outs) == 1:
+        return outs[0]
+    return (jnp.concatenate([o[0] for o in outs], axis=0)[:nq],
+            jnp.concatenate([o[1] for o in outs], axis=0)[:nq])

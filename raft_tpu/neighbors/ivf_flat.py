@@ -369,10 +369,14 @@ def _ragged_fused(queries, centers, list_data, bias, list_ids, cls_ord,
     an index probing 3% of the data lost to brute force at 1M rows)."""
     from raft_tpu.ops.strip_scan import strip_search_traced
 
-    # "exact" probe selection rides the packed iter (half the VPU passes;
-    # ≤1e-4 relative coarse-distance perturbation only reorders lists whose
-    # boundary contribution is itself a tie — recall-neutral, measured)
-    sa = "packed" if select_algo == "exact" and not interpret else select_algo
+    # "exact" probe selection rides the packed iter (half the VPU passes)
+    # only while n_lists keeps the index bits cheap: the perturbation is
+    # 2^-(23-ceil(log2 n_lists)) relative — ≤ 5e-4 at 4096 lists, where it
+    # only reorders boundary-tie lists (recall-neutral, measured). Larger
+    # n_lists would steal real mantissa (ADVICE r4 medium), so "exact" is
+    # honored literally there.
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
     probes = _coarse_probes(queries, centers, n_probes, metric, sa,
                             compute_dtype)
     l2 = metric in ("sqeuclidean", "euclidean")

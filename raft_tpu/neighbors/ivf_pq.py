@@ -743,7 +743,11 @@ def _ragged_fused_pq(queries, centers, rotation, b_sum, list_ids, decoded,
     neighbors/refine, which absorbs its ~1e-4/row bin-collision loss."""
     from raft_tpu.ops.strip_scan import strip_search_traced
 
-    sa = "packed" if select_algo == "exact" and not interpret else select_algo
+    # packed coarse select only while its perturbation bound stays tight
+    # (2^-(23-ceil(log2 n_lists)) ≤ 5e-4 at 4096 lists; ADVICE r4 medium —
+    # see ivf_flat._ragged_fused)
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
     probes, qr_scaled, bias, pair_const = _pq_search_prep(
         queries, centers, rotation, b_sum, list_ids, decoded_scale,
         filter, n_probes, metric, sa, compute_dtype, l2,

@@ -175,7 +175,9 @@ def select_k(
 
     ``algo``: "exact" (lax.top_k) | "iter" (k masked-min passes; exact,
     the fast TPU route for small k) | "packed" (mantissa-packed iter —
-    half the passes' cost, values perturbed ≤ ~1e-4 relative) | "approx"
+    half the passes' cost, values perturbed ≤ 2^-(23-ceil(log2 n))
+    relative — ~1e-4 at n=1024, ~1e-3 at the n=8192 fallback bound) |
+    "approx"
     (TPU partial-reduce; ``recall_target`` trades recall for speed).
     "exact" auto-routes to "iter" for k <= 64 on TPU — same results,
     ~10x faster.
@@ -198,9 +200,12 @@ def select_k(
     if (algo in ("iter", "packed")
             and not jnp.issubdtype(values.dtype, jnp.floating)):
         algo = "exact"  # the inf mask needs a floating dtype
-    if algo == "packed" and values.shape[-1] > (1 << 16):
-        # packing always happens in fp32 regardless of input dtype: past
-        # 16 index bits too few mantissa bits remain for the values
+    if algo == "packed" and values.shape[-1] > (1 << 13):
+        # packing always happens in fp32 regardless of input dtype, and the
+        # perturbation is 2^-(23-ceil(log2 n)) relative: 13 index bits keep
+        # it ≤ ~1e-3; wider rows would steal 14-16 mantissa bits (~1e-2
+        # worst case — inconsistent with the documented contract, ADVICE
+        # r4), so they fall back to the exact iter select
         algo = "iter"
     vals, idx = _select_k_impl(values, int(k), bool(select_min), algo, float(recall_target))
     if indices is not None:

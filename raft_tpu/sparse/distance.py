@@ -121,12 +121,22 @@ def pairwise_distance(
     (superset of the reference's sparse metric list,
     sparse/distance/distance.cuh).
 
-    ``backend``: "dense" (densify-by-tiles + MXU — every metric; the
-    measured winner on TPU at every sparsity tested, see
-    results/SPARSE_r04.json), "expand" (nnz-expansion over a padded ELL
-    layout — the coo_spmv analog; l2/ip/cosine only, kept for API parity
-    and shapes where gathers beat redundant FLOPs), or "auto" (currently
-    = dense).
+    ``backend``:
+
+    * ``"auto"`` — ALWAYS the dense route. This is a decided, measured
+      policy, not a heuristic that might pick "expand".
+    * ``"dense"`` — densify-by-tiles + MXU; every metric. The measured
+      winner on TPU at every sparsity tested, down to 99.8% sparse at
+      (2048² × 16384) — see results/SPARSE_r04.json.
+    * ``"expand"`` — nnz-expansion over a padded ELL layout (the coo_spmv
+      analog; l2/ip/cosine only). **Oracle / API-parity only — measured
+      SLOWER than dense at every tested shape and sparsity (0.04–0.33×)**,
+      and the loss is bandwidth-fundamental on this hardware: the gathered
+      (rows, nnz_width, ny) block round-trips HBM, which costs as much
+      memory traffic as the dense pass costs MXU FLOPs, and per-row
+      gathers are op-bound (~12 ns/row) besides. Kept as an independent
+      correctness oracle for the dense path and as the slot where a host
+      (CPU) offload variant would plug in; do not use it for performance.
     """
     res = res or current_resources()
     y = x if y is None else y

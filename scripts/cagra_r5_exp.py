@@ -1,0 +1,108 @@
+"""Round-5 CAGRA experiment driver: build once at 1M, sweep search configs.
+
+Writes one JSON line per measurement so partial runs still yield data.
+Usage: python scripts/cagra_r5_exp.py [out_log]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+out_path = sys.argv[1] if len(sys.argv) > 1 else "results/cagra_r5_exp.jsonl"
+out = open(out_path, "a", buffering=1)
+
+
+def emit(**kw):
+    line = json.dumps(kw)
+    print(line, flush=True)
+    out.write(line + "\n")
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from raft_tpu import stats
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.neighbors import brute_force, cagra
+
+N, DIM, Q, K = 1_000_000, 128, 10_000, 10
+
+t0 = time.perf_counter()
+data_u8, queries_u8 = sift_like(N, DIM, Q)
+dataset = jnp.asarray(data_u8, jnp.float32)
+queries = jnp.asarray(queries_u8, jnp.float32)
+jax.block_until_ready(dataset)
+emit(stage="data", s=round(time.perf_counter() - t0, 1))
+
+t0 = time.perf_counter()
+bf = brute_force.build(dataset, metric="sqeuclidean")
+gt_vals, gt_ids = brute_force.search(bf, queries, K, select_algo="exact")
+jax.block_until_ready(gt_vals)
+emit(stage="gt", s=round(time.perf_counter() - t0, 1))
+del bf, dataset  # keep HBM headroom: the index stores the uint8 dataset
+
+t0 = time.perf_counter()
+idx = cagra.build(jnp.asarray(data_u8), cagra.CagraParams(
+    intermediate_graph_degree=128, graph_degree=64, build_algo="auto"))
+jax.block_until_ready(idx.graph)
+if idx.nbr_codes is not None:
+    jax.block_until_ready(idx.nbr_codes)
+build_s = round(time.perf_counter() - t0, 1)
+emit(stage="build", s=build_s,
+     compressed=idx.nbr_codes is not None,
+     centroids=None if idx.centroids is None else int(idx.centroids.shape[0]))
+
+
+def timed_search(sp, reps=5):
+    cv, ci = cagra.search(idx, queries, K, sp)
+    jax.block_until_ready(cv)  # compile+warm
+    rec = float(stats.neighborhood_recall(ci, gt_ids, cv, gt_vals))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cv, ci = cagra.search(idx, queries, K, sp)
+    jax.block_until_ready(cv)
+    dt = (time.perf_counter() - t0) / reps
+    return Q / dt, rec
+
+
+configs = [
+    # (itopk, width, refine_topk, traversal)
+    (64, 4, 0, "auto"),
+    (64, 8, 0, "auto"),
+    (64, 2, 0, "auto"),
+    (96, 8, 0, "auto"),
+    (32, 4, 0, "auto"),
+    (64, 4, 32, "auto"),
+    (128, 8, 0, "auto"),
+    (64, 16, 0, "auto"),
+]
+for itopk, w, rt, trav in configs:
+    sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
+                                 refine_topk=rt, traversal=trav)
+    try:
+        t0 = time.perf_counter()
+        qps, rec = timed_search(sp)
+        emit(itopk=itopk, width=w, rt=rt, trav=trav,
+             qps=round(qps, 1), recall=round(rec, 4),
+             wall_s=round(time.perf_counter() - t0, 1))
+    except Exception as e:
+        emit(itopk=itopk, width=w, rt=rt, trav=trav, error=repr(e)[:200])
+
+# exact traversal baseline at the round-4 operating point
+sp = cagra.CagraSearchParams(itopk_size=64, search_width=4, traversal="exact")
+try:
+    t0 = time.perf_counter()
+    qps, rec = timed_search(sp, reps=2)
+    emit(itopk=64, width=4, trav="exact", qps=round(qps, 1),
+         recall=round(rec, 4), wall_s=round(time.perf_counter() - t0, 1))
+except Exception as e:
+    emit(trav="exact", error=repr(e)[:200])
+
+emit(stage="done")

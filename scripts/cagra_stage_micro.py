@@ -1,0 +1,148 @@
+"""Per-stage microbench of the compressed CAGRA traversal body on TPU.
+
+Synthetic tensors at production shapes — timing is shape-dependent only.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+N, DIM, P_, DEG = 1_000_000, 128, 64, 64
+Q = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+W = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+ITOPK = 64
+B = W * DEG
+R = 50
+
+key = jax.random.key(0)
+ks = jax.random.split(key, 10)
+# tile a small random block to production size: gather/compute timing only
+# depends on shapes, and generating 4G random elements stalls for minutes
+blk = jax.random.randint(ks[0], (8192, DEG, P_), -127, 127, jnp.int8)
+nbr_codes = jnp.tile(blk, (N // 8192, 1, 1))
+graph = jax.random.randint(ks[1], (N, DEG), 0, N, jnp.int32)
+qp = jax.random.normal(ks[2], (Q, P_), jnp.float32)
+buf_ids = jax.random.randint(ks[3], (Q, ITOPK), 0, N, jnp.int32)
+buf_d = jax.random.uniform(ks[4], (Q, ITOPK))
+vis = jnp.zeros((Q, ITOPK), jnp.bool_)
+pids = jax.random.randint(ks[5], (Q, W), 0, N, jnp.int32)
+cand_ids = jax.random.randint(ks[6], (Q, B), 0, N, jnp.int32)
+cand_d = jax.random.uniform(ks[7], (Q, B))
+codes_g = jax.random.randint(ks[8], (Q, B, P_), -127, 127, jnp.int8)
+jax.block_until_ready(nbr_codes)
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(R):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / R * 1000
+    print(f"{name:34s} {dt:8.3f} ms", flush=True)
+    return dt
+
+
+timeit("graph_gather (q,w) rows", lambda p: graph[p], pids)
+timeit("codes_gather (q,w) recs 4KB", lambda p: nbr_codes[p], pids)
+timeit("codes_gather2d flat (q,w) rows",
+       lambda p: nbr_codes.reshape(N, DEG * P_)[p], pids)
+timeit("dataset-style gather (q,b) rows",
+       lambda c: nbr_codes.reshape(N, DEG * P_)[:, :DIM][c], cand_ids)
+
+
+def dists_bf16(codes, q):
+    cf = codes.astype(jnp.bfloat16)
+    ip = jnp.einsum("qmp,qp->qm", cf, q.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+    nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
+                     preferred_element_type=jnp.float32)
+    return nrm - 2.0 * ip
+
+
+timeit("code_dists bf16 (q,b,p)", dists_bf16, codes_g, qp)
+
+
+def dup_buf_fn(c, b):
+    return jnp.any(c[:, :, None] == b[:, None, :], axis=2)
+
+
+timeit("dup_buf (q,b,itopk)", dup_buf_fn, cand_ids, buf_ids)
+
+
+def dup_self_fn(c):
+    eq = c[:, :, None] == c[:, None, :]
+    tri = jnp.tril(jnp.ones((B, B), jnp.bool_), k=-1)
+    return jnp.any(eq & tri[None], axis=2)
+
+
+timeit("dup_self (q,b,b)", dup_self_fn, cand_ids)
+
+
+def merge_packed(bd, cd, bi, ci, bv):
+    from raft_tpu.ops.select_k import iter_topk_min_packed
+
+    allv = jnp.concatenate([bd, cd], axis=1)
+    alli = jnp.concatenate([bi, ci], axis=1)
+    allvis = jnp.concatenate([bv, jnp.zeros(ci.shape, jnp.bool_)], axis=1)
+    nv, sel = iter_topk_min_packed(allv, ITOPK)
+    return (jnp.take_along_axis(alli, sel, axis=1), nv,
+            jnp.take_along_axis(allvis, sel, axis=1))
+
+
+timeit("merge packed select 320->64", merge_packed,
+       buf_d, cand_d, buf_ids, cand_ids, vis)
+
+
+def merge_topk(bd, cd, bi, ci, bv):
+    allv = jnp.concatenate([bd, cd], axis=1)
+    alli = jnp.concatenate([bi, ci], axis=1)
+    allvis = jnp.concatenate([bv, jnp.zeros(ci.shape, jnp.bool_)], axis=1)
+    nv, sel = jax.lax.top_k(-allv, ITOPK)
+    return (jnp.take_along_axis(alli, sel, axis=1), -nv,
+            jnp.take_along_axis(allvis, sel, axis=1))
+
+
+timeit("merge lax.top_k 320->64", merge_topk,
+       buf_d, cand_d, buf_ids, cand_ids, vis)
+
+
+def parent_pick(bd, v, bi):
+    from raft_tpu.ops.select_k import iter_topk_min_packed
+
+    pkey = jnp.where(v | (bi < 0), jnp.inf, bd)
+    pv, ppos = iter_topk_min_packed(pkey, W)
+    pid = jnp.take_along_axis(bi, ppos, axis=1)
+    nvis = v | jnp.any(jnp.arange(ITOPK, dtype=jnp.int32)[None, None, :]
+                       == ppos[:, :, None], axis=1)
+    return pid, nvis
+
+
+timeit("parent pick + vis mark", parent_pick, buf_d, vis, buf_ids)
+
+# exact-loop comparison: fp32 row gather at (q, b)
+dataset = jax.random.normal(ks[9], (N, DIM), jnp.float32)
+jax.block_until_ready(dataset)
+timeit("exact fp32 gather (q,b,dim)", lambda c: dataset[c], cand_ids)
+
+
+def exact_dists(c, q):
+    xv = dataset[c].astype(jnp.float32)
+    ip = jnp.einsum("qmd,qd->qm", xv, q, preferred_element_type=jnp.float32)
+    return jnp.sum(xv * xv, axis=2) - 2.0 * ip
+
+
+qf = jax.random.normal(ks[2], (Q, DIM), jnp.float32)
+timeit("exact gather+dists (q,b,dim)", exact_dists, cand_ids, qf)

@@ -42,14 +42,22 @@ def _force(x):
 
 
 def collective_stats(n_dev: int, q: int, k: int) -> dict:
-    """Analytic per-search collective model for the sharded IVF search:
-    every query tile all_gathers (world, q, k) candidate vals (f32) + ids
-    (i32) over the mesh axis; ring all-gather moves (world-1)/world of the
-    gathered buffer per link."""
-    gathered = 2 * 4 * q * k * n_dev            # vals + ids, full buffer
-    per_link = int(gathered * (n_dev - 1) / max(n_dev, 1))
-    return {"allgather_bytes_total": gathered,
-            "allgather_bytes_per_link": per_link}
+    """Analytic per-search collective model.
+
+    Round 5: the candidate merge is a recursive-doubling butterfly
+    (_sharding.merge_shards) — log2(world) rounds, each exchanging one
+    (q, k) vals+ids tile per device pair, so per-link traffic is
+    2·4·q·k·log2(world) bytes and STOPS growing linearly in world (the
+    round-4 all_gather model grew ~(world-1)·q·k per link — measured ~9×
+    from 2→8 devices, VERDICT r4 #6)."""
+    import math
+
+    rounds = int(math.log2(n_dev)) if n_dev > 1 else 0
+    per_link = 2 * 4 * q * k * rounds
+    old_per_link = int(2 * 4 * q * k * n_dev * (n_dev - 1) / max(n_dev, 1))
+    return {"merge_rounds": rounds,
+            "butterfly_bytes_per_link": per_link,
+            "allgather_bytes_per_link_r4_model": old_per_link}
 
 
 def hlo_collectives(fn, *args) -> dict:
@@ -121,6 +129,42 @@ def main():
         last["brute_qps"] * n_last / max(base["brute_qps"], 1e-9), 3)
     results["weak_scaling_efficiency_ivf"] = round(
         last["ivf_flat_qps"] * n_last / max(base["ivf_flat_qps"], 1e-9), 3)
+
+    # --- ≥1M-row distributed IVF-PQ on the full virtual mesh (VERDICT r4
+    # #6: the dryrun exercises the path at toy scale only) — one 8-device
+    # build + search with a brute-force recall oracle on a query subset.
+    try:
+        from raft_tpu.distributed import ivf_pq as dpq
+        from raft_tpu.neighbors import ivf_pq as sl_pq
+        from raft_tpu.neighbors import refine as refm
+        from raft_tpu import stats
+
+        n_dev = 8
+        n1m, dim1m, q1m = 1_048_576, 32, 256
+        Xb = jnp.asarray(rng.standard_normal((n1m, dim1m)), jnp.float32)
+        Qb = jnp.asarray(rng.standard_normal((q1m, dim1m)), jnp.float32)
+        comms = Comms(local_mesh(n_dev))
+        t0 = time.perf_counter()
+        pidx = dpq.build(Xb, sl_pq.IvfPqParams(
+            n_lists=256, pq_dim=16, kmeans_trainset_fraction=0.05,
+            kmeans_n_iters=5), comms=comms)
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _, cand = dpq.search(pidx, Qb, 4 * K, n_probes=32)
+        _, ids = refm.refine(Xb, Qb, cand, K)
+        _force(ids)
+        search_s = time.perf_counter() - t0
+        from raft_tpu.neighbors import brute_force as bf
+
+        _, gt = bf.knn(Qb, Xb, K)
+        rec = float(stats.neighborhood_recall(ids, gt))
+        results["ivf_pq_1m_8dev"] = {
+            "n": n1m, "dim": dim1m, "q": q1m, "k": K,
+            "build_s": round(build_s, 1), "search_s": round(search_s, 2),
+            "recall": round(rec, 4)}
+        print(json.dumps(results["ivf_pq_1m_8dev"]), flush=True)
+    except Exception as e:
+        results["ivf_pq_1m_8dev"] = {"error": repr(e)[:300]}
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "results", f"ICI_r{rnd:02d}.json")

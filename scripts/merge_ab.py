@@ -1,0 +1,49 @@
+"""A/B the butterfly vs all_gather cross-shard merge on the virtual mesh."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms import local_mesh
+from raft_tpu.comms.comms import Comms
+from raft_tpu.distributed import _sharding
+
+Q, K = 1024, 10
+REPS = 20
+
+for n_dev in (2, 4, 8):
+    comms = Comms(local_mesh(n_dev))
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.uniform(size=(Q, K)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 1 << 20, (Q, K)), jnp.int32)
+
+    for world in (n_dev, 0):  # n_dev -> butterfly, 0 -> all_gather
+        def body(v, i):
+            return _sharding.merge_shards(v, i, K, comms.axis, world)
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False))
+        out = fn(vals, ids)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(vals, ids)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS * 1000
+        name = "butterfly" if world else "all_gather"
+        print(f"n_dev={n_dev} {name:10s} {dt:7.3f} ms", flush=True)
