@@ -75,3 +75,41 @@ def sift_like(n: int, dim: int = 128, n_queries: int = 10_000,
     data, queries = x[:n], x[n:]
     np.savez(path, data=data, queries=queries)
     return data, queries
+
+
+def deep_like_rows(row_ids, dim: int = 96, seed: int = 0,
+                   n_coarse: int = 4096):
+    """Row-ADDRESSABLE DEEP-shaped generator: row r is a pure function of
+    ``(seed, r)`` (counter-based PRNG), so 100M-row benches can stream
+    build chunks and later regenerate exactly the candidate rows needed
+    for exact re-ranking — the raw (n, dim) matrix never exists anywhere.
+
+    Same two-level-mixture character as :func:`sift_like` (Zipf-weighted
+    overlapping clusters), but fp32 L2-normalized like the DEEP descriptors
+    (big-ann deep-96). Runs on device; jit/vmap-safe.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    row_ids = jnp.asarray(row_ids, jnp.int32)
+    key = jax.random.key(seed)
+    # centers/spread ride a fold_in index no row id can collide with:
+    # row ids are int32 (≤ 0x7FFFFFFF), this is above that range but still
+    # uint32-representable as fold_in requires
+    kc, ks = jax.random.split(jax.random.fold_in(key, 0x80000001))
+    centers = jax.random.normal(kc, (n_coarse, dim), jnp.float32) * 2.0
+    spread = 0.5 + 1.5 * jax.random.uniform(ks, (n_coarse,), jnp.float32)
+    w = 1.0 / jnp.arange(1, n_coarse + 1, dtype=jnp.float32) ** 0.7
+    cw = jnp.cumsum(w / jnp.sum(w))
+
+    def one(r):
+        kr = jax.random.fold_in(key, r)
+        ku, kn = jax.random.split(kr)
+        c = jnp.searchsorted(cw, jax.random.uniform(ku))
+        c = jnp.minimum(c, n_coarse - 1)
+        return centers[c] + jax.random.normal(kn, (dim,)) * spread[c]
+
+    rows = jax.vmap(one)(row_ids.reshape(-1))
+    rows = rows / jnp.maximum(
+        jnp.linalg.norm(rows, axis=-1, keepdims=True), 1e-30)
+    return rows.reshape(row_ids.shape + (dim,))

@@ -12,6 +12,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.cluster import kmeans_balanced
@@ -235,17 +236,30 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
 def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float,
                      pair_const=None):
     """Jittable dense fallback scan for shards too small for the strip
-    kernel (max_list_size < 512): gather the probed lists and reduce with
-    one einsum — the single-device gather backend per shard."""
-    cand = ld[probes].astype(jnp.float32)            # (q, p, mls, d)
-    ip = jnp.einsum("qd,qpmd->qpm", queries, cand,
-                    preferred_element_type=jnp.float32)
-    d = alpha * ip + bias[probes]
-    if pair_const is not None:
-        d = d + pair_const[:, :, None]
+    kernel (max_list_size < 512), and the off-TPU SPMD scan.
+
+    Tiled over the probe axis (``lax.map``): the one-shot formulation
+    materialized a (q, p, mls, dim) gather — 2 GB/device at the ICI-bench
+    shapes, which collapsed the virtual-mesh weak-scaling run — where one
+    probe's (q, mls, dim) block is p× smaller and the loop carries only
+    the (p, q, mls) score tensor."""
     q = queries.shape[0]
-    flat_ids = li[probes].reshape(q, -1)
-    d = d.reshape(q, -1)
+    qf = queries.astype(jnp.float32)
+
+    def one_probe(j):
+        lids = probes[:, j]                              # (q,)
+        cand = ld[lids].astype(jnp.float32)              # (q, mls, d)
+        ip = jnp.einsum("qd,qmd->qm", qf, cand,
+                        preferred_element_type=jnp.float32)
+        d = alpha * ip + bias[lids]
+        if pair_const is not None:
+            d = d + pair_const[:, j, None]
+        return d, li[lids]
+
+    p = probes.shape[1]
+    d_all, ids_all = lax.map(one_probe, jnp.arange(p))   # (p, q, mls)
+    d = jnp.transpose(d_all, (1, 0, 2)).reshape(q, -1)
+    flat_ids = jnp.transpose(ids_all, (1, 0, 2)).reshape(q, -1)
     vals, sel = select_k(d, min(k, d.shape[1]), select_min=True)
     ids = jnp.where(jnp.isinf(vals), -1,
                     jnp.take_along_axis(flat_ids, sel, axis=1))

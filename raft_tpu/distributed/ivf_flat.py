@@ -176,11 +176,17 @@ def search(
 
     probes = _coarse_probes(queries, index.centers, n_probes, index.metric,
                             "exact", res.compute_dtype)
+    # off-TPU the strip kernel only exists as the single-threaded Pallas
+    # interpreter — it serializes the per-shard scans of a virtual mesh and
+    # turns weak-scaling numbers into an emulator artifact (ICI r5 finding:
+    # brute scaled at 1.0, IVF at 0.6-0.8 purely from this). The dense
+    # XLA scan is the honest off-TPU backend.
+    interpret = jax.default_backend() != "tpu"
     vals, ids = tiled_search(
         queries, probes, index.lens_max, index.n_lists, int(k),
         index.comms, -2.0 if l2 else -1.0,
-        dense=not strip_eligible(index.max_list_size),
-        interpret=jax.default_backend() != "tpu",
+        dense=interpret or not strip_eligible(index.max_list_size),
+        interpret=interpret,
         data=index.list_data, ids_arr=index.list_ids, bias=index.bias,
     )
     if l2:

@@ -258,11 +258,18 @@ def search(
         index.decoded_scale, None, n_probes, index.metric, "exact",
         res.compute_dtype, l2,
     )
+    # truncated-cache indexes (build_streaming store="cache") drop the same
+    # rotated tail from the query operand (see neighbors/ivf_pq)
+    if index.decoded.shape[-1] < qr_scaled.shape[-1]:
+        qr_scaled = qr_scaled[:, :index.decoded.shape[-1]]
+    # dense XLA scan off-TPU: the interpreted strip kernel serializes
+    # virtual-mesh shards (see distributed/ivf_flat.py)
+    interpret = jax.default_backend() != "tpu"
     vals, ids = tiled_search(
         qr_scaled, probes, index.lens_max, index.n_lists,
         int(k), index.comms, alpha,
-        dense=not strip_eligible(index.max_list_size),
-        interpret=jax.default_backend() != "tpu",
+        dense=interpret or not strip_eligible(index.max_list_size),
+        interpret=interpret,
         data=index.decoded, ids_arr=index.list_ids, bias=index.bias,
         pair_const=pair_const,
     )

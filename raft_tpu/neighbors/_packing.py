@@ -125,7 +125,8 @@ def _spill_core(work, centers, labels, metric, cap, base, counts, chunk):
 
     n_alt = min(_N_ALT, n_lists - 1)
     if n_alt <= 0:
-        return labels  # a single list has nowhere to spill
+        # a single list has nowhere to spill (tuple contract as below)
+        return labels, jnp.int32(0)
     alts = []
     for s in range(0, n, chunk):
         w = work[s:s + chunk]

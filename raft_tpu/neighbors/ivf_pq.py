@@ -541,6 +541,365 @@ def build(
     )
 
 
+def _chunk_ranks(labels, n_lists: int):
+    """Chunk-local arrival rank of each row within its label, in
+    label-sorted order: returns ``(order, sorted_labels, rank_sorted)``.
+    The ONE definition shared by the streamed-build scatter position math
+    and the capacity diversion's fill check — they must agree exactly or
+    rows overwrite/drop (code-review r5). Sentinel labels (== n_lists)
+    sort last and rank within the sentinel bucket."""
+    m = labels.shape[0]
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    counts = jnp.bincount(labels, length=n_lists + 1)[:n_lists]
+    offsets = jnp.cumsum(counts) - counts
+    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
+    rank_sorted = (jnp.arange(m, dtype=jnp.int32)
+                   - offsets[safe_sl].astype(jnp.int32))
+    return order, sorted_labels, rank_sorted
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pq_dim", "pq_bits", "cluster", "code_w"),
+    donate_argnums=(0, 1),
+)
+def _scatter_chunk(list_codes, list_ids, chunk, labels, base, row_start,
+                   centers, rotation, codebooks,
+                   pq_dim, pq_bits, cluster, code_w):
+    """One streamed-build chunk: encode + offset-scatter into the donated
+    packed blocks (build_streaming pass 2). ``base`` is the per-list write
+    offset accumulated over previous chunks; the in-chunk rank comes from
+    one chunk-local sort, so no global position array ever exists."""
+    m, dim = chunk.shape
+    n_lists, mls = list_ids.shape
+    dsub = codebooks.shape[-1]
+    rot_dim = pq_dim * dsub
+    safe = jnp.minimum(labels, n_lists - 1)
+    resid = _pad_rot(chunk - centers[safe], rot_dim) @ rotation.T
+    resid = resid.reshape(m, pq_dim, dsub)
+    raw = (_encode_cluster(resid, safe, codebooks) if cluster
+           else _encode(resid, codebooks))
+    codes = pack_codes(raw, pq_bits)
+    # chunk-local rank within each list; sentinel labels (== n_lists, the
+    # diversion drop marker) and overflow past mls route to row mls, which
+    # mode="drop" discards
+    order, sorted_labels, rank_sorted = _chunk_ranks(labels, n_lists)
+    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
+    pos = base[safe_sl].astype(jnp.int32) + rank_sorted
+    pos = jnp.where((sorted_labels < n_lists) & (pos < mls), pos, mls)
+    list_codes = list_codes.at[safe_sl, pos].set(
+        codes[order], mode="drop")
+    ids = row_start + jnp.arange(m, dtype=jnp.int32)
+    list_ids = list_ids.at[safe_sl, pos].set(
+        ids[order], mode="drop")
+    return list_codes, list_ids
+
+
+@functools.partial(jax.jit, static_argnames=("block", "metric"))
+def _assign_top2(rows, centers, block: int = 4096,
+                 metric: str = "sqeuclidean"):
+    """Best and second-best center per row, tiled over center blocks
+    (fused_l2_nn_argmin gives only the argmin; the streamed build's
+    capacity diversion needs the runner-up as the spill target — the
+    one-pass analog of _packing.spill_to_cap's first alternative round).
+    ``metric`` matches kmeans_balanced._assign: "sqeuclidean" ranks by
+    expanded L2, "inner_product" by −⟨row, center⟩."""
+    m, dim = rows.shape
+    n_c = centers.shape[0]
+    nb = -(-n_c // block)
+    cpad = jnp.pad(centers, ((0, nb * block - n_c), (0, 0)))
+    cn = jnp.sum(cpad * cpad, axis=1)
+    cn = jnp.where(jnp.arange(nb * block) < n_c, cn, jnp.inf)
+
+    def step(carry, bi):
+        v1, i1, v2, i2 = carry
+        cb = lax.dynamic_slice_in_dim(cpad, bi * block, block, axis=0)
+        bn = lax.dynamic_slice_in_dim(cn, bi * block, block, axis=0)
+        ip = jnp.einsum("md,cd->mc", rows, cb,
+                        preferred_element_type=jnp.float32)
+        d = -ip if metric == "inner_product" else bn[None, :] - 2.0 * ip
+        d = jnp.where(jnp.isinf(bn)[None, :], jnp.inf, d)
+        bv1 = jnp.min(d, axis=1)
+        ba1 = jnp.argmin(d, axis=1).astype(jnp.int32) + bi * block
+        d2 = jnp.where(jnp.arange(block)[None, :]
+                       == (ba1 - bi * block)[:, None], jnp.inf, d)
+        bv2 = jnp.min(d2, axis=1)
+        ba2 = jnp.argmin(d2, axis=1).astype(jnp.int32) + bi * block
+        # merge two sorted pairs -> global best two
+        cand_v = jnp.stack([v1, v2, bv1, bv2], axis=1)
+        cand_i = jnp.stack([i1, i2, ba1, ba2], axis=1)
+        nv1 = jnp.min(cand_v, axis=1)
+        na1 = jnp.argmin(cand_v, axis=1)
+        ni1 = jnp.take_along_axis(cand_i, na1[:, None], axis=1)[:, 0]
+        cv2 = jnp.where(jnp.arange(4)[None, :] == na1[:, None],
+                        jnp.inf, cand_v)
+        na2 = jnp.argmin(cv2, axis=1)
+        nv2 = jnp.take_along_axis(cv2, na2[:, None], axis=1)[:, 0]
+        ni2 = jnp.take_along_axis(cand_i, na2[:, None], axis=1)[:, 0]
+        return (nv1, ni1, nv2, ni2), None
+
+    init = (jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32),
+            jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32))
+    (v1, i1, v2, i2), _ = lax.scan(step, init,
+                                   jnp.arange(nb, dtype=jnp.int32))
+    return i1, i2
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pq_dim", "pq_bits", "cluster", "cache_dim"),
+    donate_argnums=(0, 1, 2),
+)
+def _scatter_chunk_cache(cache, list_ids, b_sum, chunk, labels, base,
+                         row_start, centers, rotation, codebooks, rc_t,
+                         pq_dim, pq_bits, cluster, cache_dim):
+    """Streamed-build chunk for ``store="cache"``: encode → reconstruct →
+    int8-truncate to ``cache_dim`` rotated coords, then offset-scatter the
+    cache + ids + per-entry b_sum into the donated blocks. The codes are
+    transient — at 100M×96 keeping BOTH packed codes and the cache busts
+    HBM, and truncating the cache is the quantize-harder decision
+    (detail/ivf_pq_fp_8bit.cuh analog: precision traded for memory, exact
+    refine absorbs it)."""
+    m, dim = chunk.shape
+    n_lists, mls = list_ids.shape
+    dsub = codebooks.shape[-1]
+    rot_dim = pq_dim * dsub
+    safe = jnp.minimum(labels, n_lists - 1)
+    resid = _pad_rot(chunk - centers[safe], rot_dim) @ rotation.T
+    resid3 = resid.reshape(m, pq_dim, dsub)
+    raw = (_encode_cluster(resid3, safe, codebooks) if cluster
+           else _encode(resid3, codebooks))
+    packed = pack_codes(raw, pq_bits)
+    scale = jnp.maximum(jnp.max(jnp.abs(codebooks)), 1e-30) / 127.0
+    rec = _decode_lists_scaled(codebooks, packed[None], scale, pq_dim,
+                               pq_bits, cluster)[0]  # (m, rot_dim) int8
+    rec_t = rec[:, :cache_dim]
+    rf = rec_t.astype(jnp.float32) * scale
+    # truncated-space b_sum: 2⟨(Rc_l)[:cd], r̂_t⟩ + ‖r̂_t‖² (the scan's
+    # −2⟨q_rot[:cd], r̂_t⟩ completes the cross term; ‖Rc‖² rides
+    # _ragged_bias_pq, −2⟨q,c⟩ rides pair_const — both exact)
+    b = (2.0 * jnp.einsum("md,md->m", rc_t[safe], rf,
+                          preferred_element_type=jnp.float32)
+         + jnp.einsum("md,md->m", rf, rf,
+                      preferred_element_type=jnp.float32))
+    order, sorted_labels, rank_sorted = _chunk_ranks(labels, n_lists)
+    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
+    pos = base[safe_sl].astype(jnp.int32) + rank_sorted
+    pos = jnp.where((sorted_labels < n_lists) & (pos < mls), pos, mls)
+    cache = cache.at[safe_sl, pos].set(rec_t[order], mode="drop")
+    ids = row_start + jnp.arange(m, dtype=jnp.int32)
+    list_ids = list_ids.at[safe_sl, pos].set(ids[order], mode="drop")
+    b_sum = b_sum.at[safe_sl, pos].set(b[order], mode="drop")
+    return cache, list_ids, b_sum
+
+
+def build_streaming(
+    chunk_fn,
+    n: int,
+    dim: int,
+    params: IvfPqParams = IvfPqParams(),
+    res: Optional[Resources] = None,
+    chunk_rows: int = 0,
+    train_rows: int = 0,
+    store: str = "codes",
+    cache_dim: int = 0,
+) -> IvfPqIndex:
+    """Out-of-HBM build: the dataset visits the device one chunk at a time
+    (the 100M-row single-chip configuration, BASELINE DEEP-100M row).
+
+    ``chunk_fn(start, end) -> (end-start, dim) array`` supplies rows — a
+    file reader (bench/io.py readers), a generator, or a host array slice.
+    It is called once per chunk per pass (twice total), so it must be
+    deterministic.
+
+    Differences from :func:`build`, all forced by the memory budget:
+
+    * quantizers train on ``train_rows`` sampled rows (default ≤2M) — the
+      reference trains on a host-side subsample for the same reason
+      (ivf_pq_build.cuh:1729);
+    * pass 1 streams assignments (labels are kept, ~4 B/row); pass 2
+      encodes each chunk and scatters at precomputed per-list offsets into
+      DONATED blocks — peak HBM is the index + one chunk, never the raw
+      matrix (vs extend()'s whole-index repack per call, O(n²) over a
+      chunk stream);
+    * the list cap (``params.list_size_cap``) is enforced by ONE-PASS
+      capacity diversion: a row whose nearest list is full goes to its
+      second-nearest (:func:`_assign_top2` — the streaming analog of
+      _packing.spill_to_cap's first alternative round); rows whose second
+      choice is also full are DROPPED and counted
+      (``index._streaming_dropped``) — at the auto cap this is empty;
+    * ``store="codes"`` keeps packed codes (search via ``backend="pallas"``
+      or lazy cache decode); ``store="cache"`` keeps ONLY the int8
+      strip-scan cache, truncated to the first ``cache_dim`` rotated
+      coordinates — the quantize-harder memory decision
+      (detail/ivf_pq_fp_8bit.cuh analog) that makes 100M×96 fit one 16 GB
+      chip next to its own transients; such an index searches at full
+      strip speed but cannot extend() or re-derive codes.
+    """
+    import numpy as np
+
+    res = res or current_resources()
+    if params.metric == "cosine":
+        raise ValueError("build_streaming: cosine needs normalized chunks; "
+                         "normalize inside chunk_fn and use inner_product")
+    if store not in ("codes", "cache"):
+        raise ValueError(f"unknown store mode {store!r}")
+    pq_dim = params.pq_dim or _auto_pq_dim(dim)
+    dsub = -(-dim // pq_dim)
+    rot_dim = pq_dim * dsub
+    cd = int(cache_dim) or rot_dim
+    if not 0 < cd <= rot_dim:
+        raise ValueError(f"cache_dim={cd} out of range (1..{rot_dim})")
+    n_codes = 1 << params.pq_bits
+    cluster = params.codebook_kind == "cluster"
+    km_metric = ("inner_product" if params.metric == "inner_product"
+                 else "sqeuclidean")
+    km = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=km_metric, seed=params.seed)
+    chunk = int(chunk_rows) or int(
+        max(262_144, min(n, res.workspace_bytes // max(dim * 12, 1))))
+    chunk = min(chunk, n)
+    starts = list(range(0, n, chunk))
+    group = params.group_size or _packing.auto_group_size(
+        n, params.n_lists, floor=128)
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(n, params.n_lists, group)
+
+    from raft_tpu.core.interruptible import check_interrupt
+
+    # --- quantizers on a strided sample ------------------------------------
+    t_rows = int(train_rows) or int(min(2_000_000, max(
+        params.n_lists * 32, n * params.kmeans_trainset_fraction)))
+    t_rows = min(t_rows, n)
+    per = max(1, t_rows // len(starts))
+    train_parts = [jnp.asarray(chunk_fn(s, min(s + per, n)), jnp.float32)
+                   for s in starts]
+    trainset = (jnp.concatenate(train_parts) if len(train_parts) > 1
+                else train_parts[0])
+    del train_parts
+    centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
+    key = jax.random.key(params.seed)
+    _, k_rot, k_cb = jax.random.split(key, 3)
+    rotation = make_rotation_matrix(k_rot, rot_dim)
+    train_labels = kmeans_balanced.predict(trainset, centers, km, res=res)
+    cb_rows = min(trainset.shape[0], 65536)
+    resid = (_pad_rot(trainset[:cb_rows] - centers[train_labels[:cb_rows]],
+                      rot_dim) @ rotation.T).reshape(cb_rows, pq_dim, dsub)
+    if cluster:
+        codebooks = _train_codebooks_cluster(
+            resid, train_labels[:cb_rows], k_cb, n_codes,
+            params.codebook_n_iters, params.n_lists)
+    else:
+        codebooks = _train_codebooks(resid.transpose(1, 0, 2), k_cb,
+                                     n_codes, params.codebook_n_iters)
+    del trainset, train_labels, resid
+
+    # --- pass 1: streamed assignment (+ capacity diversion under a cap) ----
+    n_lists = params.n_lists
+    run = np.zeros(n_lists, np.int64)
+    counts_np = np.zeros((len(starts), n_lists), np.int64)
+    labels_chunks = []
+    dropped = 0
+    for ci, s in enumerate(starts):
+        check_interrupt()
+        e = min(s + chunk, n)
+        rows = jnp.asarray(chunk_fn(s, e), jnp.float32)
+        if cap:
+            l1, l2 = _assign_top2(rows, centers, metric=km_metric)
+            labels = _divert_to_cap(l1, l2, jnp.asarray(run, jnp.int32),
+                                    jnp.int32(cap), n_lists)
+        else:
+            labels = kmeans_balanced.predict(rows, centers, km, res=res)
+        labels_chunks.append(labels)
+        c = np.asarray(jnp.bincount(jnp.minimum(labels, n_lists),
+                                    length=n_lists + 1))
+        counts_np[ci] = c[:n_lists]
+        dropped += int(c[n_lists])
+        run += c[:n_lists]
+        del rows
+    totals = counts_np.sum(axis=0)
+    mls = int(max(group, -(-int(totals.max()) // group) * group))
+    if group == 512:  # strip backend block-divisibility (pow2 chunks)
+        mls = 512 * (1 << (mls // 512 - 1).bit_length())
+    base_np = np.cumsum(counts_np, axis=0) - counts_np  # per-chunk offsets
+    if dropped:
+        from raft_tpu.core.logger import get_logger
+
+        get_logger().warning(
+            "build_streaming: %d row(s) overflowed both their nearest and "
+            "second-nearest capped lists and were dropped (cap=%d); raise "
+            "list_size_cap or n_lists.", dropped, cap)
+
+    # --- pass 2: encode + offset-scatter into donated blocks ---------------
+    list_ids = jnp.full((n_lists, mls), -1, jnp.int32)
+    if store == "cache":
+        cache = jnp.zeros((n_lists, mls, cd), jnp.int8)
+        b_sum = jnp.full((n_lists, mls), jnp.inf, jnp.float32)
+        rc_t = ((_pad_rot(centers, rot_dim) @ rotation.T)[:, :cd])
+        for ci, s in enumerate(starts):
+            check_interrupt()
+            e = min(s + chunk, n)
+            rows = jnp.asarray(chunk_fn(s, e), jnp.float32)
+            cache, list_ids, b_sum = _scatter_chunk_cache(
+                cache, list_ids, b_sum, rows, labels_chunks[ci],
+                jnp.asarray(base_np[ci], jnp.int32), jnp.int32(s),
+                centers, rotation, codebooks, rc_t,
+                pq_dim, params.pq_bits, cluster, cd)
+            del rows
+        scale = jnp.maximum(jnp.max(jnp.abs(codebooks)), 1e-30) / 127.0
+        if params.metric in ("inner_product",):
+            b_sum = jnp.where(list_ids >= 0, 0.0, jnp.inf)
+        out = IvfPqIndex(
+            centers, rotation, codebooks,
+            jnp.zeros((n_lists, mls, 0), jnp.uint8), list_ids, b_sum,
+            cache, params.metric, params.pq_bits, group,
+            decoded_scale=scale, codebook_kind=params.codebook_kind,
+            pq_dim_hint=pq_dim)
+    else:
+        code_w = packed_width(pq_dim, params.pq_bits)
+        list_codes = jnp.zeros((n_lists, mls, code_w), jnp.uint8)
+        for ci, s in enumerate(starts):
+            check_interrupt()
+            e = min(s + chunk, n)
+            rows = jnp.asarray(chunk_fn(s, e), jnp.float32)
+            list_codes, list_ids = _scatter_chunk(
+                list_codes, list_ids, rows, labels_chunks[ci],
+                jnp.asarray(base_np[ci], jnp.int32), jnp.int32(s),
+                centers, rotation, codebooks,
+                pq_dim, params.pq_bits, cluster, code_w)
+            del rows
+        b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes,
+                               list_ids, params.metric, pq_dim,
+                               params.pq_bits, cluster=cluster)
+        out = IvfPqIndex(
+            centers, rotation, codebooks, list_codes, list_ids, b_sum,
+            None, params.metric, params.pq_bits, group,
+            codebook_kind=params.codebook_kind, pq_dim_hint=pq_dim)
+    out._streaming_dropped = dropped
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def _divert_to_cap(l1, l2, run_counts, cap, n_lists):
+    """Capacity diversion for one streamed chunk: rows whose nearest list
+    is full (given the running fill) take their second-nearest; rows whose
+    second choice is also full get the drop sentinel ``n_lists``. Ranks are
+    chunk-local arrival order, matching the scatter's position math."""
+    m = l1.shape[0]
+
+    def rank_of(lab):
+        order, _, rank_sorted = _chunk_ranks(lab, n_lists)
+        return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
+
+    full1 = run_counts[l1] + rank_of(l1) >= cap
+    lab = jnp.where(full1, l2, l1)
+    # re-rank under the diverted labels; overflow past cap drops
+    full2 = run_counts[jnp.minimum(lab, n_lists - 1)] + rank_of(lab) >= cap
+    return jnp.where(full2, n_lists, lab).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits", "cluster"))
 def _decode_lists(codebooks, list_codes, pq_dim=None, pq_bits: int = 8,
                   cluster: bool = False):
@@ -644,6 +1003,11 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Optional[Resources
     """Encode new vectors with the existing quantizers and repack
     (ivf_pq extend analog)."""
     res = res or current_resources()
+    if index.list_codes.shape[-1] == 0:
+        raise ValueError(
+            "cache-only streamed index (build_streaming store='cache') "
+            "keeps no codes and cannot extend(); rebuild with "
+            "store='codes'")
     new_vectors = jnp.asarray(new_vectors).astype(jnp.float32)
     if new_vectors.shape[1] != index.dim:
         raise ValueError(f"dim mismatch: {new_vectors.shape[1]} != {index.dim}")
@@ -752,6 +1116,12 @@ def _ragged_fused_pq(queries, centers, rotation, b_sum, list_ids, decoded,
         queries, centers, rotation, b_sum, list_ids, decoded_scale,
         filter, n_probes, metric, sa, compute_dtype, l2,
     )
+    # truncated cache (build_streaming store="cache", cache_dim < rot_dim):
+    # the cache keeps only the leading rotated coords, so the query operand
+    # drops the same tail — b_sum was built in the truncated space and the
+    # center terms (‖Rc‖², −2⟨q,c⟩) stay exact
+    if decoded.shape[-1] < qr_scaled.shape[-1]:
+        qr_scaled = qr_scaled[:, :decoded.shape[-1]]
     vals, ids = strip_search_traced(
         qr_scaled, probes, decoded, bias, list_ids, cls_ord,
         classes, class_counts, int(k), int(k), -2.0 if l2 else -1.0,
@@ -780,8 +1150,10 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
             pq_bits=index.pq_bits, cluster=index.codebook_kind == "cluster",
         )
     l2 = index.metric in ("sqeuclidean", "euclidean")
+    # plan with the dim the kernel actually scans: a truncated streamed
+    # cache (store="cache", cache_dim < rot_dim) narrows the fetch classes
     classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
-        index, n_probes, k, res, index.rotation.shape[0])
+        index, n_probes, k, res, int(index.decoded.shape[-1]))
     return _ragged_fused_pq(
         queries, index.centers, index.rotation, index.b_sum, index.list_ids,
         index.decoded, index.decoded_scale, filter, cls_ord,
@@ -1052,6 +1424,15 @@ def search(
 
     aligned = strip_eligible(index.max_list_size) and k <= 512
     pallas_ok = index.max_list_size % 128 == 0
+    if index.list_codes.shape[-1] == 0:
+        # cache-only streamed index: the int8 strip cache IS the payload —
+        # no codes for the LUT/gather backends to read
+        if not aligned:
+            raise ValueError(
+                "cache-only streamed index needs a strip-eligible "
+                f"max_list_size (power-of-two multiple of 512 and k <= "
+                f"512), got {index.max_list_size} / k={k}")
+        backend = "ragged"
     if backend == "auto":
         # ragged decoded scan on TPU (the fast path); jnp gather elsewhere
         # (the exact-fp32 oracle; its take_along_axis crashes the TPU
