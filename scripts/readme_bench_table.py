@@ -1,0 +1,91 @@
+"""Regenerate README.md's benchmark table from the latest BENCH_r*.json.
+
+One source of truth (VERDICT r4 weak #6): the driver-captured JSON. The
+table between the BENCH-TABLE markers is replaced in place.
+
+Usage: python scripts/readme_bench_table.py
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+benches = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+if not benches:
+    sys.exit("no BENCH_r*.json found")
+path = benches[-1]
+rnd = re.search(r"BENCH_r(\d+)", path).group(1)
+with open(path) as f:
+    b = json.load(f)
+# driver layout: {"n", "cmd", "rc", "tail", "parsed": {.., "extras": {..}}}
+b = b.get("parsed", b)
+e = b.get("extras", b)
+if isinstance(e, str):
+    e = json.loads(e)
+
+rows = []
+n, dim = e.get("n", 0), e.get("dim", 0)
+scale = (f"{n // 1_000_000}M×{dim}" if n >= 1_000_000
+         else f"{n // 1000}K×{dim}")
+bf = e.get("brute_force", {})
+if bf.get("qps"):
+    rows.append((f"brute force, {scale}", bf.get("recall", 1.0),
+                 bf["qps"]))
+fl = e.get("ivf_flat", {})
+if fl.get("qps"):
+    rows.append((f"IVF-Flat, {scale}, nprobe {fl.get('nprobe', '?')}",
+                 fl.get("recall"), fl["qps"]))
+pq = e.get("ivf_pq", {})
+if pq.get("qps"):
+    rows.append((f"IVF-PQ + refine, {scale}, nprobe "
+                 f"{pq.get('nprobe', '?')} (headline)",
+                 pq.get("recall"), pq["qps"]))
+cg = e.get("cagra", {})
+if cg.get("qps"):
+    trav = cg.get("traversal", "exact")
+    rows.append((f"CAGRA ({trav}), {scale}, deg 64, itopk "
+                 f"{cg.get('itopk', '?')}, q={cg.get('q', '?')}",
+                 cg.get("recall"), cg["qps"]))
+d10 = e.get("deep10m", {})
+bc = d10.get("brute_chunked", {})
+if bc.get("qps"):
+    rows.append((f"exact chunked scan, 10M×{d10.get('dim', 96)}",
+                 1.0, bc["qps"]))
+p10 = d10.get("ivf_pq", {})
+if p10.get("qps"):
+    rows.append((f"IVF-PQ + refine, 10M×{d10.get('dim', 96)}, nprobe "
+                 f"{p10.get('nprobe', '?')}", p10.get("recall"),
+                 p10["qps"]))
+d100 = e.get("deep100m", {})
+hl = d100.get("headline", {})
+if hl.get("qps"):
+    rows.append((f"IVF-PQ (streamed cache build), 100M×96, nprobe "
+                 f"{hl.get('nprobe', '?')}", hl.get("recall"), hl["qps"]))
+
+
+def fmt_qps(v):
+    return f"{v / 1000:.1f}K" if v >= 1000 else f"{v:.0f}"
+
+
+lines = [f"| config | recall@10 | QPS |", "|---|---|---|"]
+for name, rec, qps in rows:
+    rec_s = f"{rec:.4g}" if isinstance(rec, (int, float)) else "—"
+    lines.append(f"| {name} | {rec_s} | {fmt_qps(qps)} |")
+table = "\n".join(lines)
+
+readme = os.path.join(root, "README.md")
+with open(readme) as f:
+    txt = f.read()
+block = (f"<!-- BENCH-TABLE (generated from BENCH_r{rnd}.json by "
+         f"scripts/readme_bench_table.py; do not hand-edit) -->\n"
+         f"{table}\n<!-- /BENCH-TABLE -->")
+pat = re.compile(r"<!-- BENCH-TABLE.*?/BENCH-TABLE -->", re.S)
+if pat.search(txt):
+    txt = pat.sub(block, txt)
+else:
+    sys.exit("README is missing the BENCH-TABLE markers")
+with open(readme, "w") as f:
+    f.write(txt)
+print(f"README table regenerated from {os.path.basename(path)}")
