@@ -18,9 +18,9 @@ if not benches:
 path = benches[-1]
 rnd = re.search(r"BENCH_r(\d+)", path).group(1)
 with open(path) as f:
-    b = json.load(f)
+    outer = json.load(f)
 # driver layout: {"n", "cmd", "rc", "tail", "parsed": {.., "extras": {..}}}
-b = b.get("parsed", b)
+b = outer.get("parsed", outer)
 e = b.get("extras", b)
 if isinstance(e, str):
     e = json.loads(e)
@@ -63,6 +63,14 @@ hl = d100.get("headline", {})
 if hl.get("qps"):
     rows.append((f"IVF-PQ (streamed cache build), 100M×96, nprobe "
                  f"{hl.get('nprobe', '?')}", hl.get("recall"), hl["qps"]))
+
+
+if not rows:
+    sys.exit(f"{os.path.basename(path)} yielded no table rows — refusing "
+             "to overwrite the README table (failed/partial bench run?)")
+if outer.get("rc", 0) not in (0, None):
+    print(f"warning: {os.path.basename(path)} records rc={outer.get('rc')}",
+          file=sys.stderr)
 
 
 def fmt_qps(v):
