@@ -34,10 +34,12 @@ from raft_tpu.neighbors import ivf_pq
 from raft_tpu.ops.select_k import merge_topk
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000_000
-DIM, Q, K = 96, 2000, 10
+DIM, Q, K = 96, int(os.environ.get("DEEP_Q", 2000)), 10
 N_LISTS = 32768 if N >= 50_000_000 else 4096
 PQ_DIM = 48
 SEED = 0
+PROBES = tuple(int(x) for x in
+               os.environ.get("DEEP_PROBES", "32,64,128,256").split(","))
 
 import raft_tpu as _pkg
 
@@ -136,7 +138,7 @@ def refine_regen(cand_ids, qs):
 
 KF = 8 * K  # wider over-fetch: the truncated cache ranks in 2/3 space
 best = None
-for nprobe in (32, 64, 128, 256):
+for nprobe in PROBES:
     t0 = time.perf_counter()
     _, cand = ivf_pq.search(idx, queries_d, KF, n_probes=nprobe)
     _, ids = refine_regen(cand, queries_d)
