@@ -668,6 +668,63 @@ def build_from_graph(dataset, graph) -> CagraIndex:
 # Search
 # ---------------------------------------------------------------------------
 
+
+def _merge_candidates(bids, bd, bvis, cids, cd, itopk: int, packed: bool,
+                      dedup_limit: int):
+    """Buffer ∪ candidates → new (ids, d, vis): the ONE merge both
+    traversal loops share (code-review r5 — the two hand-tuned copies had
+    already diverged once). Candidate-side duplicates are masked exactly
+    pre-select while the (q, b, b) compare tensor stays VPU-cheap
+    (b ≤ dedup_limit); wider candidate sets select itopk + slack, mask
+    later duplicate copies among the survivors, and compact with one
+    narrow re-select — so duplicate copies never occupy itopk slots
+    (ADVICE r4 cagra.py:536, now fixed for BOTH loops). ``packed`` picks
+    the mantissa-packed iter select (2 VPU ops/pass) over ``lax.top_k``;
+    top_k/packed are both stable, so the first copy — the buffer's,
+    carrying its visited flag — is the one kept."""
+    from raft_tpu.ops.select_k import iter_topk_min_packed
+
+    inf = jnp.float32(jnp.inf)
+    dup_buf = jnp.any(cids[:, :, None] == bids[:, None, :], axis=2)
+    bb = cids.shape[1]
+    if bb <= dedup_limit:
+        eq = cids[:, :, None] == cids[:, None, :]
+        tri = jnp.tril(jnp.ones((bb, bb), jnp.bool_), k=-1)
+        dup_self = jnp.any(eq & tri[None], axis=2)
+        cd = jnp.where(dup_buf | dup_self | (cids < 0), inf, cd)
+        slack = 0
+    else:
+        cd = jnp.where(dup_buf | (cids < 0), inf, cd)
+        # capped at bb: the select reads itopk + slack of itopk + bb
+        slack = min(bb, max(8, itopk // 4))
+    allv = jnp.concatenate([bd, cd], axis=1)
+    alli = jnp.concatenate([bids, cids], axis=1)
+    allvis = jnp.concatenate(
+        [bvis, jnp.zeros(cids.shape, jnp.bool_)], axis=1)
+
+    def select(vals, kk):
+        if packed:
+            return iter_topk_min_packed(vals, kk)
+        nv, sel = lax.top_k(-vals, kk)
+        return -nv, sel
+
+    nv, sel = select(allv, itopk + slack)
+    ni = jnp.take_along_axis(alli, sel, axis=1)
+    nvis = jnp.take_along_axis(allvis, sel, axis=1)
+    if slack:
+        w2 = itopk + slack
+        dup = jnp.any(
+            (ni[:, :, None] == ni[:, None, :])
+            & (jnp.arange(w2)[None, None, :]
+               < jnp.arange(w2)[None, :, None]), axis=2)
+        nv = jnp.where(dup, inf, nv)
+        nv, sel2 = select(nv, itopk)
+        ni = jnp.take_along_axis(ni, sel2, axis=1)
+        nvis = jnp.take_along_axis(nvis, sel2, axis=1)
+    ni = jnp.where(jnp.isinf(nv), -1, ni)
+    return ni, nv, nvis
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "n_rand"),
@@ -709,44 +766,10 @@ def _search_impl(
         return jnp.where(ids >= 0, d, inf)
 
     def merge(bids, bd, bvis, cids, cd):
-        """Buffer ∪ candidates → new (ids, d, vis): compare-matrix dedup +
-        one narrow top_k (the hashmap + bitonic-merge replacement)."""
-        # candidate vs buffer dups: (q, b, itopk) compares, linear in b
-        dup_buf = jnp.any(cids[:, :, None] == bids[:, None, :], axis=2)
-        bb = cids.shape[1]
-        if bb <= 320:
-            # within-candidate dups pre-merge, exact: (q, b, b) compares
-            eq = cids[:, :, None] == cids[:, None, :]
-            tri = jnp.tril(jnp.ones((bb, bb), jnp.bool_), k=-1)
-            dup_self = jnp.any(eq & tri[None], axis=2)
-        else:
-            # wide candidate sets (code-review r4): the all-pairs tensor
-            # scales quadratically in b, so dedup within candidates AFTER
-            # the top_k instead — survivors are only itopk wide. Duplicate
-            # copies can transiently occupy merge slots (bounded waste, the
-            # GPU hashmap analog drops them pre-insert).
-            dup_self = jnp.zeros(cids.shape, jnp.bool_)
-        cd = jnp.where(dup_buf | dup_self | (cids < 0), inf, cd)
-        allv = jnp.concatenate([bd, cd], axis=1)
-        alli = jnp.concatenate([bids, cids], axis=1)
-        allvis = jnp.concatenate(
-            [bvis, jnp.zeros(cids.shape, jnp.bool_)], axis=1)
-        nv, sel = lax.top_k(-allv, itopk)
-        ni = jnp.take_along_axis(alli, sel, axis=1)
-        nvis = jnp.take_along_axis(allvis, sel, axis=1)
-        ni = jnp.where(jnp.isinf(nv), -1, ni)
-        nv = -nv
-        if bb > 320:
-            # post-merge dedup over the (q, itopk) survivors (top_k is
-            # stable, so the first copy — the buffer's, carrying its
-            # visited flag — is the one kept)
-            dup = jnp.any(
-                (ni[:, :, None] == ni[:, None, :])
-                & (jnp.arange(itopk)[None, None, :]
-                   < jnp.arange(itopk)[None, :, None]), axis=2)
-            nv = jnp.where(dup, inf, nv)
-            ni = jnp.where(dup, -1, ni)
-        return ni, nv, nvis
+        # shared buffer∪candidate merge; exact select (the hashmap +
+        # bitonic-merge replacement)
+        return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
+                                 packed=False, dedup_limit=320)
 
     # ---- init: random seeds (num_random_samplings analog) -----------------
     n_seed = min(itopk * n_rand, n)
@@ -844,45 +867,9 @@ def _search_impl_compressed(
         return jnp.where(ids >= 0, nrm - 2.0 * ip, inf)
 
     def merge(bids, bd, bvis, cids, cd):
-        """Buffer ∪ candidates → new (ids, d, vis): compare-matrix dedup +
-        one packed-iter select (2 VPU ops/pass — ADVICE r4 cagra.py:536:
-        candidate-side dups are masked pre-select for every width, so
-        duplicate copies can no longer occupy itopk slots)."""
-        dup_buf = jnp.any(cids[:, :, None] == bids[:, None, :], axis=2)
-        # within-candidate dedup, linear-ish: mask any candidate equal to an
-        # earlier candidate. (q, b, b) bool compares are VPU-cheap up to
-        # b=512; beyond that fall back to post-select masking + re-select.
-        bb = cids.shape[1]
-        if bb <= 512:
-            eq = cids[:, :, None] == cids[:, None, :]
-            tri = jnp.tril(jnp.ones((bb, bb), jnp.bool_), k=-1)
-            dup_self = jnp.any(eq & tri[None], axis=2)
-            cd = jnp.where(dup_buf | dup_self | (cids < 0), inf, cd)
-        else:
-            cd = jnp.where(dup_buf | (cids < 0), inf, cd)
-        allv = jnp.concatenate([bd, cd], axis=1)
-        alli = jnp.concatenate([bids, cids], axis=1)
-        allvis = jnp.concatenate(
-            [bvis, jnp.zeros(cids.shape, jnp.bool_)], axis=1)
-        sel_slack = 0 if bb <= 512 else max(8, itopk // 4)
-        nv, sel = iter_topk_min_packed(allv, itopk + sel_slack)
-        ni = jnp.take_along_axis(alli, sel, axis=1)
-        nvis = jnp.take_along_axis(allvis, sel, axis=1)
-        if sel_slack:
-            # wide case: drop later duplicate copies among the survivors,
-            # then compact back to itopk with one narrow re-select
-            w2 = itopk + sel_slack
-            dup = jnp.any(
-                (ni[:, :, None] == ni[:, None, :])
-                & (jnp.arange(w2)[None, None, :]
-                   < jnp.arange(w2)[None, :, None]), axis=2)
-            nv = jnp.where(dup, inf, nv)
-            nv2, sel2 = iter_topk_min_packed(nv, itopk)
-            ni = jnp.take_along_axis(ni, sel2, axis=1)
-            nvis = jnp.take_along_axis(nvis, sel2, axis=1)
-            nv = nv2
-        ni = jnp.where(jnp.isinf(nv), -1, ni)
-        return ni, nv, nvis
+        # shared buffer∪candidate merge; mantissa-packed select
+        return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
+                                 packed=True, dedup_limit=512)
 
     # ---- seeds ------------------------------------------------------------
     if centroids is not None:

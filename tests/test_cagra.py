@@ -288,3 +288,28 @@ class TestRefineKnnGraph:
         out = cagra.refine_knn_graph(X, bad, 3, 64, 0, res)
         after = float(stats.neighborhood_recall(out, exact))
         assert after > before + 0.1, (before, after)
+
+
+def test_empty_query_batch(data):
+    """A filtered-to-empty query batch returns empty results instead of
+    crashing in the tiling math (code-review r5)."""
+    X, _ = data
+    idx = cagra.build(X, cagra.CagraParams(graph_degree=8,
+                                           intermediate_graph_degree=16))
+    v, i = cagra.search(idx, np.zeros((0, X.shape[1]), np.float32), 5)
+    assert v.shape == (0, 5) and i.shape == (0, 5)
+
+
+def test_wide_merge_slack_path(data):
+    """width*deg beyond the exact-dedup limit takes the slack+re-select
+    merge in BOTH traversals without recall collapse (shared
+    _merge_candidates wide branch)."""
+    X, Q = data
+    idx = cagra.build(X, cagra.CagraParams(
+        graph_degree=16, intermediate_graph_degree=32, compress="on"))
+    _, ei = brute_force.knn(Q, X, 10)
+    ei = np.asarray(ei)
+    for trav in ("compressed", "exact"):
+        _, vi = cagra.search(idx, Q, 10, cagra.CagraSearchParams(
+            itopk_size=64, search_width=40, traversal=trav))
+        assert _recall(np.asarray(vi), ei) >= 0.9, trav

@@ -325,3 +325,28 @@ class TestBuildStreaming:
         _, cand = ivf_pq.search(idx, qs, 40, n_probes=12, backend="gather")
         _, ids = refine.refine(ds, qs, cand, 10)
         assert _recall(ids, gt) >= 0.7
+
+
+class TestAssignTop2:
+    def test_matches_numpy_top2(self):
+        """_assign_top2 (the streamed build's diversion helper) must agree
+        with a dense numpy top-2 under both metrics, across center-block
+        boundaries."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        rows = rng.standard_normal((500, 16)).astype(np.float32)
+        centers = rng.standard_normal((70, 16)).astype(np.float32)
+        # sqeuclidean
+        l1, l2 = ivf_pq._assign_top2(jnp.asarray(rows), jnp.asarray(centers),
+                                     block=32)
+        d = ((rows[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        order = np.argsort(d, axis=1)
+        np.testing.assert_array_equal(np.asarray(l1), order[:, 0])
+        np.testing.assert_array_equal(np.asarray(l2), order[:, 1])
+        # inner product
+        l1, l2 = ivf_pq._assign_top2(jnp.asarray(rows), jnp.asarray(centers),
+                                     block=32, metric="inner_product")
+        order = np.argsort(-rows @ centers.T, axis=1)
+        np.testing.assert_array_equal(np.asarray(l1), order[:, 0])
+        np.testing.assert_array_equal(np.asarray(l2), order[:, 1])
