@@ -67,6 +67,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import os as _os
+
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
@@ -669,6 +671,11 @@ def build_from_graph(dataset, graph) -> CagraIndex:
 # ---------------------------------------------------------------------------
 
 
+# internal tuning knob for the compressed loop's merge (see merge() in
+# _search_impl_compressed); 0 forces the slack+re-select path everywhere
+_CAGRA_DEDUP_LIMIT = int(_os.environ.get("RAFT_TPU_CAGRA_DEDUP_LIMIT", "512"))
+
+
 def _merge_candidates(bids, bd, bvis, cids, cd, itopk: int, packed: bool,
                       dedup_limit: int):
     """Buffer ∪ candidates → new (ids, d, vis): the ONE merge both
@@ -867,9 +874,13 @@ def _search_impl_compressed(
         return jnp.where(ids >= 0, nrm - 2.0 * ip, inf)
 
     def merge(bids, bd, bvis, cids, cd):
-        # shared buffer∪candidate merge; mantissa-packed select
+        # shared buffer∪candidate merge; mantissa-packed select.
+        # _CAGRA_DEDUP_LIMIT (internal tuning knob): whether candidate
+        # dedup pays the (q, b, b) compare tensor pre-select or the
+        # slack + re-select path — the crossover is hardware-dependent
         return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
-                                 packed=True, dedup_limit=512)
+                                 packed=True,
+                                 dedup_limit=_CAGRA_DEDUP_LIMIT)
 
     # ---- seeds ------------------------------------------------------------
     if centroids is not None:

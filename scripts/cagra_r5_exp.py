@@ -74,21 +74,26 @@ def timed_search(sp, reps=5):
 
 
 configs = [
-    # (itopk, width, refine_topk, traversal) — trimmed to the decisive
-    # points (each distinct static shape costs a compile)
-    (64, 4, 0, "auto"),
-    (96, 8, 0, "auto"),
-    (64, 8, 0, "auto"),
-    (64, 4, 32, "auto"),
-    (96, 4, 0, "auto"),
+    # (itopk, width, refine_topk, traversal, max_iter) — trimmed to the
+    # decisive points (each distinct static shape costs a compile);
+    # mi > 0 tests the few-hops hypothesis: centroid seeds land near the
+    # query, so wide expansion over few iterations may beat narrow-many
+    (64, 4, 0, "auto", 0),
+    (96, 8, 0, "auto", 0),
+    (64, 8, 0, "auto", 0),
+    (64, 16, 0, "auto", 6),
+    (96, 16, 0, "auto", 8),
+    (64, 4, 32, "auto", 0),
+    (96, 4, 0, "auto", 0),
 ]
-for itopk, w, rt, trav in configs:
+for itopk, w, rt, trav, mi in configs:
     sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
-                                 refine_topk=rt, traversal=trav)
+                                 refine_topk=rt, traversal=trav,
+                                 max_iterations=mi)
     try:
         t0 = time.perf_counter()
         qps, rec = timed_search(sp)
-        emit(itopk=itopk, width=w, rt=rt, trav=trav,
+        emit(itopk=itopk, width=w, rt=rt, trav=trav, max_iter=mi,
              qps=round(qps, 1), recall=round(rec, 4),
              wall_s=round(time.perf_counter() - t0, 1))
     except Exception as e:
