@@ -24,8 +24,11 @@ run() {
 
 # 1. 1M CAGRA compressed-vs-exact validation (PCA projection)
 run 2400 python scripts/cagra_r5_exp.py results/cagra_r5_exp4.jsonl
-# 2. driver-format bench (headline + ladder + 10M crossover)
-run 3000 python bench.py
+# 2. driver-format bench (headline + ladder + 10M crossover); keep its
+# stdout JSON line as its own artifact too
+echo "$(date) RUN: bench.py" >> "$LOG"
+timeout 3000 python bench.py > results/bench_r5_local.out 2>> "$LOG"
+echo "$(date) RC=$? : bench.py (results/bench_r5_local.out)" >> "$LOG"
 # 3. DEEP-100M streamed build + search
 run 4200 python scripts/deep100m.py
 # 4. 1M frontier sweep
