@@ -1,0 +1,114 @@
+"""Stage profile of the fused IVF-Flat search at the 1M bench shape
+(VERDICT r5 #5: find the fixed overhead keeping IVF-Flat at 1.25× brute).
+
+Times each stage of _ragged_fused as its own amortized dispatch chain:
+coarse gemm+select, device planning, strip kernel + merge, finalize, and
+the fused whole. Writes JSON lines to results/ivf_profile_r5.jsonl.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from raft_tpu.bench.datasets import sift_like
+from raft_tpu.core.resources import current_resources
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_flat import (_coarse_probes, _finalize_ragged,
+                                         _lens_np, _ragged_plan_static)
+from raft_tpu.ops import strip_scan as ss
+from jax import lax
+
+N = int(os.environ.get("IVFPROF_N", 1_000_000))
+DIM, Q, K = 128, int(os.environ.get("IVFPROF_Q", 10_000)), 10
+NLIST = 1024 if N >= 500_000 else 128
+NPROBE = 16
+INTERP = False  # set per-backend below
+out = open("results/ivf_profile_r5.jsonl", "a", buffering=1)
+
+
+def emit(**kw):
+    line = json.dumps(kw)
+    print(line, flush=True)
+    out.write(line + "\n")
+
+
+def timeit(name, fn, *args, reps=20):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    _ = np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _r in range(reps):
+        o = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[:1]
+    ms = (time.perf_counter() - t0) / reps * 1000
+    emit(stage=name, ms=round(ms, 3))
+    return o
+
+
+data_u8, queries_u8 = sift_like(N, DIM, Q)
+dataset = jnp.asarray(data_u8, jnp.float32)
+queries = jnp.asarray(queries_u8, jnp.float32)
+idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+    n_lists=NLIST, kmeans_trainset_fraction=0.2, group_size=512))
+jax.block_until_ready(idx.list_data)
+res = current_resources()
+emit(stage="built", mls=int(idx.max_list_size))
+
+classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
+    idx, NPROBE, K, res, DIM)
+emit(stage="plan_static", classes=list(classes), q_tile=q_tile)
+qt = min(q_tile, Q)
+
+coarse_fn = jax.jit(lambda qs: _coarse_probes(
+    qs, idx.centers, NPROBE, "sqeuclidean", "packed", res.compute_dtype))
+probes = timeit("coarse_probes(q=10k)", coarse_fn, queries)
+
+region_starts, s_tot, layout = ss.static_layout(
+    classes, class_counts, qt, NPROBE)
+emit(stage="layout", s_tot=int(s_tot))
+
+plan_fn = jax.jit(lambda pr: ss._plan_device(
+    pr[:qt], cls_ord, NLIST, region_starts, s_tot))
+plan = timeit(f"plan_device(qt={qt})", plan_fn, probes)
+qids, strip_list, pair_strip, pair_slot, _ = plan
+
+from raft_tpu.neighbors.ivf_flat import _ragged_bias
+
+bias = _ragged_bias(idx.list_ids, idx.list_norms, None, "l2")
+INTERP = jax.default_backend() != "tpu"
+kernel_fn = jax.jit(lambda qs, a, b, c, d: ss._strip_tile_body(
+    qs[:qt], a, b, c, d, idx.list_data, bias, idx.list_ids,
+    layout, K, K, -2.0, INTERP, None, False))
+try:
+    kv = timeit(f"strip_tile_body(qt={qt})", kernel_fn, queries,
+                qids, strip_list, pair_strip, pair_slot)
+except Exception as e:
+    emit(stage="strip_tile_body", error=repr(e)[:300])
+    kv = None
+
+if kv is not None:
+    fin_fn = jax.jit(lambda v, i, qs: _finalize_ragged(v, i, qs[:qt],
+                                                       "sqeuclidean"))
+    timeit("finalize", fin_fn, kv[0], kv[1], queries)
+
+full = lambda qs: ivf_flat.search(idx, qs, K, n_probes=NPROBE)
+timeit("full_search(q=10k)", full, queries)
+
+# brute anchor at the same batch for the 2x target arithmetic
+from raft_tpu.neighbors import brute_force
+
+bf = brute_force.build(dataset)
+timeit("brute(q=10k)", lambda qs: brute_force.search(
+    bf, qs, K, select_algo="approx"), queries, reps=5)
+emit(stage="done")
