@@ -672,9 +672,18 @@ def _scatter_chunk_cache(cache, list_ids, b_sum, chunk, labels, base,
            else _encode(resid3, codebooks))
     packed = pack_codes(raw, pq_bits)
     scale = jnp.maximum(jnp.max(jnp.abs(codebooks)), 1e-30) / 127.0
-    rec = _decode_lists_scaled(codebooks, packed[None], scale, pq_dim,
-                               pq_bits, cluster)[0]  # (m, rot_dim) int8
-    rec_t = rec[:, :cache_dim]
+    # decode through GL pseudo-lists: one giant take over the whole chunk
+    # is the gather shape class that faults the tunneled TPU runtime
+    # (round-2 finding); 64 slices keep each take at the proven per-list
+    # scale
+    GL = 64
+    mp = -(-m // GL) * GL
+    packed_p = jnp.pad(packed, ((0, mp - m), (0, 0)))
+    rec = _decode_lists_scaled(
+        codebooks, packed_p.reshape(GL, mp // GL, packed.shape[-1]),
+        scale, pq_dim, pq_bits, cluster)
+    rot_dim_full = pq_dim * dsub
+    rec_t = rec.reshape(mp, rot_dim_full)[:m, :cache_dim]
     rf = rec_t.astype(jnp.float32) * scale
     # truncated-space b_sum: 2⟨(Rc_l)[:cd], r̂_t⟩ + ‖r̂_t‖² (the scan's
     # −2⟨q_rot[:cd], r̂_t⟩ completes the cross term; ‖Rc‖² rides
@@ -745,6 +754,11 @@ def build_streaming(
                          "normalize inside chunk_fn and use inner_product")
     if store not in ("codes", "cache"):
         raise ValueError(f"unknown store mode {store!r}")
+    if store == "cache" and params.codebook_kind == "cluster":
+        raise ValueError(
+            "store='cache' supports subspace codebooks only (the chunked "
+            "decode regroups rows across lists, which a per-list codebook "
+            "cannot follow); use store='codes' for PER_CLUSTER")
     pq_dim = params.pq_dim or _auto_pq_dim(dim)
     dsub = -(-dim // pq_dim)
     rot_dim = pq_dim * dsub
