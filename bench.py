@@ -291,6 +291,11 @@ def run_suite():
         best = None
         last_err = None
         for trav, itopk, w in ladder:
+            # compile-cold runs pay ~1 min per rung: stop laddering before
+            # the 10M section's time gate (elapsed<1600) is starved, as
+            # long as at least one rung has landed
+            if best is not None and elapsed() > 1250:
+                break
             sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
                                          traversal=trav)
             try:
