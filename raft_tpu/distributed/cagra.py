@@ -39,12 +39,23 @@ _PAD_SENTINEL = 1e18
 class ShardedCagraIndex:
     """Row-sharded CAGRA: one local graph per shard, stacked on a leading
     (world,) mesh dimension. Graph ids are shard-LOCAL; the search maps
-    them to global ids (rank · rows_per + local)."""
+    them to global ids (rank · rows_per + local).
+
+    When every shard was built with the compressed-traversal payload
+    (CagraParams.compress), the stacked payload rides along and the SPMD
+    search runs each shard's compressed loop (round 5); otherwise the
+    full-precision loop."""
 
     dataset: jax.Array   # (world, rows_per, dim) fp32, P(axis)
     graph: jax.Array     # (world, rows_per, graph_degree) int32, P(axis)
     n_total: int
     comms: Comms
+    proj: Optional[jax.Array] = None        # (world, dim, p), P(axis)
+    code_scale: Optional[jax.Array] = None  # (world,), P(axis)
+    nbr_codes: Optional[jax.Array] = None   # (world, rows_per, deg, p) int8
+    centroids: Optional[jax.Array] = None   # (world, c, dim), P(axis)
+    centroid_reps: Optional[jax.Array] = None  # (world, c) int32, LOCAL ids
+    proj_energy: Optional[jax.Array] = None    # (world,), P(axis)
 
     @property
     def dim(self) -> int:
@@ -81,7 +92,17 @@ def build(
         raise ValueError(
             f"shard rows {rows_per} must exceed graph_degree "
             f"{params.graph_degree}")
+    # resolve compress="auto" ONCE from the GLOBAL row count: per-shard
+    # re-derivation lets one sub-threshold tail shard silently discard
+    # every other shard's built payload (code-review r5)
+    import dataclasses as _dc
+
+    compress_on = params.compress == "on" or (
+        params.compress == "auto" and n >= params.compress_threshold)
+    params = _dc.replace(params, compress="on" if compress_on else "off")
     ds_parts, g_parts = [], []
+    payload = {k: [] for k in ("proj", "code_scale", "nbr_codes",
+                               "centroids", "centroid_reps", "proj_energy")}
     for r in range(world):
         Xr = X[r * rows_per: min((r + 1) * rows_per, n)]
         li = sl.build(Xr, params, res=res)
@@ -94,23 +115,70 @@ def build(
             g = jnp.pad(g, ((0, pad), (0, 0)), constant_values=-1)
         ds_parts.append(d)
         g_parts.append(g)
-    dataset_sh = jax.device_put(jnp.stack(ds_parts),
-                                comms.sharding(comms.axis, None, None))
-    graph_sh = jax.device_put(jnp.stack(g_parts),
-                              comms.sharding(comms.axis, None, None))
-    return ShardedCagraIndex(dataset_sh, graph_sh, n, comms)
+        if li.nbr_codes is not None:
+            payload["proj"].append(li.proj)
+            payload["code_scale"].append(li.code_scale)
+            payload["nbr_codes"].append(jnp.pad(
+                li.nbr_codes, ((0, pad), (0, 0), (0, 0))) if pad
+                else li.nbr_codes)
+            payload["centroids"].append(li.centroids)
+            payload["centroid_reps"].append(li.centroid_reps)
+            payload["proj_energy"].append(
+                li.proj_energy if li.proj_energy is not None
+                else jnp.float32(li.proj.shape[1] / dim))
+
+    def put(parts, spec_extra):
+        return jax.device_put(
+            jnp.stack(parts),
+            comms.sharding(comms.axis, *spec_extra))
+
+    dataset_sh = put(ds_parts, (None, None))
+    graph_sh = put(g_parts, (None, None))
+    opt = {}
+    # the payload rides only when EVERY shard built it (identical params →
+    # all or none); the centroid seeding table additionally needs every
+    # shard to have one of the same shape (small shards skip centroids and
+    # seed randomly inside the compressed loop)
+    core = ("proj", "code_scale", "nbr_codes", "proj_energy")
+    if (len(payload["nbr_codes"]) == world
+            and all(x is not None for kk in core for x in payload[kk])):
+        opt = {
+            "proj": put(payload["proj"], (None, None)),
+            "code_scale": put(payload["code_scale"], ()),
+            "nbr_codes": put(payload["nbr_codes"], (None, None, None)),
+            "proj_energy": put(payload["proj_energy"], ()),
+        }
+        cents = payload["centroids"]
+        if (all(c is not None for c in cents)
+                and len({c.shape for c in cents}) == 1):
+            opt["centroids"] = put(cents, (None, None))
+            opt["centroid_reps"] = put(payload["centroid_reps"], (None,))
+    return ShardedCagraIndex(dataset_sh, graph_sh, n, comms, **opt)
 
 
 @functools.lru_cache(maxsize=64)
 def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
-                    n_total, seed, world=0):
-    def body(shard, graph, queries):
+                    n_total, seed, world=0, compressed=False, rt=0,
+                    has_cents=False):
+    def body(shard, graph, queries, *payload):
         rows = shard.shape[1]
         rank = jax.lax.axis_index(axis)
         key = jax.random.key(seed)
-        vals, local_ids = sl._search_impl(
-            shard[0], graph[0], queries, key, None, rows,
-            k, itopk, width, max_iter, min_iter, n_rand)
+        if compressed:
+            if has_cents:
+                proj, scale, codes, cents, reps, energy = payload
+                cents, reps = cents[0], reps[0]
+            else:
+                proj, scale, codes, energy = payload
+                cents = reps = None
+            vals, local_ids = sl._search_impl_compressed(
+                shard[0], graph[0], codes[0], proj[0], scale[0],
+                cents, reps, energy[0], queries, key, None, rows,
+                k, itopk, width, max_iter, min_iter, n_rand, rt)
+        else:
+            vals, local_ids = sl._search_impl(
+                shard[0], graph[0], queries, key, None, rows,
+                k, itopk, width, max_iter, min_iter, n_rand)
         gids = jnp.where(local_ids >= 0,
                          rank * rows + local_ids, -1).astype(jnp.int32)
         # padded sentinel rows carry ~1e36 distances already; also mask any
@@ -122,9 +190,17 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
 
         return merge_shards(vals, gids, k, axis, world)
 
+    if compressed:
+        pay_specs = (P(axis, None, None), P(axis),
+                     P(axis, None, None, None))
+        if has_cents:
+            pay_specs += (P(axis, None, None), P(axis, None))
+        pay_specs += (P(axis),)
+    else:
+        pay_specs = ()
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None, None), P()),
+        in_specs=(P(axis, None, None), P(axis, None, None), P()) + pay_specs,
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -150,8 +226,18 @@ def search(
     width = int(params.search_width)
     max_iter = int(params.max_iterations) or max(16, itopk // width)
     min_iter = int(min(params.min_iterations, max_iter))
+    mode, rt = sl._resolve_traversal(params, index.nbr_codes is not None,
+                                     int(k), itopk)
+    compressed = mode == "compressed"
+    has_cents = compressed and index.centroids is not None
     fn = _make_search_fn(
         index.comms.mesh, index.comms.axis, int(k), itopk, width, max_iter,
         min_iter, int(max(1, params.num_random_samplings)), index.n_total,
-        int(params.seed), index.comms.size)
+        int(params.seed), index.comms.size, compressed, rt, has_cents)
+    if compressed:
+        args = (index.proj, index.code_scale, index.nbr_codes)
+        if has_cents:
+            args += (index.centroids, index.centroid_reps)
+        args += (index.proj_energy,)
+        return fn(index.dataset, index.graph, queries, *args)
     return fn(index.dataset, index.graph, queries)

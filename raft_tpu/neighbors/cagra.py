@@ -973,6 +973,29 @@ def _search_impl_compressed(
     return out_d, out_ids
 
 
+
+def _resolve_traversal(params: CagraSearchParams, has_payload: bool,
+                       k: int, itopk: int):
+    """Resolve the traversal mode + exact-re-rank depth once for every
+    search wrapper (single-device and distributed share this — the two
+    copies had already drifted, code-review r5). Returns
+    ``(mode, refine_topk)`` with refine_topk = 0 for the exact loop."""
+    mode = params.traversal
+    if mode == "auto":
+        mode = "compressed" if has_payload else "exact"
+    elif mode == "compressed" and not has_payload:
+        raise ValueError(
+            "traversal='compressed' needs the compression payload "
+            "(build with CagraParams.compress)")
+    rt = 0
+    if mode == "compressed":
+        rt = int(params.refine_topk) or itopk
+        if not k <= rt <= itopk:
+            raise ValueError(
+                f"refine_topk={rt} must be in [k={k}, itopk={itopk}]")
+    return mode, rt
+
+
 @traced("cagra::search")
 def search(
     index: CagraIndex,
@@ -1003,13 +1026,8 @@ def search(
     max_iter = int(params.max_iterations) or max(16, itopk // width)
     min_iter = int(min(params.min_iterations, max_iter))
     key = jax.random.key(params.seed)
-    mode = params.traversal
-    if mode == "auto":
-        mode = "compressed" if index.nbr_codes is not None else "exact"
-    elif mode == "compressed" and index.nbr_codes is None:
-        raise ValueError(
-            "traversal='compressed' needs an index built with the "
-            "compression payload (CagraParams.compress)")
+    mode, rt = _resolve_traversal(params, index.nbr_codes is not None,
+                                  int(k), itopk)
 
     # query tiling: one traversal's live set is ~per_q bytes/query (the
     # (b, b) dedup compares + gathered codes/vectors + merge passes);
@@ -1038,10 +1056,6 @@ def search(
             qs = jnp.pad(qs, ((0, q_tile - qs.shape[0]), (0, 0)))
         tkey = jax.random.fold_in(key, ti) if ti else key
         if mode == "compressed":
-            rt = int(params.refine_topk) or itopk
-            if not k <= rt <= itopk:
-                raise ValueError(
-                    f"refine_topk={rt} must be in [k={k}, itopk={itopk}]")
             outs.append(_search_impl_compressed(
                 index.dataset, index.graph, index.nbr_codes, index.proj,
                 index.code_scale, index.centroids, index.centroid_reps,
