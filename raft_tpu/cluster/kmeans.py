@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.resources import Resources, current_resources, use_resources
 from raft_tpu.ops.distance import fused_l2_nn_argmin, pairwise_distance
 
@@ -212,6 +213,12 @@ def fit(
         # euclidean objective = sum of distances, not sum of squares
         d, _ = fused_l2_nn_argmin(X, best.centroids, sqrt=True, res=res)
         best = best._replace(inertia=jnp.sum(d * weights))
+    if obs.enabled():
+        obs.add("kmeans.fits", 1)
+        obs.add("kmeans.rows", n)
+        # int() is a host fetch — paid only with telemetry on; the EM loop
+        # itself stays one sync-free compiled program
+        obs.add("kmeans.iterations", int(best.n_iter))
     return best
 
 

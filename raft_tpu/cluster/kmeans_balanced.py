@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.resources import Resources, current_resources, use_resources
 from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t
 
@@ -185,6 +186,12 @@ def _fit_full(X, n_clusters, params, res):
     # choice(replace=False)'s O(n log n) permutation compile (round 3)
     rows = jax.random.randint(k_init, (n_clusters,), 0, n)
     centers0 = X[rows].astype(jnp.float32)
+    if obs.enabled():
+        obs.add("kmeans_balanced.fits", 1)
+        obs.add("kmeans_balanced.rows", n)
+        # configured, not executed: the balancing loop may run up to 5× this
+        # (_balanced_em does not surface its actual count)
+        obs.add("kmeans_balanced.iterations_configured", int(params.n_iters))
     with use_resources(res):
         return _balanced_em(
             X.astype(jnp.float32),

@@ -17,7 +17,7 @@ from raft_tpu.core.serialize import (
     load_arrays,
 )
 from raft_tpu.core.bitset import Bitset
-from raft_tpu.core.logger import get_logger
+from raft_tpu.core.logger import get_logger, set_level
 from raft_tpu.core.interruptible import InterruptedException, check_interrupt, cancel, clear
 
 __all__ = [
@@ -30,6 +30,7 @@ __all__ = [
     "load_arrays",
     "Bitset",
     "get_logger",
+    "set_level",
     "InterruptedException",
     "check_interrupt",
     "cancel",

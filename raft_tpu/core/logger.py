@@ -3,20 +3,28 @@
 Reference: cpp/include/raft/core/logger-inl.hpp:74-89 (callback sink so Python
 can capture C++ logs), logger-macros.hpp (RAFT_LOG_*). Here the whole stack is
 Python, so we use stdlib logging with the same capability: a process-wide named
-logger plus an optional callback sink.
+logger plus an optional callback sink. :func:`set_level` is the
+``RAFT_LOG_LEVEL`` / ``set_log_level`` analog.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 _LOGGER_NAME = "raft_tpu"
+
+# One formatter shared by every sink: callback sinks must see the same
+# "[LEVEL] [name] msg" rendering as the stream handler (a bare
+# self.format(record) with no formatter installed hands callbacks the raw
+# message only — the reference's log_callback receives the formatted line).
+_FORMATTER = logging.Formatter("[%(levelname)s] [%(name)s] %(message)s")
 
 
 class _CallbackHandler(logging.Handler):
     def __init__(self, fn: Callable[[int, str], None]):
         super().__init__()
+        self.setFormatter(_FORMATTER)
         self._fn = fn
 
     def emit(self, record: logging.LogRecord) -> None:
@@ -30,10 +38,21 @@ def get_logger() -> logging.Logger:
     logger = logging.getLogger(_LOGGER_NAME)
     if not logger.handlers:
         handler = logging.StreamHandler()
-        handler.setFormatter(logging.Formatter("[%(levelname)s] [%(name)s] %(message)s"))
+        handler.setFormatter(_FORMATTER)
         logger.addHandler(handler)
         logger.setLevel(logging.WARNING)
     return logger
+
+
+def set_level(level: Union[int, str]) -> None:
+    """Set the process-wide raft_tpu log level (RAFT_LOG_* analog,
+    logger-macros.hpp). Accepts a stdlib level int or a name like "debug"."""
+    if isinstance(level, str):
+        resolved = logging.getLevelName(level.upper())
+        if not isinstance(resolved, int):
+            raise ValueError(f"unknown log level {level!r}")
+        level = resolved
+    get_logger().setLevel(level)
 
 
 def set_callback_sink(fn: Optional[Callable[[int, str], None]]) -> None:

@@ -5,6 +5,12 @@ appear on the host timeline of a `jax.profiler.trace(...)` capture. The
 ``traced`` decorator is the `RAFT_USING_RANGE`-style entry-point annotation
 used across build/search paths; it costs one context manager per call (not
 per device op) and nothing when no trace is active.
+
+When telemetry is enabled (``RAFT_TPU_OBS=1`` / :func:`raft_tpu.obs.enable`),
+``traced`` routes through :func:`raft_tpu.obs.record_span` instead, which
+wraps the same TraceAnnotation AND records the wall-clock duration into the
+process-wide metrics registry — every ``@traced`` entry point becomes a
+measured span for free. Off-path cost stays one branch.
 """
 
 from __future__ import annotations
@@ -12,6 +18,8 @@ from __future__ import annotations
 import functools
 
 import jax.profiler
+
+from raft_tpu import obs as _obs
 
 
 class trace_range(jax.profiler.TraceAnnotation):
@@ -23,11 +31,15 @@ class trace_range(jax.profiler.TraceAnnotation):
 
 
 def traced(name: str):
-    """Decorator wrapping a function body in a named trace range."""
+    """Decorator wrapping a function body in a named trace range (and, when
+    telemetry is on, a registry-fed timing span)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            if _obs.enabled():
+                with _obs.record_span(name):
+                    return fn(*args, **kwargs)
             with jax.profiler.TraceAnnotation(name):
                 return fn(*args, **kwargs)
 

@@ -15,6 +15,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors import _packing
 from raft_tpu.ops.select_k import select_k
@@ -69,8 +70,14 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
         out_specs=(P(axis, None), P(axis, None)),
         check_vma=False,
     ))
-    labels_sh, counts_sh = fn(work_sh, gids_sh)
-    return labels_sh, np.asarray(counts_sh)
+    with obs.record_span("distributed::assign_phase"):
+        labels_sh, counts_sh = fn(work_sh, gids_sh)
+        counts_np = np.asarray(counts_sh)
+    if obs.enabled():
+        obs.add("distributed.assign.shards", comms.size)
+        obs.add("distributed.assign.rows",
+                int(work_sh.shape[0]) * int(work_sh.shape[1]))
+    return labels_sh, counts_np
 
 
 def round_mls(max_count: int, group: int) -> int:
@@ -206,28 +213,37 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                         dim=queries_mat.shape[1])
     out_v, out_i = [], []
     start = 0
+    n_tiles = 0
     zero = jnp.zeros((1,), jnp.int32)
     zero2 = jnp.zeros((1, 1), jnp.int32)
-    while start < q:
-        qt = min(q_tile, q - start)
-        if dense:
-            # dense_local_scan never reads the strip tables: skip the
-            # planning dispatch + its counts round-trip entirely
-            qids, strip_list, pair_strip, pair_slot = zero2, zero, zero2, zero2
-            layout = ((1, 1, 0, 1),)
-        else:
-            qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
-                probes, start, qt, cls_ord, classes, n_lists)
-        fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
-                          kf, dense, interpret, alpha, comms.size)
-        v, i = fn(queries_mat[start:start + qt],
-                  jax.lax.slice_in_dim(probes, start, start + qt, axis=0),
-                  pair_const[start:start + qt],
-                  qids, strip_list, pair_strip, pair_slot,
-                  data, ids_arr, bias)
-        out_v.append(v)
-        out_i.append(i)
-        start += qt
+    with obs.record_span("distributed::tiled_search"):
+        while start < q:
+            qt = min(q_tile, q - start)
+            if dense:
+                # dense_local_scan never reads the strip tables: skip the
+                # planning dispatch + its counts round-trip entirely
+                qids, strip_list, pair_strip, pair_slot = (
+                    zero2, zero, zero2, zero2)
+                layout = ((1, 1, 0, 1),)
+            else:
+                qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
+                    probes, start, qt, cls_ord, classes, n_lists)
+            fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
+                              kf, dense, interpret, alpha, comms.size)
+            v, i = fn(queries_mat[start:start + qt],
+                      jax.lax.slice_in_dim(probes, start, start + qt, axis=0),
+                      pair_const[start:start + qt],
+                      qids, strip_list, pair_strip, pair_slot,
+                      data, ids_arr, bias)
+            out_v.append(v)
+            out_i.append(i)
+            start += qt
+            n_tiles += 1
+    if obs.enabled():
+        obs.add("distributed.search.shards", comms.size)
+        obs.add("distributed.search.queries", q)
+        obs.add("distributed.search.probes", q * p)
+        obs.add("distributed.search.tiles", n_tiles)
     vals = out_v[0] if len(out_v) == 1 else jnp.concatenate(out_v, 0)
     ids = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, 0)
     return vals, ids

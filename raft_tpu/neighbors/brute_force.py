@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
@@ -196,6 +197,11 @@ def search(
             per_col = max(1, q * index.dim * 4)
         tile_rows = int(min(n, max(k, res.workspace_bytes // per_col)))
     tile_rows = max(min(tile_rows, n), min(n, k))
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("brute_force.search.queries", q_obs)
+        obs.add("brute_force.search.rows_scanned", q_obs * n)
+        obs.add("brute_force.search.tiles", ceil_div(n, int(tile_rows)))
     return _search_impl(
         queries,
         index.dataset,

@@ -69,6 +69,7 @@ from jax import lax
 
 import os as _os
 
+from raft_tpu import obs
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
@@ -578,6 +579,10 @@ def build(
         _sync(out.nbr_codes)
         timings["compress"] = _time.perf_counter() - t0
     out._build_timings_s = {k: round(v, 2) for k, v in timings.items()}
+    if obs.enabled():
+        obs.add("cagra.build.nodes", n)
+        for phase, secs in timings.items():
+            obs.record_timing(f"cagra.build.{phase}", secs)
     return out
 
 
@@ -1047,6 +1052,12 @@ def search(
     n_tiles = ceil_div(nq, q_tile)
     q_tile = ceil_div(nq, n_tiles)  # equalize; pad the tail tile below so
     # every dispatch shares ONE compiled shape
+
+    if obs.enabled():
+        obs.add("cagra.search.queries", nq)
+        obs.add("cagra.search.tiles", n_tiles)
+        obs.add("cagra.search.iterations", nq * max_iter)
+        obs.add(f"cagra.search.traversal.{mode}", 1)
 
     fb = filter.bits if filter is not None else None
     outs = []

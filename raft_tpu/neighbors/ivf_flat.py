@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -196,6 +197,10 @@ def build(
         labels = kmeans_balanced.predict(work, centers, km, res=res)
     else:
         centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+
+    if obs.enabled():
+        obs.add("ivf_flat.build.rows", n)
+        obs.add("ivf_flat.build.lists", params.n_lists)
 
     group = params.group_size or _packing.auto_group_size(n, params.n_lists)
     cap = params.list_size_cap
@@ -549,6 +554,15 @@ def search(
         backend = "ragged" if jax.default_backend() == "tpu" and aligned else "gather"
     if backend not in ("ragged", "gather"):
         raise ValueError(f"unknown backend {backend!r}")
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_flat.search.queries", q_obs)
+        obs.add("ivf_flat.search.probes", q_obs * n_probes)
+        # padded upper bound on candidate rows visited (the ragged backend's
+        # actual work is ∝ real list fills; this is telemetry, not billing)
+        obs.add("ivf_flat.search.rows_scanned",
+                q_obs * n_probes * index.max_list_size)
+        obs.add(f"ivf_flat.search.backend.{backend}", 1)
     if backend == "ragged":
         if not aligned:
             raise ValueError(

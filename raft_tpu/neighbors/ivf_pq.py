@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -501,6 +502,10 @@ def build(
         codebooks = _train_codebooks(
             resid_cb.transpose(1, 0, 2), k_cb, n_codes,
             params.codebook_n_iters)
+
+    if obs.enabled():
+        obs.add("ivf_pq.build.rows", n)
+        obs.add("ivf_pq.build.lists", params.n_lists)
 
     group = params.group_size or _packing.auto_group_size(n, params.n_lists, floor=128)
     cap = params.list_size_cap
@@ -1465,6 +1470,15 @@ def search(
         # the LUT kernel's table is per query; PER_CLUSTER tables are per
         # list — served by the strip cache / gather paths instead
         backend = "ragged" if aligned and jax.default_backend() == "tpu" else "gather"
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_pq.search.queries", q_obs)
+        obs.add("ivf_pq.search.probes", q_obs * n_probes)
+        # padded upper bound on candidate rows visited (the ragged backend's
+        # actual work is ∝ real list fills; this is telemetry, not billing)
+        obs.add("ivf_pq.search.rows_scanned",
+                q_obs * n_probes * index.max_list_size)
+        obs.add(f"ivf_pq.search.backend.{backend}", 1)
     if backend == "ragged":
         if not aligned:
             raise ValueError(

@@ -1,0 +1,299 @@
+"""Process-wide metrics registry: counters, wall-clock timers, histograms.
+
+The telemetry analog of the reference's NVTX-range + spdlog infrastructure
+(cpp/include/raft/core/nvtx.hpp, logger.hpp) — except measured, not just
+annotated: every :func:`record_span` feeds BOTH the profiler timeline
+(``jax.profiler.TraceAnnotation``, the NVTX-range analog core/trace.py already
+provides) and this registry, so hot-path timings survive the process even when
+no profiler capture is active.
+
+Zero-dep and thread-safe (one ``threading.Lock`` around the maps; jax.profiler
+is imported lazily and only when a span actually opens). Telemetry is OFF by
+default: the gate is the ``RAFT_TPU_OBS`` env var (or :func:`enable` /
+:func:`disable` at runtime), and every instrumented hot path guards its
+emission with ``if obs.enabled():`` so the disabled cost is a single branch.
+When disabled, :func:`record_span` returns one shared no-op context manager
+(``NOOP_SPAN`` — identity-testable, which is how the overhead contract is
+asserted in tests) and never touches the registry.
+
+Span timings are host wall-clock around the instrumented region. JAX dispatch
+is asynchronous, so a span around a pure-dispatch region measures dispatch +
+trace/compile time, not device execution — that is the useful number for the
+wedge-hunting this layer exists for (VERDICT.md round 5: the failure modes are
+host-side hangs, not slow kernels).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "MetricsRegistry",
+    "NOOP_SPAN",
+    "add",
+    "disable",
+    "enable",
+    "enabled",
+    "export_jsonl",
+    "observe",
+    "record_span",
+    "record_timing",
+    "registry",
+    "reset",
+    "snapshot",
+]
+
+_enabled = os.environ.get("RAFT_TPU_OBS", "").strip().lower() in (
+    "1", "true", "on", "yes",
+)
+
+
+def enabled() -> bool:
+    """The single-branch hot-path gate: instrumented code runs its emission
+    only under ``if obs.enabled():``."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+class _TimerStat:
+    """count / total / min / max of one named wall-clock timer."""
+
+    __slots__ = ("count", "total_s", "min_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = math.inf
+        self.max_s = 0.0
+
+    def add(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds < self.min_s:
+            self.min_s = seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": self.total_s,
+            "min_s": self.min_s,
+            "max_s": self.max_s,
+            "mean_s": self.total_s / self.count if self.count else 0.0,
+        }
+
+
+class _HistStat:
+    """Power-of-two-bucketed histogram (+ count/sum/min/max exact)."""
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: dict = {}
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        # bucket upper bound = smallest power of two >= value (0 for v <= 0)
+        bound = 0.0 if value <= 0 else 2.0 ** math.ceil(math.log2(value))
+        key = f"le_{bound:g}"
+        self.buckets[key] = self.buckets.get(key, 0) + 1
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "buckets": dict(self.buckets),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named counters + timers + histograms with dict snapshots
+    and JSONL export. One process-wide default instance lives in this module
+    (:func:`registry`); algorithms never construct their own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._timers: dict = {}
+        self._hists: dict = {}
+
+    # -- writes -------------------------------------------------------------
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def record_timing(self, name: str, seconds: float) -> None:
+        with self._lock:
+            stat = self._timers.get(name)
+            if stat is None:
+                stat = self._timers[name] = _TimerStat()
+            stat.add(seconds)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            stat = self._hists.get(name)
+            if stat is None:
+                stat = self._hists[name] = _HistStat()
+            stat.add(value)
+
+    # -- reads --------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Plain-dict copy: {"counters": .., "timers": .., "histograms": ..}.
+        Empty sections are included so consumers need no key checks."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {k: v.as_dict() for k, v in self._timers.items()},
+                "histograms": {k: v.as_dict() for k, v in self._hists.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._hists.clear()
+
+    def export_jsonl(self, path, extra: Optional[dict] = None) -> dict:
+        """Append one timestamped snapshot line to ``path``; returns the
+        record written. ``extra`` keys ride at the top level (run ids, phase
+        tags)."""
+        rec = {"t": round(time.time(), 3), **(extra or {}), **self.snapshot()}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+_ANN_UNRESOLVED = object()
+_ann_cls = _ANN_UNRESOLVED
+
+
+def _trace_annotation():
+    """jax.profiler.TraceAnnotation, resolved lazily (the registry must stay
+    importable in jax-free parent processes like bench.py's orchestrator);
+    None when jax is unavailable."""
+    global _ann_cls
+    if _ann_cls is _ANN_UNRESOLVED:
+        try:
+            import jax.profiler
+
+            _ann_cls = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover - jax is present in this repo
+            _ann_cls = None
+    return _ann_cls
+
+
+class _Span:
+    """Context manager: profiler trace annotation + registry wall-clock."""
+
+    __slots__ = ("_name", "_reg", "_t0", "_ann")
+
+    def __init__(self, name: str, reg: MetricsRegistry):
+        self._name = name
+        self._reg = reg
+
+    def __enter__(self):
+        ann_cls = _trace_annotation()
+        self._ann = ann_cls(self._name) if ann_cls is not None else None
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        self._reg.record_timing(self._name, dt)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span handed out whenever telemetry is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _default
+
+
+def record_span(name: str, reg: Optional[MetricsRegistry] = None):
+    """``with obs.record_span("ivf_pq::search"): ...`` — times the block into
+    the registry AND marks it on the profiler timeline. When telemetry is
+    disabled this returns the shared :data:`NOOP_SPAN` (no allocation, no
+    registry touch)."""
+    if not _enabled:
+        return NOOP_SPAN
+    return _Span(name, reg if reg is not None else _default)
+
+
+def add(name: str, value: float = 1) -> None:
+    if _enabled:
+        _default.add(name, value)
+
+
+def record_timing(name: str, seconds: float) -> None:
+    if _enabled:
+        _default.record_timing(name, seconds)
+
+
+def observe(name: str, value: float) -> None:
+    if _enabled:
+        _default.observe(name, value)
+
+
+def snapshot() -> dict:
+    return _default.snapshot()
+
+
+def reset() -> None:
+    _default.reset()
+
+
+def export_jsonl(path, extra: Optional[dict] = None) -> dict:
+    return _default.export_jsonl(path, extra)

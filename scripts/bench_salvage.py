@@ -1,0 +1,62 @@
+"""Reconstruct a BENCH metric line from a killed run's checkpoint file.
+
+Round 5 ended with ``BENCH_r05.json`` = ``rc=124, tail="", parsed=null``: the
+run was killed mid-suite and every finished section's numbers died with the
+process. bench.py now checkpoints each completed section to
+``results/bench_progress.jsonl`` (bench/progress.py); this script turns that
+file into the best-available single JSON metric line — tagged
+``"salvaged": true`` — so a future rc=124 still yields a number of record.
+
+Usage:
+    python scripts/bench_salvage.py [results/bench_progress.jsonl]
+
+Prints the salvaged metric line on stdout (exit 0), or a diagnostic on
+stderr (exit 2) when no completed section with a positive QPS exists.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# progress.py by FILE PATH, not package import: this tool's one job is to run
+# right after a wedged/killed bench round — the environment where importing
+# the jax-heavy raft_tpu package is exactly what must be avoided (bench.py's
+# parent uses the same route for the same reason)
+_spec = importlib.util.spec_from_file_location(
+    "_bench_progress", os.path.join(_REPO, "raft_tpu", "bench", "progress.py"))
+_progress = importlib.util.module_from_spec(_spec)
+sys.modules["_bench_progress"] = _progress
+_spec.loader.exec_module(_progress)
+DEFAULT_PATH = _progress.DEFAULT_PATH
+read_progress = _progress.read_progress
+salvage = _progress.salvage
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    path = argv[0] if argv else DEFAULT_PATH
+    if not os.path.exists(path):
+        print(f"bench_salvage: no progress file at {path}", file=sys.stderr)
+        return 2
+    records = read_progress(path)
+    if not records:
+        print(f"bench_salvage: {path} holds no parseable records",
+              file=sys.stderr)
+        return 2
+    line = salvage(records, source=path)
+    if line is None:
+        kinds = {}
+        for r in records:
+            kinds[r.get("type", "?")] = kinds.get(r.get("type", "?"), 0) + 1
+        print(f"bench_salvage: no completed section with a positive QPS in "
+              f"{path} (records: {kinds})", file=sys.stderr)
+        return 2
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

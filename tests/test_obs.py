@@ -1,0 +1,340 @@
+"""Telemetry layer tests: registry arithmetic under threads, span timing,
+single-branch disabled gate, health-probe bounds, bench heartbeat + salvage
+(ISSUE 1 acceptance criteria)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.bench.progress import (
+    ProgressWriter,
+    read_progress,
+    salvage,
+)
+from raft_tpu.obs.registry import MetricsRegistry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    """Enable the global gate for one test, leaving a clean slate after."""
+    obs.reset()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_under_threads():
+    reg = MetricsRegistry()
+    n_threads, per = 8, 500
+
+    def worker(i):
+        for j in range(per):
+            reg.add("hits")
+            reg.add("bytes", 3)
+            reg.record_timing("op", 0.001)
+            reg.observe("batch", j + 1)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["hits"] == n_threads * per
+    assert snap["counters"]["bytes"] == 3 * n_threads * per
+    assert snap["timers"]["op"]["count"] == n_threads * per
+    assert snap["timers"]["op"]["total_s"] == pytest.approx(
+        0.001 * n_threads * per, rel=1e-6)
+    hist = snap["histograms"]["batch"]
+    assert hist["count"] == n_threads * per
+    assert hist["min"] == 1 and hist["max"] == per
+    assert sum(hist["buckets"].values()) == hist["count"]
+
+
+def test_registry_reset_and_export(tmp_path):
+    reg = MetricsRegistry()
+    reg.add("a", 2)
+    reg.record_timing("t", 0.5)
+    path = str(tmp_path / "obs.jsonl")
+    reg.export_jsonl(path, extra={"phase": "x"})
+    reg.export_jsonl(path)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["phase"] == "x"
+    assert lines[0]["counters"]["a"] == 2
+    assert lines[0]["timers"]["t"]["count"] == 1
+    reg.reset()
+    assert reg.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+
+
+def test_record_span_timing_monotonic(telemetry):
+    with obs.record_span("unit::sleep"):
+        time.sleep(0.02)
+    with obs.record_span("unit::sleep"):
+        time.sleep(0.005)
+    t = obs.snapshot()["timers"]["unit::sleep"]
+    assert t["count"] == 2
+    assert t["min_s"] > 0.0
+    assert t["max_s"] >= 0.02
+    assert t["min_s"] <= t["mean_s"] <= t["max_s"]
+    assert t["total_s"] >= t["max_s"]
+
+
+def test_disabled_gate_is_noop():
+    """The off-path contract: disabled record_span hands out ONE shared
+    no-op object (no allocation, no registry write) and module-level
+    counter helpers never touch the registry."""
+    assert not obs.enabled()
+    s1 = obs.record_span("x")
+    s2 = obs.record_span("y")
+    assert s1 is s2 is obs.NOOP_SPAN
+    with s1:
+        pass
+    obs.add("never", 5)
+    obs.record_timing("never", 1.0)
+    obs.observe("never", 1.0)
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+
+
+def test_span_records_on_exception(telemetry):
+    with pytest.raises(RuntimeError):
+        with obs.record_span("unit::boom"):
+            raise RuntimeError("boom")
+    assert obs.snapshot()["timers"]["unit::boom"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Hot-path instrumentation (acceptance: IVF-PQ build+search on CPU yields
+# build and search spans with positive durations)
+# ---------------------------------------------------------------------------
+
+
+def test_ivf_pq_build_search_spans(telemetry, rng):
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq
+
+    data = jnp.asarray(rng.standard_normal((512, 16), dtype=np.float32))
+    queries = jnp.asarray(rng.standard_normal((8, 16), dtype=np.float32))
+    index = ivf_pq.build(data, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8))
+    vals, ids = ivf_pq.search(index, queries, 5, n_probes=4)
+    np.asarray(vals)  # force completion inside the measured session
+    snap = obs.snapshot()
+    for span in ("ivf_pq::build", "ivf_pq::search"):
+        assert span in snap["timers"], snap["timers"].keys()
+        assert snap["timers"][span]["count"] >= 1
+        assert snap["timers"][span]["total_s"] > 0.0
+    assert snap["counters"]["ivf_pq.build.rows"] == 512
+    assert snap["counters"]["ivf_pq.search.queries"] == 8
+    assert snap["counters"]["ivf_pq.search.probes"] == 8 * 4
+    assert any(k.startswith("ivf_pq.search.backend.")
+               for k in snap["counters"])
+    # kmeans ran inside the build and reported its iterations
+    assert snap["counters"]["kmeans_balanced.fits"] >= 1
+
+
+def test_instrumented_path_untouched_when_disabled(rng):
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force
+
+    assert not obs.enabled()
+    data = jnp.asarray(rng.standard_normal((64, 8), dtype=np.float32))
+    brute_force.knn(data[:4], data, 3)
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+
+
+# ---------------------------------------------------------------------------
+# Health probe
+# ---------------------------------------------------------------------------
+
+
+def test_health_probe_hang_bounded():
+    t0 = time.monotonic()
+    report = obs.probe("default", timeout=2.0,
+                       child_code="import time\ntime.sleep(300)\n")
+    elapsed = time.monotonic() - t0
+    assert not report.healthy
+    assert "timed out" in report.reason
+    assert elapsed < obs.MAX_TIMEOUT  # the ≤30 s verdict bound
+    assert report.elapsed_s < obs.MAX_TIMEOUT
+
+
+@pytest.mark.slow  # waits out the full 30 s MAX_TIMEOUT clamp
+def test_health_probe_timeout_clamped():
+    report = obs.probe("default", timeout=10_000.0,
+                       child_code="import time\ntime.sleep(300)\n")
+    assert not report.healthy
+    assert report.elapsed_s <= obs.MAX_TIMEOUT + 2.0
+
+
+def test_health_probe_sentinel_parsing():
+    report = obs.probe("default", timeout=10.0,
+                       child_code="print('RAFT_TPU_HEALTH_OK cpu 42.0')\n")
+    assert report.healthy
+    assert report.backend == "cpu"
+    assert report.reason == ""
+    bad = obs.probe("default", timeout=10.0,
+                    child_code="import sys\nsys.exit(3)\n")
+    assert not bad.healthy
+    assert "rc=3" in bad.reason
+
+
+@pytest.mark.slow
+def test_health_probe_real_cpu():
+    report = obs.probe("cpu", timeout=30.0)
+    assert report.healthy, report.reason
+    assert report.backend == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Progress writer + salvage
+# ---------------------------------------------------------------------------
+
+
+def _fake_progress(path):
+    w = ProgressWriter(path, platform="cpu", pulse_interval_s=0.05)
+    w.start({"n": 100_000, "dim": 64, "q": 1_000, "k": 10,
+             "dataset": "siftlike-100k-64"})
+    w.set_section("brute_force")
+    w.section("brute_force", {"qps": 1234.5, "recall": 1.0})
+    w.section("ivf_flat", {"qps": 4321.0, "recall": 0.97, "nprobe": 32})
+    time.sleep(0.12)  # let at least one heartbeat land
+    w.finish({"metric": "x", "value": 1.0})
+    return w
+
+
+def test_progress_writer_records(tmp_path):
+    path = str(tmp_path / "p.jsonl")
+    _fake_progress(path)
+    recs = read_progress(path)
+    types = [r["type"] for r in recs]
+    assert types[0] == "run_start"
+    assert "run_end" in types  # not necessarily last: the pulse thread races
+    assert "heartbeat" in types
+    sections = [r["name"] for r in recs if r["type"] == "section"]
+    assert sections == ["brute_force", "ivf_flat"]
+    assert all("t" in r and "elapsed_s" in r for r in recs)
+
+
+def test_salvage_prefers_ivf_pq_order(tmp_path):
+    path = str(tmp_path / "p.jsonl")
+    _fake_progress(path)
+    # torn trailing line (the kill can land mid-write)
+    with open(path, "a") as f:
+        f.write('{"type": "sec')
+    line = salvage(read_progress(path), source=path)
+    assert line is not None
+    assert line["salvaged"] is True
+    # shape tag must match what a LIVE run of this config would emit
+    assert line["metric"] == "ivf_flat_qps_siftlike100k_64d_k10_recall0.97"
+    assert line["value"] == 4321.0
+    assert line["unit"] == "QPS"
+    assert line["recall_gate_met"] is True
+    assert line["platform"] == "cpu"
+    assert line["extras"]["brute_force"]["qps"] == 1234.5
+
+
+def test_salvage_uses_last_run_only(tmp_path):
+    path = str(tmp_path / "p.jsonl")
+    w = ProgressWriter(path, platform="tpu", pulse_interval_s=60)
+    w.start({"dataset": "siftlike-1000k-128"})
+    w.section("ivf_pq", {"qps": 9e5, "recall": 0.96})
+    w.finish()
+    w2 = ProgressWriter(path, platform="cpu", pulse_interval_s=60)
+    w2.start({"dataset": "siftlike-100k-64"})
+    w2.section("brute_force", {"qps": 100.0, "recall": 1.0})
+    w2.finish()
+    line = salvage(read_progress(path))
+    # the TPU attempt's ivf_pq section must NOT leak into the CPU retry
+    assert line["metric"].startswith("brute_force_qps_siftlike-100k-64")
+    assert "recall" not in line["metric"]  # anchor carries no recall suffix
+    assert line["value"] == 100.0
+
+
+def test_salvage_falls_back_past_sectionless_retry(tmp_path):
+    """A retry that died before its first checkpoint must not discard the
+    previous attempt's real numbers (code-review round-6 finding)."""
+    path = str(tmp_path / "p.jsonl")
+    w = ProgressWriter(path, platform="tpu", pulse_interval_s=60)
+    w.start({"n": 1_000_000, "dim": 128, "k": 10,
+             "dataset": "siftlike-1000k-128"})
+    w.section("brute_force", {"qps": 129_000.0, "recall": 1.0})
+    w.section("ivf_pq", {"qps": 136_900.0, "recall": 0.9615})
+    w2 = ProgressWriter(path, platform="cpu", pulse_interval_s=60)
+    w2.start({"n": 100_000, "dim": 64, "k": 10,
+              "dataset": "siftlike-100k-64"})  # dies before any section
+    line = salvage(read_progress(path))
+    assert line is not None
+    assert line["metric"] == "ivf_pq_qps_siftlike1000k_128d_k10_recall0.9615"
+    assert line["value"] == 136_900.0
+    assert line["platform"] == "tpu"
+
+
+def test_salvage_empty_and_sectionless():
+    assert salvage([]) is None
+    assert salvage([{"type": "run_start", "config": {}},
+                    {"type": "heartbeat", "section": "ivf_pq"}]) is None
+    # a section that died before producing a qps is not salvageable
+    assert salvage([{"type": "section", "name": "cagra",
+                     "data": {"error": "boom"}}]) is None
+
+
+# ---------------------------------------------------------------------------
+# bench.py child-mode smoke test: heartbeat lines appear per section
+# ---------------------------------------------------------------------------
+
+
+def test_bench_child_heartbeat_smoke(tmp_path):
+    hb = str(tmp_path / "bench_progress.jsonl")
+    env = dict(os.environ)
+    env.update(
+        RAFT_TPU_BENCH_CHILD="cpu",
+        RAFT_TPU_BENCH_TINY="1",
+        RAFT_TPU_BENCH_SECTIONS="brute_force,ivf_flat",
+        RAFT_TPU_BENCH_HEARTBEAT=hb,
+    )
+    env.pop("JAX_PLATFORMS", None)  # child uses the config route
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    final = json.loads(
+        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1])
+    assert final["metric"].startswith("ivf_flat_qps_")  # headline fallback
+    recs = read_progress(hb)
+    sections = {r["name"]: r for r in recs if r["type"] == "section"}
+    assert set(sections) == {"brute_force", "ivf_flat"}
+    assert all(sections[s]["data"]["qps"] > 0 for s in sections)
+    assert recs[0]["type"] == "run_start" and recs[0]["config"]["tiny"]
+    assert any(r["type"] == "run_end" for r in recs)
+
+    # the salvage CLI turns the same file into one valid metric line
+    # (acceptance: a killed run + bench_salvage still yields a number)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "bench_salvage.py"),
+         hb],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    line = json.loads(out.stdout)
+    assert line["salvaged"] is True and line["value"] > 0

@@ -1,0 +1,151 @@
+"""core/trace.py + core/logger.py coverage (ISSUE 1 satellite: both were
+untested despite being the emission spine of the new telemetry layer)."""
+
+import logging
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.core.logger import get_logger, set_callback_sink, set_level
+from raft_tpu.core.trace import trace_range, traced
+
+
+# ---------------------------------------------------------------------------
+# trace
+# ---------------------------------------------------------------------------
+
+
+def test_traced_preserves_metadata_and_return():
+    @traced("unit::double")
+    def double(x, y=1):
+        """Doc survives wrapping."""
+        return 2 * x + y
+
+    assert double.__name__ == "double"
+    assert double.__doc__ == "Doc survives wrapping."
+    assert double(3) == 7
+    assert double(3, y=2) == 8
+
+
+def test_traced_propagates_exceptions():
+    @traced("unit::boom")
+    def boom():
+        raise KeyError("k")
+
+    with pytest.raises(KeyError):
+        boom()
+
+
+def test_trace_range_nests():
+    with trace_range("outer"):
+        with trace_range("inner"):
+            with trace_range("inner"):  # same name re-entered
+                pass
+        with trace_range("sibling"):
+            pass
+
+
+def test_traced_feeds_registry_when_enabled():
+    @traced("unit::traced_span")
+    def f():
+        return 41
+
+    obs.reset()
+    obs.enable()
+    try:
+        assert f() == 41
+        timers = obs.snapshot()["timers"]
+        assert timers["unit::traced_span"]["count"] == 1
+        assert timers["unit::traced_span"]["total_s"] > 0.0
+    finally:
+        obs.disable()
+        obs.reset()
+    # disabled again: no registry writes
+    assert f() == 41
+    assert obs.snapshot()["timers"] == {}
+
+
+# ---------------------------------------------------------------------------
+# logger
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sink():
+    captured = []
+    set_callback_sink(lambda lvl, msg: captured.append((lvl, msg)))
+    try:
+        yield captured
+    finally:
+        set_callback_sink(None)
+
+
+def test_callback_sink_receives_formatted_lines(sink):
+    get_logger().warning("look out %d", 7)
+    assert sink == [(logging.WARNING, "[WARNING] [raft_tpu] look out 7")]
+
+
+def test_callback_sink_matches_stream_format(sink):
+    """The fix under test: the callback handler must carry the SAME
+    formatter as the stream handler (it used to call self.format with none
+    installed, handing sinks the bare message)."""
+    logger = get_logger()
+    stream_fmt = logger.handlers[0].formatter
+    logger.error("parity")
+    rec = logging.LogRecord("raft_tpu", logging.ERROR, __file__, 0,
+                            "parity", None, None)
+    assert sink[0][1] == stream_fmt.format(rec)
+
+
+def test_callback_sink_removed(sink):
+    set_callback_sink(None)
+    get_logger().warning("after removal")
+    assert sink == []
+
+
+def test_callback_sink_replaced_not_stacked():
+    a, b = [], []
+    set_callback_sink(lambda lvl, msg: a.append(msg))
+    set_callback_sink(lambda lvl, msg: b.append(msg))
+    try:
+        get_logger().warning("once")
+    finally:
+        set_callback_sink(None)
+    assert a == [] and len(b) == 1
+
+
+def test_callback_sink_exception_never_propagates(sink):
+    def bad_sink(lvl, msg):
+        raise RuntimeError("sink exploded")
+
+    set_callback_sink(bad_sink)
+    try:
+        get_logger().warning("survives")  # must not raise
+    finally:
+        set_callback_sink(None)
+
+
+def test_set_level_names_and_ints():
+    logger = get_logger()
+    old = logger.level
+    try:
+        set_level("debug")
+        assert logger.level == logging.DEBUG
+        set_level(logging.ERROR)
+        assert logger.level == logging.ERROR
+        with pytest.raises(ValueError):
+            set_level("chatty")
+    finally:
+        logger.setLevel(old)
+
+
+def test_set_level_filters_callback(sink):
+    logger = get_logger()
+    old = logger.level
+    try:
+        set_level("error")
+        logger.warning("dropped")
+        logger.error("kept")
+    finally:
+        logger.setLevel(old)
+    assert [msg for _, msg in sink] == ["[ERROR] [raft_tpu] kept"]
