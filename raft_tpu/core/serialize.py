@@ -23,7 +23,6 @@ import os
 import struct
 from typing import Any, Dict, Mapping, Tuple
 
-import jax
 import numpy as np
 
 _MAGIC = b"RAFTTPU\x00"

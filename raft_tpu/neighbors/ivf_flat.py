@@ -31,7 +31,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced

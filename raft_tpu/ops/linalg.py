@@ -12,7 +12,6 @@ jnp expression that XLA fuses; what earns a real design here:
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax

@@ -7,7 +7,7 @@ mirrors the reference inventory (SURVEY.md §2.2) one-to-one.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

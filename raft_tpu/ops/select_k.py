@@ -23,7 +23,7 @@ All operate row-wise on a (batch, n) matrix, like the reference.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

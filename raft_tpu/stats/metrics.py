@@ -12,14 +12,13 @@ eps-relative distance-tie matching of stats/detail/neighborhood_recall.cuh.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.linalg import gemm
-from raft_tpu.utils.tiling import ceil_div
 
 
 def accuracy(predictions, references) -> jax.Array:

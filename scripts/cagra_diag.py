@@ -2,7 +2,6 @@
 import time
 import sys
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -2,7 +2,6 @@
 recall after each stage."""
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -2,9 +2,7 @@
 import sys
 import time
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache
 

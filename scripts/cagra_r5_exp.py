@@ -22,7 +22,6 @@ def emit(**kw):
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache
 

@@ -11,7 +11,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache
 

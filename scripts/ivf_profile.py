@@ -27,7 +27,6 @@ from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors.ivf_flat import (_coarse_probes, _finalize_ragged,
                                          _lens_np, _ragged_plan_static)
 from raft_tpu.ops import strip_scan as ss
-from jax import lax
 
 N = int(os.environ.get("IVFPROF_N", 1_000_000))
 DIM, Q, K = 128, int(os.environ.get("IVFPROF_Q", 10_000)), 10

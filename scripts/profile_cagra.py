@@ -7,11 +7,9 @@ Measures, per op, amortized wall-clock over back-to-back dispatches:
   - a full _search_impl call at several (width, itopk) points
 """
 import time
-import sys
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache
 

@@ -11,7 +11,6 @@ enable_persistent_cache()
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu import random as rt_random
 from raft_tpu import stats

@@ -6,7 +6,6 @@ fetch) and recall vs exact ground truth at the 1M bench shape.
 import time
 import sys
 
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache

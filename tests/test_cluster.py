@@ -1,7 +1,6 @@
 """Cluster tests — tier-2 oracle (numpy recomputation) + quality gates,
 mirroring cpp/test/cluster_kmeans.cu's score/convergence checks (SURVEY.md §4.3)."""
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -2,8 +2,6 @@
 match the single-device library path (tier-1 oracle, SURVEY.md §4.3 — the
 LocalCUDACluster-analog fixture is the conftest virtual CPU mesh)."""
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

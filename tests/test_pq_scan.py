@@ -6,7 +6,6 @@ backend end-to-end."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.neighbors import ivf_pq
