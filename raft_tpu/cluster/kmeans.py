@@ -29,6 +29,7 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.core.resources import Resources, current_resources, use_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.ops.distance import fused_l2_nn_argmin, pairwise_distance
 
 
@@ -161,6 +162,7 @@ def _init_random(key, X, n_clusters):
 # ---------------------------------------------------------------------------
 
 
+@traced("kmeans::fit")
 def fit(
     X,
     params: KMeansParams = KMeansParams(),
@@ -235,6 +237,7 @@ def predict(
     return labels, jnp.sum(d2)
 
 
+@traced("kmeans::fit_predict")
 def fit_predict(
     X,
     params: KMeansParams = KMeansParams(),

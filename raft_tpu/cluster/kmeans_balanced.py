@@ -33,6 +33,7 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.core.resources import Resources, current_resources, use_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.ops.distance import fused_l2_nn_argmin, matmul_t
 
 
@@ -150,6 +151,7 @@ def _balanced_em(X, centers0, key, n_clusters, n_iters, metric, threshold, works
     return centers, labels, sizes
 
 
+@traced("kmeans_balanced::fit")
 def fit(
     X,
     n_clusters: int,
@@ -162,6 +164,7 @@ def fit(
     return centers
 
 
+@traced("kmeans_balanced::fit_predict")
 def fit_predict(
     X,
     n_clusters: int,

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.neighbors._packing import pack_lists
 from raft_tpu.ops import distance as dist_mod
 
@@ -64,6 +65,7 @@ class BallCoverIndex:
         return cls(*children, aux[0])
 
 
+@traced("ball_cover::build")
 def build(
     dataset,
     n_landmarks: int = 0,

@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.select_k import select_k
 
@@ -59,6 +60,7 @@ def _merge_running(best_v, best_i, vals, ids, k: int):
     return -v, jnp.take_along_axis(alli, sel, axis=1)
 
 
+@traced("batch_knn::search_device_chunked")
 @functools.partial(jax.jit, static_argnames=("k", "chunk_rows", "metric"))
 def search_device_chunked(dataset, queries, k: int,
                           chunk_rows: int = 131072,
@@ -126,6 +128,7 @@ def search_device_chunked(dataset, queries, k: int,
     return best_v, best_i
 
 
+@traced("batch_knn::search_out_of_core")
 def search_out_of_core(
     dataset,
     queries,

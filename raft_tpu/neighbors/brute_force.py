@@ -25,12 +25,12 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.core.bitset import Bitset
-from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.core.trace import traced
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.select_k import select_k
-from raft_tpu.utils.tiling import ceil_div, pad_and_tile, pad_rows
+from raft_tpu.utils.tiling import ceil_div, pad_and_tile
 
 # Metrics where larger is better (search selects max instead of min).
 _MAX_METRICS = frozenset({"inner_product"})
@@ -82,6 +82,7 @@ class BruteForceIndex:
         return cls(jnp.asarray(arrays["dataset"]), norms, meta["metric"], meta.get("metric_arg", 2.0))
 
 
+@traced("brute_force::build")
 def build(dataset, metric: str = "sqeuclidean", metric_arg: float = 2.0,
           res: Optional[Resources] = None) -> BruteForceIndex:
     """Build = store dataset + precompute norms (brute_force-inl.cuh:337)."""
@@ -216,6 +217,7 @@ def search(
     )
 
 
+@traced("brute_force::knn")
 def knn(
     queries,
     dataset,

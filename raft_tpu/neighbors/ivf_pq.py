@@ -708,6 +708,7 @@ def _scatter_chunk_cache(cache, list_ids, b_sum, chunk, labels, base,
     return cache, list_ids, b_sum
 
 
+@traced("ivf_pq::build_streaming")
 def build_streaming(
     chunk_fn,
     n: int,

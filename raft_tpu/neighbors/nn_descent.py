@@ -53,6 +53,7 @@ from jax import lax
 from raft_tpu.core.interruptible import check_interrupt
 from raft_tpu.core.logger import get_logger
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.ops.segment import merge_topk_dedup, segment_take
 from raft_tpu.utils.tiling import ceil_div
 
@@ -241,6 +242,7 @@ def _iteration(X, norms, ids, dists, is_new, key, K, S, n_blocks, cand_cap):
     return ids, dists, is_new, updates
 
 
+@traced("nn_descent::build")
 def build(
     dataset,
     params: NNDescentParams = NNDescentParams(),
