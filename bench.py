@@ -369,11 +369,22 @@ def run_suite():
             # graph_degree=64 (the reference default): measured the difference
             # between 0.87 and 0.98 recall at 1M — degree-32 graphs lose
             # navigability at this scale
-            cidx = cagra.build(csub, cagra.CagraParams(
-                intermediate_graph_degree=128 if not on_cpu else 64,
-                graph_degree=64 if not on_cpu else 32,
-                build_algo=calgo))
+            # telemetry ON for the build: cagra's per-phase _sync barriers
+            # are obs-gated, and build_phases_s must record completion times
+            # (comparable with pre-gating rounds), not dispatch times
+            _obs_was_on = obs.enabled()
+            obs.enable()
+            try:
+                cidx = cagra.build(csub, cagra.CagraParams(
+                    intermediate_graph_degree=128 if not on_cpu else 64,
+                    graph_degree=64 if not on_cpu else 32,
+                    build_algo=calgo))
+            finally:
+                if not _obs_was_on:
+                    obs.disable()
             _force(cidx.graph)
+            if cidx.nbr_codes is not None:
+                _force(cidx.nbr_codes)  # compression is part of build_s
             cbuild = time.perf_counter() - t0
 
             def c_rec(ci, cv):
@@ -677,8 +688,7 @@ def main():
         hb_path = os.path.abspath(
             args.heartbeat or os.path.join(_REPO, "results",
                                            "bench_progress.jsonl"))
-        os.makedirs(os.path.dirname(hb_path), exist_ok=True)
-        open(hb_path, "w").close()  # fresh file per run
+        _PROGRESS.truncate(hb_path)  # fresh file per run
         _HB_PATH = hb_path
 
     # --- device-health probe BEFORE committing to the TPU window (ISSUE 1:

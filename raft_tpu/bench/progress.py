@@ -119,6 +119,17 @@ class NullProgress:
         pass
 
 
+def truncate(path: str) -> None:
+    """Start a fresh heartbeat file for a new run (the orchestrator's
+    per-run reset). Lives here so every touch of the side-channel file —
+    create, append, reset — goes through this module's contract."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w"):
+        pass
+
+
 def from_env(platform: str = ""):
     """The measurement child's entry: a real writer when the orchestrator
     exported ``RAFT_TPU_BENCH_HEARTBEAT``, else a no-op."""
