@@ -34,13 +34,14 @@ Semantics notes (documented deviations, by design):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.compat import axis_size, shard_map
 
 _REDUCE_OPS = ("sum", "max", "min")
 
@@ -51,7 +52,7 @@ _REDUCE_OPS = ("sum", "max", "min")
 
 def get_size(axis: str = "data") -> int:
     """Communicator size (reference comms_t::get_size, core/comms.hpp:254)."""
-    return lax.axis_size(axis)
+    return axis_size(axis)
 
 
 def get_rank(axis: str = "data") -> jax.Array:
@@ -116,7 +117,7 @@ def sendrecv(x, perm: Sequence[Tuple[int, int]], axis: str = "data") -> jax.Arra
 def shift(x, offset: int = 1, axis: str = "data") -> jax.Array:
     """Ring shift by ``offset`` (the ring-pass building block for
     ring-allreduce-style algorithms and ring attention)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
 
@@ -182,7 +183,7 @@ class Comms:
         ``fn`` sees per-shard views and may call the module-level collectives
         with ``axis=self.axis``.
         """
-        return jax.shard_map(
+        return shard_map(
             fn,
             mesh=self.mesh,
             in_specs=in_specs,

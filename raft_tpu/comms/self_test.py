@@ -17,16 +17,16 @@ from __future__ import annotations
 
 from typing import Dict
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.comms import comms as C
+from raft_tpu.core.compat import shard_map
 
 
 def _run(mesh, axis, fn, x, in_spec, out_spec):
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False
     )(x)
 
@@ -120,7 +120,7 @@ def test_comm_split(mesh: Mesh, axis: str) -> bool:
         c = C.allreduce(s, "sum", col.axis)   # sum across rows (n/2 entries)
         return r, c
 
-    r, c = jax.shard_map(
+    r, c = shard_map(
         body,
         mesh=row.mesh,
         in_specs=(P(row.axis, col.axis),),

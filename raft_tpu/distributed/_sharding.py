@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.compat import shard_map
 from raft_tpu.neighbors import _packing
 from raft_tpu.ops.select_k import select_k
 
@@ -64,7 +65,7 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
         return labels[None], counts[None]
 
     axis = comms.axis
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=comms.mesh,
         in_specs=(P(axis, None, None), P(axis, None)),
         out_specs=(P(axis, None), P(axis, None)),
@@ -171,7 +172,7 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
             )
         return merge_shards(vals, ids, k, axis, world)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(),
                   P(axis, None, None, None), P(axis, None, None),

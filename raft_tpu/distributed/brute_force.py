@@ -21,7 +21,9 @@ from jax.sharding import PartitionSpec as P
 
 from raft_tpu.comms.comms import Comms, make_comms, shard_padded
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.compat import shard_map
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.neighbors.brute_force import _MAX_METRICS, _tile_distances
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.select_k import select_k
@@ -49,6 +51,7 @@ class ShardedBruteForceIndex:
         return self.n_total
 
 
+@traced("distributed.brute_force::build")
 def build(
     dataset,
     metric: str = "sqeuclidean",
@@ -107,7 +110,7 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
         return merge_shards(vals, ids, k, axis, world, select_min)
 
     nspec = P(axis) if has_norms else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), nspec, P(), P()),
@@ -117,6 +120,7 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
     return jax.jit(fn)
 
 
+@traced("distributed.brute_force::search")
 def search(
     index: ShardedBruteForceIndex,
     queries,

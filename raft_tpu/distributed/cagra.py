@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.compat import shard_map
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.neighbors import cagra as sl
 
 # padded shard rows get this coordinate value: any query's distance to the
@@ -74,6 +76,7 @@ class ShardedCagraIndex:
         return self.dataset.shape[1]
 
 
+@traced("distributed.cagra::build")
 def build(
     dataset,
     params: sl.CagraParams = sl.CagraParams(),
@@ -198,7 +201,7 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
         pay_specs += (P(axis),)
     else:
         pay_specs = ()
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None, None), P(axis, None, None), P()) + pay_specs,
         out_specs=(P(), P()),
@@ -207,6 +210,7 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
     return jax.jit(fn)
 
 
+@traced("distributed.cagra::search")
 def search(
     index: ShardedCagraIndex,
     queries,

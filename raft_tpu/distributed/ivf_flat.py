@@ -34,7 +34,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.compat import shard_map
 from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors.ivf_flat import IvfFlatParams
 from raft_tpu.ops import distance as dist_mod
@@ -70,6 +72,7 @@ class ShardedIvfFlatIndex:
         return self.list_data.shape[2]
 
 
+@traced("distributed.ivf_flat::build")
 def build(
     dataset,
     params: IvfFlatParams = IvfFlatParams(),
@@ -136,7 +139,7 @@ def build(
         bias = jnp.where(li >= 0, base, jnp.inf).astype(jnp.float32)
         return ld[None], li[None], bias[None]
 
-    pack_fn = jax.jit(jax.shard_map(
+    pack_fn = jax.jit(shard_map(
         pack_body, mesh=comms.mesh,
         in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
         out_specs=(P(axis, None, None, None), P(axis, None, None),
@@ -150,6 +153,7 @@ def build(
     )
 
 
+@traced("distributed.ivf_flat::search")
 def search(
     index: ShardedIvfFlatIndex,
     queries,

@@ -34,9 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, make_comms
-from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.compat import shard_map
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors import ivf_pq as sl
 from raft_tpu.neighbors.ivf_pq import IvfPqParams
@@ -82,6 +84,7 @@ class ShardedIvfPqIndex:
         return self.list_codes.shape[2]
 
 
+@traced("distributed.ivf_pq::build")
 def build(
     dataset,
     params: IvfPqParams = IvfPqParams(),
@@ -196,7 +199,7 @@ def build(
             bias = b_sum
         return lc[None], li[None], bias[None]
 
-    pack_fn = jax.jit(jax.shard_map(
+    pack_fn = jax.jit(shard_map(
         pack_body, mesh=comms.mesh,
         in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
         out_specs=(P(axis, None, None, None), P(axis, None, None),
@@ -211,7 +214,7 @@ def build(
         return sl._decode_lists_scaled(codebooks, lc[0], scale, pq_dim,
                                        params.pq_bits, cluster=cluster)[None]
 
-    decode_fn = jax.jit(jax.shard_map(
+    decode_fn = jax.jit(shard_map(
         decode_body, mesh=comms.mesh,
         in_specs=(P(axis, None, None, None),),
         out_specs=P(axis, None, None, None),
@@ -225,6 +228,7 @@ def build(
     )
 
 
+@traced("distributed.ivf_pq::search")
 def search(
     index: ShardedIvfPqIndex,
     queries,

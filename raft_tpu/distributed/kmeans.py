@@ -15,21 +15,23 @@ program with ICI collectives inside the loop body.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, make_comms, shard_padded
-from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.cluster.kmeans import (
     KMeansOutput,
     KMeansParams,
     _init_plus_plus,
     _init_random,
 )
+from raft_tpu.comms.comms import Comms, make_comms, shard_padded
+from raft_tpu.core.compat import shard_map
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
 from raft_tpu.ops.distance import fused_l2_nn_argmin
 
 
@@ -64,7 +66,7 @@ def _make_fit_fn(mesh, axis, n_clusters, max_iter, tol):
         inertia = lax.psum(jnp.sum(d2 * shard_w), axis)
         return centers, inertia, n_iter, labels
 
-    fn = jax.shard_map(
+    fn = shard_map(
         spmd_fit,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P()),
@@ -96,6 +98,7 @@ def _seed_centers(kinit, X, weights, params: KMeansParams, centroids):
     return _init_plus_plus(kpp, jnp.asarray(X[rows]), weights[rows], k)
 
 
+@traced("distributed.kmeans::fit")
 def fit(
     X,
     params: KMeansParams = KMeansParams(),

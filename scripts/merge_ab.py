@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 
 from raft_tpu.comms import local_mesh
 from raft_tpu.comms.comms import Comms
+from raft_tpu.core.compat import shard_map
 from raft_tpu.distributed import _sharding
 
 Q, K = 1024, 10
@@ -35,7 +36,7 @@ for n_dev in (2, 4, 8):
         def body(v, i):
             return _sharding.merge_shards(v, i, K, comms.axis, world)
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             body, mesh=comms.mesh, in_specs=(P(), P()), out_specs=(P(), P()),
             check_vma=False))
         out = fn(vals, ids)
