@@ -1,0 +1,7 @@
+"""Entry point for ``python -m raft_tpu.analysis``."""
+
+import sys
+
+from raft_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,138 @@
+"""``python -m raft_tpu.analysis`` — the graftlint command line.
+
+Examples::
+
+    python -m raft_tpu.analysis raft_tpu tests bench.py scripts
+    python -m raft_tpu.analysis --json raft_tpu/neighbors
+    python -m raft_tpu.analysis --list-rules
+    python -m raft_tpu.analysis --select mutable-default,banned-api raft_tpu
+
+Exit codes: 0 = clean (no findings outside the baseline), 1 = new findings,
+2 = bad invocation. ``--write-baseline`` exists for
+``scripts/analysis_baseline.py``; prefer that script (it preserves
+justifications and prints what changed) over calling the flag directly.
+
+The analysis package itself is pure stdlib (ast + argparse + json), but
+``import raft_tpu.analysis`` necessarily executes ``raft_tpu/__init__``,
+which pulls jax — so a `-m` run pays the package cold-start once. All the
+analysis work after that is AST-only and runs on CPU-only hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from pathlib import Path
+
+from raft_tpu.analysis.baseline import Baseline
+from raft_tpu.analysis.findings import format_json, format_text
+from raft_tpu.analysis.registry import all_rules, resolve
+from raft_tpu.analysis.walker import analyze_paths, collect_files
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raft_tpu.analysis",
+        description="graftlint: JAX/TPU-aware static analysis",
+    )
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files / directories to analyze")
+    p.add_argument("--root", default=".",
+                   help="repo root for relative paths + default baseline "
+                        "(default: cwd)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, grandfathered or not")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline to cover current findings "
+                        "(use scripts/analysis_baseline.py)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit JSON instead of text")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:22s} {rule.severity:8s} {rule.description}")
+        return 0
+
+    if not args.paths:
+        print("graftlint: no paths given (try: raft_tpu tests bench.py "
+              "scripts)", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.select:
+        if args.write_baseline:
+            # from_findings covers the current findings EXACTLY — a partial
+            # rule selection would silently delete every other grandfathered
+            # entry (and its handwritten justification) from the file.
+            print("graftlint: --write-baseline with --select would drop all "
+                  "entries for unselected rules; run without --select "
+                  "(prefer scripts/analysis_baseline.py)", file=sys.stderr)
+            return 2
+        try:
+            rules = resolve(s.strip() for s in args.select.split(",") if s.strip())
+        except KeyError as e:
+            print(f"graftlint: {e.args[0]}", file=sys.stderr)
+            return 2
+
+    root = Path(args.root).resolve()
+    baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
+
+    t0 = time.monotonic()
+    try:
+        findings = analyze_paths(args.paths, rules=rules, root=root)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        previous = Baseline.load(baseline_path)
+        # A rewrite covers the scanned findings EXACTLY, so scanning a
+        # subset of the tree would silently delete every entry (and its
+        # handwritten justification) for files outside that subset. Refuse
+        # when an existing entry's file is real but was not scanned —
+        # entries for deleted files still prune legitimately.
+        scanned = {os.path.relpath(f, root).replace(os.sep, "/")
+                   for f in collect_files(args.paths, root=root)}
+        orphaned = sorted({e.get("path", "") for e in previous.entries
+                           if e.get("path") not in scanned
+                           and (root / e.get("path", "")).exists()})
+        if orphaned:
+            print("graftlint: --write-baseline over a partial scan would "
+                  "drop existing entries for unscanned files "
+                  f"({', '.join(orphaned)}); scan the full set or use "
+                  "scripts/analysis_baseline.py", file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings, previous=previous).save(baseline_path)
+        print(f"graftlint: baseline rewritten with {len(findings)} finding(s)"
+              f" -> {baseline_path}", file=sys.stderr)
+        return 0
+
+    absorbed = 0
+    if not args.no_baseline:
+        findings, absorbed = Baseline.load(baseline_path).filter(findings)
+
+    out = (format_json(findings, absorbed) if args.as_json
+           else format_text(findings, absorbed))
+    print(out)
+    elapsed = time.monotonic() - t0
+    print(f"graftlint: analyzed in {elapsed:.2f}s", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,192 @@
+"""Jit/pallas region resolver: which functions in a module run under a tracer.
+
+Host-sync and tracer-control-flow rules are only meaningful INSIDE a traced
+region — ``int(x)`` on the host is free, ``int(x)`` under ``jax.jit`` is a
+blocking device round-trip (or a ConcretizationTypeError). This module
+answers "is this ast node inside traced code?" from a single file's AST:
+
+1. **Direct roots** — functions decorated ``@jax.jit`` /
+   ``@functools.partial(jax.jit, ...)``, rebound via ``f = jax.jit(f)``,
+   passed to ``jax.jit(f)`` inline, handed to ``pl.pallas_call`` as the
+   kernel, or passed to a tracing transform (``vmap``/``grad``/``lax.scan``/
+   ``fori_loop``/``while_loop``/``cond``/``switch``/``map``/``remat``).
+2. **Call-graph closure** — a helper called (by bare name, same module) from
+   a traced function is itself traced at runtime; reachability is a BFS over
+   local call edges. Cross-module calls are out of scope by design: the
+   walker runs per-file and the registry stays import-light.
+3. **Lexical nesting** — a function defined inside a traced function
+   (scan bodies, pallas kernels-in-closures) is traced.
+
+``static_params(fn)`` exposes the ``static_argnames``/``static_argnums`` of a
+direct root so rules can exempt genuinely-static parameters (``int(k)`` on a
+static ``k`` is host arithmetic, not a sync).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# tracing transforms whose function-valued args run under a tracer
+_TRANSFORMS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "scan", "fori_loop", "while_loop", "cond", "switch", "map",
+    "associative_scan", "custom_vjp", "custom_jvp", "pallas_call",
+    "shard_map",
+}
+
+# Attribute bases a transform may hang off. Generic names (`map`, `cond`,
+# `scan`, …) collide with ordinary host APIs — `executor.map(worker, items)`
+# must NOT mark `worker` as traced — so an attribute call only counts when
+# its base object is one of the jax homes. Bare names stay trusted: they are
+# overwhelmingly `from jax.lax import scan`-style imports in this codebase.
+_TRANSFORM_BASES = {"jax", "lax", "jax.lax", "pl", "pltpu", "pallas",
+                    "jax.experimental.pallas"}
+
+
+def _is_transform_call(func: ast.AST) -> bool:
+    dotted = dotted_name(func)
+    if not dotted:
+        return False
+    base, _, head = dotted.rpartition(".")
+    if head not in _TRANSFORMS:
+        return False
+    return not base or base in _TRANSFORM_BASES
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.numpy.sum`` for a Name/Attribute chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote ``jax.jit`` (possibly bare ``jit``)?"""
+    name = dotted_name(node)
+    return name in ("jit", "jax.jit")
+
+
+def _partial_of_jit(call: ast.Call) -> bool:
+    """``functools.partial(jax.jit, ...)`` / ``partial(jit, ...)``."""
+    if dotted_name(call.func) not in ("partial", "functools.partial"):
+        return False
+    return bool(call.args) and _is_jit_expr(call.args[0])
+
+
+def _static_from_call(call: ast.Call, fn: Optional[ast.AST]) -> Set[str]:
+    """Static parameter names out of a jit(...) or partial(jax.jit, ...) call."""
+    names: Set[str] = set()
+    argnums: List[int] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    argnums.append(e.value)
+    if argnums and isinstance(fn, _FUNC_NODES):
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for i in argnums:
+            if 0 <= i < len(params):
+                names.add(params[i])
+    return names
+
+
+class JitRegions:
+    """Per-module traced-region index. Expects parent links on the tree
+    (``walker`` sets ``node.parent``)."""
+
+    def __init__(self, tree: ast.Module):
+        self._funcs: Dict[str, List[ast.AST]] = {}
+        self._static: Dict[ast.AST, Set[str]] = {}
+        roots: Set[ast.AST] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_NODES):
+                self._funcs.setdefault(node.name, []).append(node)
+
+        def mark_name(name_node: ast.AST, static: Set[str]) -> None:
+            if isinstance(name_node, ast.Name):
+                for fn in self._funcs.get(name_node.id, ()):
+                    roots.add(fn)
+                    if static:
+                        self._static.setdefault(fn, set()).update(static)
+
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_NODES):
+                for deco in node.decorator_list:
+                    if _is_jit_expr(deco):
+                        roots.add(node)
+                    elif isinstance(deco, ast.Call) and (
+                            _is_jit_expr(deco.func) or _partial_of_jit(deco)):
+                        roots.add(node)
+                        self._static.setdefault(node, set()).update(
+                            _static_from_call(deco, node))
+            elif isinstance(node, ast.Call):
+                if _is_jit_expr(node.func) and node.args:
+                    fn = (self._funcs.get(node.args[0].id, [None])[0]
+                          if isinstance(node.args[0], ast.Name) else None)
+                    mark_name(node.args[0], _static_from_call(node, fn))
+                elif _is_transform_call(node.func):
+                    for arg in node.args:
+                        mark_name(arg, set())
+
+        # call-graph closure over bare-name calls, then lexical nesting is
+        # resolved lazily in in_region() by climbing parents
+        self._roots: Set[ast.AST] = set(roots)
+        self._region: Set[ast.AST] = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    for callee in self._funcs.get(node.func.id, ()):
+                        if callee not in self._region:
+                            self._region.add(callee)
+                            frontier.append(callee)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of function defs containing ``node``."""
+        chain = []
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                chain.append(cur)
+            cur = getattr(cur, "parent", None)
+        return chain
+
+    def in_region(self, node: ast.AST) -> bool:
+        """Is ``node`` (any ast node) inside traced code?"""
+        if isinstance(node, _FUNC_NODES) and node in self._region:
+            return True
+        return any(fn in self._region for fn in self.enclosing_functions(node))
+
+    def is_direct_root(self, fn: ast.AST) -> bool:
+        """Was ``fn`` itself handed to jit/pallas (vs merely reachable)?
+        Direct roots are the one place parameter tracedness is knowable:
+        every non-static parameter arrives as a tracer."""
+        return fn in self._roots
+
+    def static_params(self, node: ast.AST) -> FrozenSet[str]:
+        """Union of static param names over the enclosing traced roots."""
+        out: Set[str] = set()
+        chain = self.enclosing_functions(node)
+        if isinstance(node, _FUNC_NODES):
+            chain = [node] + chain
+        for fn in chain:
+            out.update(self._static.get(fn, ()))
+        return frozenset(out)

@@ -1,0 +1,37 @@
+"""Rule catalog — importing this package registers every rule.
+
+Catalog (id — what it catches):
+
+* ``tracer-branch``       — Python ``if``/``while``/``assert``/``bool()`` on
+  traced values inside jit/pallas regions (ConcretizationTypeError or a
+  silent host sync at trace time)
+* ``jit-host-sync``       — ``float()``/``int()``/``.item()``/``.tolist()``/
+  ``np.asarray``/``jax.device_get`` reachable from a jit region
+* ``loop-host-transfer``  — device→host transfers inside loops in ``@traced``
+  host entry points (the per-iteration sync that ate round-5's bench window)
+* ``obs-coverage``        — public build/search/fit entry points in
+  neighbors/cluster/distributed must be ``@traced`` or open a
+  ``record_span`` (ROADMAP: telemetry is a prerequisite)
+* ``recompile-hazard``    — ``jax.jit`` constructed inside a loop, f-strings
+  formatting tracers, static params rebound as arrays
+* ``banned-api``          — wall-clock / stdlib-random / datetime reads in
+  kernel & ops modules (determinism contract)
+* ``swallowed-exception`` — bare ``except:`` and broad except-pass around
+  device calls
+* ``mutable-default``     — mutable default argument values
+* ``bench-io``            — bench results writes bypassing the crash-safe
+  ``bench/progress.py`` channel
+* ``unused-import``       — dead imports (non-``__init__`` modules)
+"""
+
+from raft_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
+    banned_api,
+    bench_io,
+    exceptions,
+    host_sync,
+    imports,
+    mutable_defaults,
+    obs_coverage,
+    recompile,
+    tracer_control,
+)

@@ -1,0 +1,229 @@
+"""Shared predicates for the rule catalog."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from raft_tpu.analysis.jit_regions import dotted_name
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: calls that move device data to the host (or block on it)
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+HOST_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+
+def resolve_call(ctx, node: ast.AST) -> str:
+    """Canonical dotted name of a call target, with the module's import
+    aliases folded in: ``np.asarray`` -> ``numpy.asarray``, a bare
+    ``device_get`` imported from jax -> ``jax.device_get``."""
+    name = dotted_name(node)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    origin = ctx.imports.get(head)
+    if origin:
+        return f"{origin}.{rest}" if rest else origin
+    return name
+
+
+def is_array_ns(ctx, node: ast.AST) -> bool:
+    """Does this call target live under jax / jax.numpy / jax.lax?"""
+    resolved = resolve_call(ctx, node)
+    return resolved.startswith(("jax.numpy.", "jax.lax.", "jax.")) and \
+        not resolved.startswith("jax.profiler.")
+
+
+def enclosing(node: ast.AST, kinds) -> Optional[ast.AST]:
+    """Nearest ancestor of one of ``kinds`` (walker sets .parent links)."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def has_ancestor(node: ast.AST, target: ast.AST) -> bool:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if cur is target:
+            return True
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def is_traced_decorated(fn) -> bool:
+    """Does ``fn`` carry the ``@traced("...")`` telemetry decorator?"""
+    for deco in fn.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if dotted_name(target).rsplit(".", 1)[-1] == "traced":
+            return True
+    return False
+
+
+def calls_record_span(fn) -> bool:
+    """Does the function body open an ``obs.record_span`` span itself?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                dotted_name(node.func).rsplit(".", 1)[-1] == "record_span":
+            return True
+    return False
+
+
+def is_obs_enabled_test(ctx, test: ast.AST) -> bool:
+    """Is this expression an ``obs.enabled()`` (or alias) call?"""
+    return isinstance(test, ast.Call) and \
+        resolve_call(ctx, test.func).endswith("obs.enabled")
+
+
+def under_obs_gate(ctx, node: ast.AST) -> bool:
+    """Is ``node`` inside an ``if obs.enabled():`` block?"""
+    cur = getattr(node, "parent", None)
+    child = node
+    while cur is not None:
+        if isinstance(cur, ast.If) and is_obs_enabled_test(ctx, cur.test):
+            # must be in the THEN branch (the else branch is the off path)
+            if any(has_ancestor(child, s) or child is s for s in cur.body):
+                return True
+        child = cur
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def has_obs_early_return(ctx, fn) -> bool:
+    """Does ``fn`` start with ``if not obs.enabled(): return``?"""
+    for stmt in fn.body:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring
+        if isinstance(stmt, ast.If) and \
+                isinstance(stmt.test, ast.UnaryOp) and \
+                isinstance(stmt.test.op, ast.Not) and \
+                is_obs_enabled_test(ctx, stmt.test.operand) and \
+                any(isinstance(s, ast.Return) for s in stmt.body):
+            return True
+        return False
+    return False
+
+
+def is_static_expr(node: ast.AST, static_names=frozenset()) -> bool:
+    """Conservatively: does this expression involve only host-static values
+    (constants, shapes/dtypes/ndim, len(), and known-static parameters)?
+    Shape/dtype access anywhere marks the whole expression static — the
+    dominant idiom is ``int(x.shape[0] * grow)`` which is host arithmetic."""
+    subs = list(ast.walk(node))
+    if any(isinstance(s, ast.Attribute) and
+           s.attr in ("shape", "ndim", "dtype", "size", "itemsize",
+                      "inf", "nan", "pi", "e")  # namespace constants
+           for s in subs):
+        return True
+    for sub in subs:
+        if isinstance(sub, ast.Call) and not (
+                isinstance(sub.func, ast.Name) and sub.func.id == "len"):
+            return False
+    names = [n.id for n in subs if isinstance(n, ast.Name)]
+    return all(n in static_names or n == "len" for n in names)
+
+
+def iter_functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            yield node
+
+
+#: array-namespace calls that return host metadata, not tracers
+METADATA_FNS = {
+    "issubdtype", "isdtype", "result_type", "promote_types", "can_cast",
+    "finfo", "iinfo", "dtype", "zeros_like_shape",
+}
+
+
+def is_metadata_call(ctx, call: ast.Call) -> bool:
+    tail = resolve_call(ctx, call.func).rsplit(".", 1)[-1]
+    return tail in METADATA_FNS
+
+
+def _is_module_constant(name: str) -> bool:
+    return name.isupper()  # ALL_CAPS module constant convention
+
+
+def taint_for_function(ctx, fn) -> frozenset:
+    """Names in ``fn`` plausibly bound to TRACED values: non-static
+    parameters of direct jit roots, results of jax/jnp/lax calls, and
+    anything assigned from those (two propagation passes over assignments,
+    for-targets and comprehension targets — no fixpoint, by design: this is
+    a linter, and two passes cover the code shapes this tree actually has).
+    Shape/dtype-derived bindings stay untainted (static under jit)."""
+    cache = getattr(ctx, "_taint_cache", None)
+    if cache is None:
+        cache = ctx._taint_cache = {}
+    if fn in cache:
+        return cache[fn]
+
+    taint = set()
+    if ctx.jit.is_direct_root(fn):
+        static = ctx.jit.static_params(fn)
+        a = fn.args
+        params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        taint.update(p for p in params if p not in static and p != "self")
+
+    def value_traced(expr) -> bool:
+        return expr_is_traced(ctx, expr, taint)
+
+    def target_names(tgt):
+        return [n.id for n in ast.walk(tgt) if isinstance(n, ast.Name)]
+
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if is_static_expr(node.value):
+                    continue  # shape/dtype-derived: static under jit
+                if value_traced(node.value):
+                    for t in node.targets:
+                        taint.update(target_names(t))
+            elif isinstance(node, ast.AugAssign):
+                if value_traced(node.value) and isinstance(node.target, ast.Name):
+                    taint.add(node.target.id)
+            elif isinstance(node, ast.For):
+                if value_traced(node.iter):
+                    taint.update(target_names(node.target))
+            elif isinstance(node, ast.comprehension):
+                if value_traced(node.iter):
+                    taint.update(target_names(node.target))
+            elif isinstance(node, ast.withitem):
+                if node.optional_vars is not None and \
+                        value_traced(node.context_expr):
+                    taint.update(target_names(node.optional_vars))
+
+    result = frozenset(taint)
+    cache[fn] = result
+    return result
+
+
+_STATIC_SUBTREE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+_STATIC_SUBTREE_FNS = {"len", "isinstance", "issubclass", "getattr",
+                       "hasattr", "callable", "type"}
+
+
+def expr_is_traced(ctx, node: ast.AST, taint) -> bool:
+    """Could this expression hold a tracer? True when it references a
+    tainted name or calls into the array namespace (inside jit, every
+    jnp/lax call returns a tracer — except metadata probes). Static
+    subtrees are pruned: ``x.shape[0]`` and ``len(x)`` are host ints even
+    when ``x`` is traced."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_SUBTREE_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _STATIC_SUBTREE_FNS:
+            return False
+        if is_array_ns(ctx, node.func):
+            return not is_metadata_call(ctx, node)
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    return any(expr_is_traced(ctx, child, taint)
+               for child in ast.iter_child_nodes(node))

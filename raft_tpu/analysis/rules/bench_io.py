@@ -1,0 +1,67 @@
+"""bench-io: bench results writes must go through ``bench/progress.py``.
+
+Round 5's lesson (BENCH_r05.json rc=124, no output): any bench result that
+lives only in process memory — or in a file written without flush+fsync —
+is lost the moment the watchdog kills the run. ``bench/progress.py`` is the
+crash-safe channel (append, flush, fsync per record, salvageable by
+``scripts/bench_salvage.py``). Direct write-mode ``open()`` / ``np.save*`` /
+``Path.write_text`` in bench code bypasses that guarantee, so it gets
+flagged; ``progress.py`` itself and read-mode opens are exempt. Legitimate
+non-results writes (dataset caches, user-pointed ``--output``) are
+baselined with justifications rather than silently allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import resolve_call
+
+_WRITE_MODES = set("wax")
+_NP_WRITERS = {"numpy.save", "numpy.savez", "numpy.savez_compressed",
+               "numpy.savetxt"}
+_PATH_WRITERS = {"write_text", "write_bytes"}
+
+
+def _open_mode(node: ast.Call) -> str:
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) and \
+            isinstance(node.args[1].value, str):
+        return node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    return "r"
+
+
+@register
+class BenchIoRule(Rule):
+    id = "bench-io"
+    severity = "warning"
+    description = ("bench code writing files directly instead of through "
+                   "the crash-safe bench/progress.py channel")
+
+    def check(self, ctx):
+        in_scope = ctx.rel == "bench.py" or (
+            "bench" in ctx.rel.split("/")[:-1])
+        if not in_scope or ctx.rel.endswith("/progress.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = ""
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                if _WRITE_MODES.intersection(_open_mode(node)):
+                    label = f"open(…, {_open_mode(node)!r})"
+            elif resolve_call(ctx, node.func) in _NP_WRITERS:
+                label = resolve_call(ctx, node.func)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _PATH_WRITERS:
+                label = f".{node.func.attr}()"
+            if label:
+                yield self.finding(
+                    ctx, node,
+                    f"direct {label} in bench code — route results through "
+                    f"bench/progress.py (fsync'd, salvageable) so a killed "
+                    f"run keeps its checkpoints")

@@ -1,0 +1,134 @@
+"""jit-host-sync + loop-host-transfer: device→host round-trips.
+
+Two rules share this module because they share the sync-call predicate:
+
+* ``jit-host-sync`` — ``float()``/``int()`` on non-static values,
+  ``.item()``/``.tolist()``/``.block_until_ready()``, ``np.asarray``/
+  ``np.array``/``jax.device_get`` INSIDE a traced region. Under jit these
+  either fail (concretization) or silently pin a host sync into what should
+  be a device-resident loop — TPU-KNN's peak-FLOP/s design (PAPER.md)
+  depends on the host staying out of the device loop.
+
+* ``loop-host-transfer`` — the same transfers inside ``for``/``while``
+  loops of ``@traced`` HOST entry points (build/search drivers). One
+  ``device_get`` per iteration serializes the dispatch pipeline. Transfers
+  gated behind ``if obs.enabled():`` (or in a helper that no-ops when
+  telemetry is off) are exempt — that is exactly the telemetry-off fast
+  path the cagra ``_sync`` probe uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import (
+    HOST_SYNC_ATTRS,
+    HOST_SYNC_CALLS,
+    enclosing,
+    expr_is_traced,
+    has_obs_early_return,
+    is_traced_decorated,
+    iter_functions,
+    resolve_call,
+    taint_for_function,
+    under_obs_gate,
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _sync_call_kind(ctx, node: ast.Call) -> str:
+    """'' when not a sync; else a short label for the message."""
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in HOST_SYNC_ATTRS and not node.args:
+        return f".{node.func.attr}()"
+    resolved = resolve_call(ctx, node.func)
+    if resolved in HOST_SYNC_CALLS:
+        return resolved
+    return ""
+
+
+@register
+class JitHostSyncRule(Rule):
+    id = "jit-host-sync"
+    severity = "error"
+    description = ("host sync (float/int/.item/np.asarray/device_get) "
+                   "reachable from a jit/pallas region")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.jit.in_region(node):
+                continue
+            encl = ctx.jit.enclosing_functions(node)
+            if not encl:
+                continue
+            taint = taint_for_function(ctx, encl[0])
+
+            kind = ""
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in HOST_SYNC_ATTRS and not node.args:
+                if expr_is_traced(ctx, node.func.value, taint):
+                    kind = f".{node.func.attr}()"
+            elif resolve_call(ctx, node.func) in HOST_SYNC_CALLS:
+                if any(expr_is_traced(ctx, a, taint) for a in node.args):
+                    kind = resolve_call(ctx, node.func)
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int") and len(node.args) == 1 \
+                    and expr_is_traced(ctx, node.args[0], taint):
+                kind = f"{node.func.id}()"
+            if kind:
+                yield self.finding(
+                    ctx, node,
+                    f"{kind} on a traced value inside a jit region forces a "
+                    f"device→host sync (or ConcretizationTypeError); keep "
+                    f"the value on device or hoist it out of the traced "
+                    f"code")
+
+
+def _syncing_locals(ctx) -> set:
+    """Names of module-local functions that transfer to host un-gated
+    (one level deep — catches helpers like cagra's ``_sync``)."""
+    out = set()
+    for fn in iter_functions(ctx.tree):
+        if has_obs_early_return(ctx, fn):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _sync_call_kind(ctx, node) \
+                    and not under_obs_gate(ctx, node):
+                out.add(fn.name)
+                break
+    return out
+
+
+@register
+class LoopHostTransferRule(Rule):
+    id = "loop-host-transfer"
+    severity = "warning"
+    description = ("device→host transfer inside a loop of a @traced entry "
+                   "point (gate it behind obs.enabled() or hoist it)")
+
+    def check(self, ctx):
+        syncing = None  # computed lazily: most files have no @traced fns
+        for fn in iter_functions(ctx.tree):
+            if not is_traced_decorated(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                loop = enclosing(node, (ast.For, ast.While))
+                if loop is None or not any(
+                        f is fn for f in ctx.jit.enclosing_functions(loop)):
+                    continue
+                kind = _sync_call_kind(ctx, node)
+                if not kind and isinstance(node.func, ast.Name):
+                    if syncing is None:
+                        syncing = _syncing_locals(ctx)
+                    if node.func.id in syncing:
+                        kind = f"{node.func.id}() [transfers internally]"
+                if kind and not under_obs_gate(ctx, node):
+                    yield self.finding(
+                        ctx, node,
+                        f"{kind} in a loop of @traced `{fn.name}` syncs the "
+                        f"device every iteration; hoist it or gate it behind "
+                        f"obs.enabled()")

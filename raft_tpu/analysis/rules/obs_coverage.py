@@ -1,0 +1,53 @@
+"""obs-coverage: telemetry is a prerequisite, mechanically enforced.
+
+The ROADMAP open item says bench-affecting hot paths must keep their
+``raft_tpu.obs`` spans. This rule turns that from review-time lore into a
+tier-1 failure: every PUBLIC build/search/fit-family entry point in
+``neighbors/``, ``cluster/`` and ``distributed/`` must either carry the
+``@traced("…")`` decorator or open an ``obs.record_span`` itself. Removing a
+span from an instrumented entry point — or adding a new entry point without
+one — is a NEW finding and fails the run (the baseline never absorbs it,
+because the identity line is the ``def`` itself).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import calls_record_span, is_traced_decorated
+
+_SCOPED_DIRS = {"neighbors", "cluster", "distributed"}
+_ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn"}
+_ENTRY_PREFIXES = ("build_", "search_", "fit_")
+
+
+def _is_entry_name(name: str) -> bool:
+    if name.startswith("_"):
+        return False
+    return name in _ENTRY_NAMES or name.startswith(_ENTRY_PREFIXES)
+
+
+@register
+class ObsCoverageRule(Rule):
+    id = "obs-coverage"
+    severity = "error"
+    description = ("public build/search/fit entry points in neighbors/"
+                   "cluster/distributed must be @traced or record_span")
+
+    def check(self, ctx):
+        parts = ctx.rel.split("/")[:-1]  # directories only
+        if not _SCOPED_DIRS.intersection(parts):
+            return
+        for node in ctx.tree.body:  # module level only: the public surface
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_entry_name(node.name):
+                continue
+            if is_traced_decorated(node) or calls_record_span(node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"public entry point `{node.name}` has no telemetry span — "
+                f"decorate it @traced(\"…\") or open obs.record_span "
+                f"(ROADMAP: telemetry is a prerequisite)")

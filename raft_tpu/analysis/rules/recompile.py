@@ -1,0 +1,79 @@
+"""recompile-hazard: patterns that silently recompile or hash tracers.
+
+Three concrete, decidable shapes:
+
+* ``jax.jit(...)`` constructed inside a loop — each iteration builds a fresh
+  wrapper with an empty cache, so every call retraces+recompiles. Hoist the
+  jit to module level (or cache the wrapper).
+* An f-string formatting a traced value inside a jit region — formats the
+  abstract tracer (useless text) and, in error paths, tends to grow into
+  ``.item()`` syncs. Shape/dtype interpolation is fine and exempt.
+* A ``static_argnums``/``static_argnames`` parameter rebound via
+  ``jnp.asarray(p)`` in the jitted body — an array-valued static arg hashes
+  by value, i.e. one compile cache entry per distinct payload.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import (
+    enclosing,
+    expr_is_traced,
+    resolve_call,
+    taint_for_function,
+)
+
+_ASARRAY = {"jax.numpy.asarray", "jax.numpy.array", "numpy.asarray",
+            "numpy.array"}
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = "warning"
+    description = ("jit-in-loop, f-string on a tracer, or array-valued "
+                   "static argument (per-call recompiles)")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    resolve_call(ctx, node.func) == "jax.jit" and \
+                    enclosing(node, (ast.For, ast.While)) is not None:
+                yield self.finding(
+                    ctx, node,
+                    "jax.jit(...) constructed inside a loop starts with an "
+                    "empty compile cache every iteration — hoist it")
+
+            elif isinstance(node, ast.JoinedStr) and ctx.jit.in_region(node):
+                encl = ctx.jit.enclosing_functions(node)
+                taint = (taint_for_function(ctx, encl[0]) if encl
+                         else frozenset())
+                for val in node.values:
+                    if isinstance(val, ast.FormattedValue) and \
+                            expr_is_traced(ctx, val.value, taint):
+                        yield self.finding(
+                            ctx, node,
+                            "f-string formats a traced value inside a jit "
+                            "region — it renders the abstract tracer; "
+                            "interpolate shapes/dtypes or move it to host "
+                            "code")
+                        break
+
+            elif isinstance(node, ast.Assign) and ctx.jit.in_region(node):
+                static = ctx.jit.static_params(node)
+                if not static or len(node.targets) != 1:
+                    continue
+                tgt, val = node.targets[0], node.value
+                if isinstance(tgt, ast.Name) and tgt.id in static and \
+                        isinstance(val, ast.Call) and \
+                        resolve_call(ctx, val.func) in _ASARRAY and \
+                        val.args and isinstance(val.args[0], ast.Name) and \
+                        val.args[0].id == tgt.id:
+                    yield self.finding(
+                        ctx, node,
+                        f"static argument `{tgt.id}` is rebound as an array "
+                        f"in the jitted body — array-valued static args "
+                        f"recompile per distinct value; pass it traced or "
+                        f"keep it a scalar/tuple")

@@ -1,0 +1,75 @@
+"""tracer-branch: Python control flow on traced values in jit regions.
+
+``if jnp.any(mask):`` inside a jitted function either raises
+ConcretizationTypeError or — worse, via weak typing on some paths — forces a
+blocking device→host sync at trace time. The fix is ``lax.cond`` /
+``jnp.where`` / ``lax.while_loop``. The rule flags ``if``/``while``/
+``assert`` tests that contain a jax/jnp/lax call, and explicit ``bool(...)``
+on non-static expressions, but only INSIDE traced regions — host code
+branching on a materialized result is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import (
+    is_array_ns,
+    is_metadata_call,
+    taint_for_function,
+)
+
+
+_STATIC_PROBES = {"len", "isinstance", "issubclass", "getattr", "hasattr",
+                  "callable", "type", "id"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+
+def _test_is_traced(ctx, node: ast.AST, taint) -> bool:
+    """Does this if/while/assert test read a traced value? Recursive so that
+    statically-decidable subtrees can be pruned: ``x is None`` probes pytree
+    STRUCTURE (the canonical optional-argument idiom under jit), and
+    ``len()``/``isinstance()``/``.shape`` read metadata, not data."""
+    if isinstance(node, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in _STATIC_PROBES:
+            return False
+        if is_array_ns(ctx, node.func) and not is_metadata_call(ctx, node):
+            return True
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    return any(_test_is_traced(ctx, child, taint)
+               for child in ast.iter_child_nodes(node))
+
+
+@register
+class TracerBranchRule(Rule):
+    id = "tracer-branch"
+    severity = "error"
+    description = ("Python if/while/assert on a traced value inside a "
+                   "jit/pallas region (use lax.cond/jnp.where)")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            else:
+                continue
+            if not ctx.jit.in_region(node):
+                continue
+            encl = ctx.jit.enclosing_functions(node)
+            taint = taint_for_function(ctx, encl[0]) if encl else frozenset()
+            if _test_is_traced(ctx, test, taint):
+                kind = type(node).__name__.lower()
+                yield self.finding(
+                    ctx, node,
+                    f"Python `{kind}` on a traced expression inside a jit "
+                    f"region — concretizes the tracer; use lax.cond/"
+                    f"lax.while_loop/jnp.where instead")

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# One-shot local gate: graftlint (blocking) + ruff (advisory) + tier-1 tests.
+#
+#   scripts/check.sh            # everything (tier-1 takes ~10 min on CPU)
+#   scripts/check.sh --fast     # graftlint + ruff only
+#
+# graftlint and the tier-1 pytest line are the same checks the driver runs;
+# ruff is advisory-only here (config in pyproject.toml [tool.ruff]) and is
+# skipped with a note when the tool is not installed.
+
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== graftlint (raft_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m raft_tpu.analysis raft_tpu tests bench.py scripts \
+    || fail=1
+
+echo
+echo "== ruff (advisory — does not gate) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check raft_tpu tests bench.py scripts || true
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check raft_tpu tests bench.py scripts || true
+else
+    echo "ruff not installed — skipped (pip install ruff to enable)"
+fi
+
+if [ "${1:-}" = "--fast" ]; then
+    exit $fail
+fi
+
+echo
+echo "== tier-1 tests (ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+[ "$rc" -ne 0 ] && fail=1
+
+exit $fail
