@@ -177,10 +177,19 @@ def run_suite():
     enable_persistent_cache()  # round-3: cold XLA compiles dominated builds
 
     from raft_tpu import obs
+    from raft_tpu import resilience
     from raft_tpu import stats
     from raft_tpu.bench import progress as prog
     from raft_tpu.bench.datasets import sift_like
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
+
+    def section_error(e):
+        """Classified section-failure stamp (ISSUE 3): every section guard
+        routes through resilience.classify so the failure CLASS survives
+        into the metric line and the obs counters, not just repr(e)."""
+        kind = resilience.classify(e)
+        obs.add(f"bench.section_error.{kind}")
+        return {"error": repr(e)[:300], "kind": kind}
 
     on_cpu = jax.devices()[0].platform == "cpu"
     tiny = bool(os.environ.get("RAFT_TPU_BENCH_TINY"))
@@ -220,7 +229,10 @@ def run_suite():
                 os.environ.get("RAFT_TPU_DATA_DIR", os.path.join(
                     os.path.expanduser("~"), ".cache", "raft_tpu_data")),
                 "sift", max_rows=N)
-        except Exception:
+        except Exception as e:
+            # classified fallback-to-synthetic (the kind disambiguates a
+            # transient read from a genuinely absent dataset)
+            extras["real_dataset_error"] = section_error(e)
             real = None
     if real is not None:
         base, qs, _ = real
@@ -267,81 +279,90 @@ def run_suite():
         return index, round(cold, 1), round(time.perf_counter() - t0, 1)
 
     # --- IVF-Flat at BASELINE config (nlist=1024, nprobe=32, escalating) ----
+    # Section guards (ISSUE 3): a failed IVF section must not sink the
+    # suite — the headline falls back down flat -> brute force, and the
+    # failure ships classified in extras instead of killing the child.
     flat = None
     if section_on("ivf_flat"):
         hb.set_section("ivf_flat")
+        try:
+            def build_flat():
+                idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
+                    n_lists=NLIST, kmeans_trainset_fraction=0.2))
+                _force(idx.list_norms)
+                return idx
 
-        def build_flat():
-            idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
-                n_lists=NLIST, kmeans_trainset_fraction=0.2))
-            _force(idx.list_norms)
-            return idx
-
-        flat_index, cold_s, warm_s = timed_build(build_flat)
-        for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
-                       NPROBE0 * 16):
-            vals, ids = ivf_flat.search(flat_index, queries, K, n_probes=nprobe)
-            recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
-            if flat is None or recall > flat["recall"]:
-                flat = {"nprobe": nprobe, "recall": round(recall, 4)}
-            if recall >= 0.95:
-                break
-        flat["qps"] = round(_time_qps(
-            lambda qs: ivf_flat.search(flat_index, qs, K, n_probes=flat["nprobe"]),
-            queries, REPS), 1)
-        flat["build_s"] = cold_s
-        flat["build_warm_s"] = warm_s
-        extras["ivf_flat"] = flat
-        hb.section("ivf_flat", flat)
-        del flat_index
+            flat_index, cold_s, warm_s = timed_build(build_flat)
+            for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
+                           NPROBE0 * 16):
+                vals, ids = ivf_flat.search(flat_index, queries, K, n_probes=nprobe)
+                recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+                if flat is None or recall > flat["recall"]:
+                    flat = {"nprobe": nprobe, "recall": round(recall, 4)}
+                if recall >= 0.95:
+                    break
+            flat["qps"] = round(_time_qps(
+                lambda qs: ivf_flat.search(flat_index, qs, K, n_probes=flat["nprobe"]),
+                queries, REPS), 1)
+            flat["build_s"] = cold_s
+            flat["build_warm_s"] = warm_s
+            extras["ivf_flat"] = flat
+            del flat_index
+        except Exception as e:
+            flat = None
+            extras["ivf_flat"] = section_error(e)
+        hb.section("ivf_flat", extras["ivf_flat"])
 
     # --- IVF-PQ at BASELINE config + refine re-rank (the headline) ----------
     pq = None
     if section_on("ivf_pq"):
         hb.set_section("ivf_pq")
+        try:
+            def build_pq():
+                idx = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
+                    n_lists=NLIST, pq_dim=DIM // 2, pq_bits=8,
+                    kmeans_trainset_fraction=0.2))
+                _force(idx.b_sum)
+                return idx
 
-        def build_pq():
-            idx = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
-                n_lists=NLIST, pq_dim=DIM // 2, pq_bits=8,
-                kmeans_trainset_fraction=0.2))
-            _force(idx.b_sum)
-            return idx
-
-        pq_index, cold_s, warm_s = timed_build(build_pq)
-        # over-fetch then exact re-rank (refine-inl.cuh:70 style): escalate
-        # nprobe at 4x over-fetch until the recall gate holds, then shrink the
-        # over-fetch while the gate still holds — the fetch width sets the
-        # in-kernel top-kf cost and the merge width, so the smallest passing
-        # K_FETCH is the fastest configuration
-        for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
-                       NPROBE0 * 16):
-            _, cand = ivf_pq.search(pq_index, queries, 4 * K, n_probes=nprobe)
-            vals, ids = refine.refine(dataset, queries, cand, K)
-            recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
-            if pq is None or recall > pq["recall"]:
-                pq = {"nprobe": nprobe, "recall": round(recall, 4), "k_fetch": 4 * K}
-            if recall >= 0.95:
-                break
-        if pq["recall"] >= 0.95:
-            for kf in (2 * K, K):
-                _, cand = ivf_pq.search(pq_index, queries, kf, n_probes=pq["nprobe"])
+            pq_index, cold_s, warm_s = timed_build(build_pq)
+            # over-fetch then exact re-rank (refine-inl.cuh:70 style): escalate
+            # nprobe at 4x over-fetch until the recall gate holds, then shrink the
+            # over-fetch while the gate still holds — the fetch width sets the
+            # in-kernel top-kf cost and the merge width, so the smallest passing
+            # K_FETCH is the fastest configuration
+            for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
+                           NPROBE0 * 16):
+                _, cand = ivf_pq.search(pq_index, queries, 4 * K, n_probes=nprobe)
                 vals, ids = refine.refine(dataset, queries, cand, K)
                 recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
-                if recall < 0.95:
+                if pq is None or recall > pq["recall"]:
+                    pq = {"nprobe": nprobe, "recall": round(recall, 4), "k_fetch": 4 * K}
+                if recall >= 0.95:
                     break
-                pq.update(recall=round(recall, 4), k_fetch=kf)
+            if pq["recall"] >= 0.95:
+                for kf in (2 * K, K):
+                    _, cand = ivf_pq.search(pq_index, queries, kf, n_probes=pq["nprobe"])
+                    vals, ids = refine.refine(dataset, queries, cand, K)
+                    recall = float(stats.neighborhood_recall(ids, gt_ids, vals, gt_vals))
+                    if recall < 0.95:
+                        break
+                    pq.update(recall=round(recall, 4), k_fetch=kf)
 
-        def pq_timed(qs):
-            _, cand = ivf_pq.search(pq_index, qs, pq["k_fetch"],
-                                    n_probes=pq["nprobe"])
-            return refine.refine(dataset, qs, cand, K)
+            def pq_timed(qs):
+                _, cand = ivf_pq.search(pq_index, qs, pq["k_fetch"],
+                                        n_probes=pq["nprobe"])
+                return refine.refine(dataset, qs, cand, K)
 
-        pq["qps"] = round(_time_qps(pq_timed, queries, REPS), 1)
-        pq["build_s"] = cold_s
-        pq["build_warm_s"] = warm_s
-        extras["ivf_pq"] = pq
-        hb.section("ivf_pq", pq)
-        del pq_index
+            pq["qps"] = round(_time_qps(pq_timed, queries, REPS), 1)
+            pq["build_s"] = cold_s
+            pq["build_warm_s"] = warm_s
+            extras["ivf_pq"] = pq
+            del pq_index
+        except Exception as e:
+            pq = None
+            extras["ivf_pq"] = section_error(e)
+        hb.section("ivf_pq", extras["ivf_pq"])
 
     # --- CAGRA at the FULL bench scale and the FULL query batch (VERDICT
     # r4 weak #3: q=2000 vs the IVF rows' q=10000 needed a footnote).
@@ -413,6 +434,8 @@ def run_suite():
                     cv, ci = cagra.search(cidx, cq, K, sp)
                     crec = c_rec(ci, cv)
                 except Exception as e:
+                    obs.add("bench.cagra.rung_error."
+                            + resilience.classify(e))
                     last_err = e
                     continue
                 # a sub-gate rung cannot beat an at-gate best: skip its timing
@@ -440,7 +463,7 @@ def run_suite():
             extras["cagra"] = best
             del cidx
         except Exception as e:  # a cagra failure must not sink the headline
-            extras["cagra"] = {"error": repr(e)[:300]}
+            extras["cagra"] = section_error(e)
         hb.section("cagra", extras["cagra"])
 
     # --- DEEP-10M-shaped ANN crossover (VERDICT r3 #3): at 10M rows the
@@ -462,7 +485,21 @@ def run_suite():
                     pass
                 extras["deep10m"] = _deep10m_crossover(REPS)
             except Exception as e:
-                extras["deep10m"] = {"error": repr(e)[:300]}
+                err = section_error(e)
+                if err["kind"] == resilience.OOM:
+                    # round-4 incident class (RESOURCE_EXHAUSTED near HBM
+                    # capacity): one degraded-scale retry — half the rows
+                    # is a worse headline but infinitely better than none,
+                    # and it ships marked degraded
+                    try:
+                        out = _deep10m_crossover(REPS, scale=0.5)
+                        out["degraded"] = True
+                        out["first_attempt_error"] = err
+                        extras["deep10m"] = out
+                    except Exception as e2:
+                        extras["deep10m"] = section_error(e2)
+                else:
+                    extras["deep10m"] = err
         else:
             extras["deep10m"] = {"error": "skipped: time budget"}
         hb.section("deep10m", extras["deep10m"])
@@ -480,7 +517,7 @@ def run_suite():
                     "measured_offline_by": "scripts/deep100m.py",
                     **json.load(f)}
         except Exception as e:
-            extras["deep100m"] = {"error": repr(e)[:200]}
+            extras["deep100m"] = section_error(e)
 
     # --- headline: ivf_pq, falling back down the same order salvage uses
     # when a sections filter excluded it
@@ -508,9 +545,13 @@ def run_suite():
     return result
 
 
-def _deep10m_crossover(reps: int) -> dict:
+def _deep10m_crossover(reps: int, scale: float = 1.0) -> dict:
     """10M x 96 (DEEP-shaped) section: exact chunked-scan baseline vs
-    IVF-PQ + exact refine at a 0.95 recall gate."""
+    IVF-PQ + exact refine at a 0.95 recall gate.
+
+    ``scale`` < 1 is the degraded-retry knob (ISSUE 3): after an
+    OOM-classified first attempt the caller re-runs at half the rows —
+    same pipeline, honestly smaller shape, marked ``degraded`` upstream."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -522,13 +563,15 @@ def _deep10m_crossover(reps: int) -> dict:
     # width C, the regime the strip engine is built for (at q=2000 /
     # n_lists=8192 the static worst-case layout allocated ~18 GB of
     # query-side tables — round-4 OOM)
-    N, DIM, Q, K = 10_000_000, 96, 10_000, 10
+    N, DIM, Q, K = int(10_000_000 * scale), 96, 10_000, 10
     NLIST = 4096
     data_u8, queries_u8 = sift_like(N, DIM, Q, seed=1)
     dataset = jnp.asarray(data_u8)               # uint8 on device (960 MB)
     queries = jnp.asarray(queries_u8, jnp.float32)
     out = {"n": N, "dim": DIM, "q": Q, "k": K, "n_lists": NLIST,
-           "dataset": "deeplike-10m-96-uint8"}
+           "dataset": f"deeplike-{N // 1_000_000}m-96-uint8"}
+    if scale != 1.0:
+        out["scale"] = scale
 
     # exact ground truth AND the brute baseline: one chunked device scan
     # (32768-row chunks keep the (q, chunk) score block ~1.3 GB)

@@ -20,13 +20,13 @@ __version__ = "0.1.0"
 from raft_tpu.core.resources import Resources, current_resources, use_resources
 
 from raft_tpu import (  # noqa: E402  (subpackage re-exports)
-    cluster, comms, distributed, label, neighbors, obs, ops, random, solver,
-    sparse, spectral, stats,
+    cluster, comms, distributed, label, neighbors, obs, ops, random,
+    resilience, solver, sparse, spectral, stats,
 )
 
 __all__ = [
     "cluster", "comms", "distributed", "label", "neighbors", "obs", "ops",
-    "random", "solver", "sparse", "spectral", "stats",
+    "random", "resilience", "solver", "sparse", "spectral", "stats",
     "Resources",
     "current_resources",
     "use_resources",

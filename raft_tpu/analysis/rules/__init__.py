@@ -21,6 +21,9 @@ Catalog (id — what it catches):
 * ``mutable-default``     — mutable default argument values
 * ``bench-io``            — bench results writes bypassing the crash-safe
   ``bench/progress.py`` channel
+* ``unclassified-except`` — broad except in bench.py / distributed paths
+  that neither routes through ``resilience.classify()`` nor re-raises
+  (the failure class must survive for recovery to see it)
 * ``unused-import``       — dead imports (non-``__init__`` modules)
 """
 
@@ -34,4 +37,5 @@ from raft_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
     obs_coverage,
     recompile,
     tracer_control,
+    unclassified_except,
 )

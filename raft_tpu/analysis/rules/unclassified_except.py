@@ -1,0 +1,68 @@
+"""unclassified-except: broad handlers must classify or re-raise.
+
+ISSUE 3 mechanized: the resilience layer only works if failures actually
+route through :func:`raft_tpu.resilience.classify` — a broad
+``except Exception`` that stamps ``repr(e)`` and moves on erases the
+failure class (the round-4 OOM and round-5 hang were both lost exactly
+this way). Scope is where the incidents live: ``bench.py`` section guards
+and the ``raft_tpu/distributed/`` paths. A broad handler there must call
+``classify(...)`` (directly or via a helper whose name ends in
+``classify`` / the bench ``section_error`` wrapper) or contain a
+``raise``; anything else is a finding. Deliberate holdouts (the parent
+orchestrator, which must stay off the raft_tpu import lock) are baselined
+with a justification via ``scripts/analysis_baseline.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from raft_tpu.analysis.registry import Rule, register
+from raft_tpu.analysis.rules._common import resolve_call
+from raft_tpu.analysis.rules.exceptions import _is_broad
+
+#: handler-body call names that count as classification
+_CLASSIFY_NAMES = {"classify", "section_error"}
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.split("/")
+    return parts[-1] == "bench.py" or "distributed" in parts[:-1]
+
+
+def _handles(handler: ast.ExceptHandler, ctx) -> bool:
+    """Does this handler classify the exception or re-raise?"""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = resolve_call(ctx, node.func).rsplit(".", 1)[-1]
+                if name in _CLASSIFY_NAMES:
+                    return True
+    return False
+
+
+@register
+class UnclassifiedExceptRule(Rule):
+    id = "unclassified-except"
+    severity = "error"
+    description = ("broad except in bench.py / distributed paths that "
+                   "neither calls resilience.classify() nor re-raises")
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not _is_broad(handler):
+                    continue
+                if _handles(handler, ctx):
+                    continue
+                yield self.finding(
+                    ctx, handler,
+                    "broad except drops the failure class — route it "
+                    "through resilience.classify() (or re-raise) so "
+                    "OOM/TRANSIENT/DEADLINE recovery can see it")

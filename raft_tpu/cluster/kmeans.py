@@ -188,8 +188,22 @@ def fit(
     )
     key = jax.random.key(params.seed)
 
+    from raft_tpu.core.interruptible import check_interrupt
+    from raft_tpu.resilience import active_deadline, faultpoint
+
     best: Optional[KMeansOutput] = None
     for _ in range(max(1, params.n_init)):
+        # the EM itself is one sync-free compiled program; the host-side
+        # checkpoint site (core/interruptible docstring) is the n_init
+        # restart loop. A spent Deadline keeps the best fit so far
+        # (degraded = fewer restarts, still a valid model) instead of
+        # being killed opaquely mid-restart.
+        dl = active_deadline()
+        if dl is not None and best is not None and dl.reached():
+            dl.mark_degraded("kmeans.fit")
+            break
+        check_interrupt()
+        faultpoint("kmeans.fit.em")
         kinit, key = jax.random.split(key)
         if params.init == "array":
             if centroids is None:

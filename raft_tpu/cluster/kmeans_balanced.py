@@ -195,6 +195,15 @@ def _fit_full(X, n_clusters, params, res):
         # configured, not executed: the balancing loop may run up to 5× this
         # (_balanced_em does not surface its actual count)
         obs.add("kmeans_balanced.iterations_configured", int(params.n_iters))
+    # host checkpoint before the (single, long) balanced-EM dispatch — the
+    # interruptible docstring names k-means as a checkpoint site; the EM
+    # loop itself is one compiled while_loop, so this is where a cancel or
+    # hard deadline lands
+    from raft_tpu.core.interruptible import check_interrupt
+    from raft_tpu.resilience import faultpoint
+
+    check_interrupt()
+    faultpoint("kmeans_balanced.fit.em")
     with use_resources(res):
         return _balanced_em(
             X.astype(jnp.float32),

@@ -15,10 +15,52 @@ test analog, SURVEY.md §4.3) needs no bootstrap at all — just a mesh over
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 from typing import Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh
+
+# hard bound on the subprocess-isolated coordinator probe (the obs/health.py
+# pattern: the verdict must arrive in seconds, whatever the child does)
+PROBE_MAX_TIMEOUT = 20.0
+
+_PROBE_SENTINEL = "RAFT_TPU_COMMS_OK"
+
+
+def _probe_coordinator(addr: str, timeout: float) -> None:
+    """Subprocess-isolated reachability check of ``host:port`` before the
+    in-process rendezvous commits (ISSUE 3, the obs/health.py pattern: on
+    this machine backend/coordinator init can wedge *unkillably* inside
+    the process, so the only safe probe is a bounded child). Raises a
+    TRANSIENT-classified error when the coordinator is unreachable; a
+    wedged or absent coordinator now costs seconds, not the round."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        return  # unparseable address: let jax.distributed report it
+    timeout = min(float(timeout), PROBE_MAX_TIMEOUT)
+    code = (
+        "import socket\n"
+        f"s = socket.create_connection(({host!r}, {int(port)}), timeout={timeout})\n"
+        "s.close()\n"
+        f"print({_PROBE_SENTINEL!r}, flush=True)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout + 5.0,
+        )
+    except subprocess.TimeoutExpired:
+        # wording matters: "timed out" would classify DEADLINE (no retry);
+        # an unreachable coordinator is the TRANSIENT, retry-worthy case
+        raise RuntimeError(
+            f"UNAVAILABLE: coordinator probe to {addr} got no connection "
+            f"within {timeout:g}s") from None
+    if _PROBE_SENTINEL not in (proc.stdout or ""):
+        raise RuntimeError(
+            f"UNAVAILABLE: coordinator {addr} unreachable "
+            f"(probe rc={proc.returncode}: {(proc.stderr or '')[-300:]})")
 
 
 def init_distributed(
@@ -26,6 +68,8 @@ def init_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     auto: bool = False,
+    timeout_s: float = 60.0,
+    probe: bool = True,
 ) -> bool:
     """Initialize multi-host JAX (ncclCommInitRank rendezvous analog).
 
@@ -36,23 +80,54 @@ def init_distributed(
     a non-pod machine the no-arg call can block looking for a coordinator.
     Returns False (no-op) when no source is available and ``auto`` is off.
     Idempotent: a second call returns True without re-initializing.
+
+    Robustness (ISSUE 3): before committing to the in-process handshake, a
+    subprocess-isolated reachability probe (``probe=True``) bounds the
+    unreachable-coordinator wedge to seconds; the probe and the handshake
+    each get one classified TRANSIENT retry with deterministic backoff,
+    and ``timeout_s`` is forwarded as the rendezvous
+    ``initialization_timeout`` where the jax version supports it.
     """
     if getattr(init_distributed, "_done", False):
         return True
+    from raft_tpu.resilience import RetryPolicy, faultpoint, with_retries
+
+    retry_once = RetryPolicy(max_retries=1, base_delay_s=0.5, max_delay_s=2.0)
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = num_processes if num_processes is not None else os.environ.get("JAX_NUM_PROCESSES")
     pid = process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID")
+
+    def _initialize(**kwargs) -> None:
+        import inspect
+
+        # inside the retried callable, so an armed fault exercises the
+        # same recovery path a real transient handshake failure takes
+        faultpoint("comms.init_distributed")
+
+        try:
+            params = inspect.signature(jax.distributed.initialize).parameters
+        except (TypeError, ValueError):  # pragma: no cover - C-level signature
+            params = {}
+        if "initialization_timeout" in params:
+            kwargs["initialization_timeout"] = max(1, int(timeout_s))
+        jax.distributed.initialize(**kwargs)
+
     if addr is None and nproc is None:
         if not auto:
             return False
-        jax.distributed.initialize()
+        with_retries(_initialize, retry_once, site="comms.init_distributed")
         init_distributed._done = True
         return True
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(nproc) if nproc is not None else None,
-        process_id=int(pid) if pid is not None else None,
-    )
+    if probe and addr:
+        with_retries(lambda: _probe_coordinator(addr, timeout_s / 4.0),
+                     retry_once, site="comms.init_distributed.probe")
+    with_retries(
+        lambda: _initialize(
+            coordinator_address=addr,
+            num_processes=int(nproc) if nproc is not None else None,
+            process_id=int(pid) if pid is not None else None,
+        ),
+        retry_once, site="comms.init_distributed")
     init_distributed._done = True
     return True
 

@@ -5,14 +5,22 @@ that stream-synchronizing loops poll; `cancel()` from another thread raises
 `interrupted_exception` at the next synchronization point. The TPU analog:
 long-running *host-side* loops (k-means EM, NN-descent rounds, tiled batch
 queries) call :func:`check_interrupt` between device steps.
+
+Extension point (ISSUE 3): :func:`add_checkpoint` registers extra checks
+that run at every :func:`check_interrupt` site — ``resilience.deadline``
+uses it so every existing interrupt checkpoint doubles as a deadline
+checkpoint without this module importing (or even knowing about) the
+resilience layer.
 """
 
 from __future__ import annotations
 
 import threading
+from typing import Callable, List
 
 _flags: dict = {}
 _lock = threading.Lock()
+_checkpoints: List[Callable] = []
 
 
 class InterruptedException(RuntimeError):
@@ -36,9 +44,22 @@ def clear(thread_id=None) -> None:
         _flags.pop(_token(thread_id), None)
 
 
+def add_checkpoint(fn: Callable) -> None:
+    """Register ``fn()`` to run at every :func:`check_interrupt` call
+    (idempotent). ``fn`` raises to stop the checkpointed loop — e.g. the
+    resilience layer's deadline check raising ``DeadlineExceeded``."""
+    with _lock:
+        if fn not in _checkpoints:
+            _checkpoints.append(fn)
+
+
 def check_interrupt() -> None:
-    """Raise :class:`InterruptedException` if this thread was cancelled."""
+    """Raise :class:`InterruptedException` if this thread was cancelled,
+    then run the registered checkpoint hooks (deadlines, …)."""
     tid = threading.get_ident()
     with _lock:
         if _flags.pop(tid, False):
             raise InterruptedException(f"thread {tid} interrupted")
+        hooks = tuple(_checkpoints)
+    for fn in hooks:
+        fn()

@@ -71,6 +71,9 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
         out_specs=(P(axis, None), P(axis, None)),
         check_vma=False,
     ))
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("distributed.assign_phase")
     with obs.record_span("distributed::assign_phase"):
         labels_sh, counts_sh = fn(work_sh, gids_sh)
         counts_np = np.asarray(counts_sh)
@@ -217,8 +220,14 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
     n_tiles = 0
     zero = jnp.zeros((1,), jnp.int32)
     zero2 = jnp.zeros((1, 1), jnp.int32)
+    from raft_tpu.core.interruptible import check_interrupt
+    from raft_tpu.resilience import faultpoint
+
     with obs.record_span("distributed::tiled_search"):
         while start < q:
+            check_interrupt()  # per-tile checkpoint: cancel/hard-deadline
+            # land between dispatches, not after the full query set
+            faultpoint("distributed.tiled_search.tile")
             qt = min(q_tile, q - start)
             if dense:
                 # dense_local_scan never reads the strip tables: skip the

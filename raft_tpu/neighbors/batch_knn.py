@@ -60,19 +60,10 @@ def _merge_running(best_v, best_i, vals, ids, k: int):
     return -v, jnp.take_along_axis(alli, sel, axis=1)
 
 
-@traced("batch_knn::search_device_chunked")
 @functools.partial(jax.jit, static_argnames=("k", "chunk_rows", "metric"))
-def search_device_chunked(dataset, queries, k: int,
-                          chunk_rows: int = 131072,
-                          metric: str = "sqeuclidean"):
-    """Exact kNN over a DEVICE-resident dataset too large for one (q, n)
-    score matrix (e.g. 10M rows: the full fp32 block would be tens of GB).
-
-    One dispatch: a ``fori_loop`` slides a (chunk_rows, dim) window over
-    the dataset, each step one MXU gemm + an exact iterative top-k merged
-    into the running (q, k) state. The complement of ``search_out_of_core``
-    (host-resident streaming) for datasets that fit HBM but whose score
-    matrix does not. Returns (distances (q, k), indices (q, k))."""
+def _search_device_chunked_impl(dataset, queries, k: int, chunk_rows: int,
+                                metric: str):
+    """One-dispatch chunked scan (see :func:`search_device_chunked`)."""
     metric = dist_mod.canonical_metric(metric)
     if metric not in SUPPORTED_METRICS:
         raise ValueError(
@@ -128,6 +119,39 @@ def search_device_chunked(dataset, queries, k: int,
     return best_v, best_i
 
 
+@traced("batch_knn::search_device_chunked")
+def search_device_chunked(dataset, queries, k: int,
+                          chunk_rows: int = 131072,
+                          metric: str = "sqeuclidean"):
+    """Exact kNN over a DEVICE-resident dataset too large for one (q, n)
+    score matrix (e.g. 10M rows: the full fp32 block would be tens of GB).
+
+    One dispatch: a ``fori_loop`` slides a (chunk_rows, dim) window over
+    the dataset, each step one MXU gemm + an exact iterative top-k merged
+    into the running (q, k) state. The complement of ``search_out_of_core``
+    (host-resident streaming) for datasets that fit HBM but whose score
+    matrix does not. Returns (distances (q, k), indices (q, k)).
+
+    OOM-adaptive (ISSUE 3): ``chunk_rows`` sizes the resident
+    (chunk + (q, chunk) score block) workspace; a ``RESOURCE_EXHAUSTED``
+    failure re-dispatches at half the chunk size down to a floor
+    (``resilience.degrade_on_oom``), recording ``resilience.degraded_tile``
+    — the round-4 deep10m OOM class recovers instead of sinking the
+    section."""
+    from raft_tpu.resilience import degrade_on_oom, faultpoint
+
+    chunk_rows = min(int(chunk_rows), dataset.shape[0])
+
+    def attempt(rows):
+        faultpoint("batch_knn.search_device_chunked")
+        return _search_device_chunked_impl(dataset, queries, int(k),
+                                           int(rows), metric)
+
+    floor = min(chunk_rows, max(int(k), 128))
+    return degrade_on_oom(attempt, chunk_rows, floor=floor,
+                          site="batch_knn.search_device_chunked")
+
+
 @traced("batch_knn::search_out_of_core")
 def search_out_of_core(
     dataset,
@@ -163,26 +187,44 @@ def search_out_of_core(
         chunk_rows = int(max(k, min(n, res.workspace_bytes // max(1, (dim + q) * 4))))
     qn = dist_mod.sqnorm(queries)
 
-    best_v = jnp.full((queries.shape[0], k),
-                      jnp.inf, jnp.float32)
-    best_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
     from raft_tpu.core.interruptible import check_interrupt
+    from raft_tpu.resilience import (active_deadline, degrade_on_oom,
+                                     faultpoint)
 
-    for s in range(0, n, chunk_rows):
-        check_interrupt()
-        host_chunk = np.asarray(dataset[s:s + chunk_rows], dtype=np.float32)
-        chunk = jax.device_put(host_chunk)
-        if metric == "cosine":
-            chunk = chunk / jnp.maximum(
-                jnp.linalg.norm(chunk, axis=1, keepdims=True), 1e-30)
-        cn = dist_mod.sqnorm(chunk)
-        vals, ids = _chunk_topk(queries, qn, chunk, cn, s, int(k), metric,
-                                select_algo)
-        if vals.shape[1] < k:  # short final chunk: pad before the merge
-            pad = k - vals.shape[1]
-            vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=jnp.inf)
-            ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
-        best_v, best_i = _merge_running(best_v, best_i, vals, ids, int(k))
+    def scan(chunk_rows):
+        # the whole host loop is the degradation unit: an OOM mid-stream
+        # restarts the scan at half the chunk size (state is per-scan, so
+        # a restart is exact); an expired Deadline breaks AFTER at least
+        # one chunk and marks the scope degraded — the running top-k over
+        # the scanned prefix is the partial result
+        best_v = jnp.full((queries.shape[0], k),
+                          jnp.inf, jnp.float32)
+        best_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
+        for s in range(0, n, chunk_rows):
+            dl = active_deadline()
+            if dl is not None and s > 0 and dl.reached():
+                dl.mark_degraded("batch_knn.search_out_of_core")
+                break
+            check_interrupt()
+            faultpoint("batch_knn.search_out_of_core.chunk")
+            host_chunk = np.asarray(dataset[s:s + chunk_rows], dtype=np.float32)
+            chunk = jax.device_put(host_chunk)
+            if metric == "cosine":
+                chunk = chunk / jnp.maximum(
+                    jnp.linalg.norm(chunk, axis=1, keepdims=True), 1e-30)
+            cn = dist_mod.sqnorm(chunk)
+            vals, ids = _chunk_topk(queries, qn, chunk, cn, s, int(k), metric,
+                                    select_algo)
+            if vals.shape[1] < k:  # short final chunk: pad before the merge
+                pad = k - vals.shape[1]
+                vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=jnp.inf)
+                ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+            best_v, best_i = _merge_running(best_v, best_i, vals, ids, int(k))
+        return best_v, best_i
+
+    best_v, best_i = degrade_on_oom(
+        scan, chunk_rows, floor=min(int(chunk_rows), max(int(k), 128)),
+        site="batch_knn.search_out_of_core")
 
     if metric == "euclidean":
         best_v = jnp.sqrt(jnp.maximum(best_v, 0.0))

@@ -203,18 +203,29 @@ def search(
         obs.add("brute_force.search.queries", q_obs)
         obs.add("brute_force.search.rows_scanned", q_obs * n)
         obs.add("brute_force.search.tiles", ceil_div(n, int(tile_rows)))
-    return _search_impl(
-        queries,
-        index.dataset,
-        index.norms,
-        filter,
-        int(k),
-        index.metric,
-        float(index.metric_arg),
-        int(tile_rows),
-        select_algo,
-        res.compute_dtype if index.metric in dist_mod.EXPANDED_METRICS else None,
-    )
+    from raft_tpu.resilience import degrade_on_oom, faultpoint
+
+    def attempt(tr):
+        faultpoint("brute_force.search")
+        return _search_impl(
+            queries,
+            index.dataset,
+            index.norms,
+            filter,
+            int(k),
+            index.metric,
+            float(index.metric_arg),
+            int(tr),
+            select_algo,
+            res.compute_dtype if index.metric in dist_mod.EXPANDED_METRICS else None,
+        )
+
+    # OOM-adaptive (ISSUE 3): the tile only partitions the scan — any size
+    # >= min(n, k) is exact — so a RESOURCE_EXHAUSTED retries at half the
+    # tile down to the floor instead of failing the query
+    floor = min(int(tile_rows), max(min(n, int(k)), 128))
+    return degrade_on_oom(attempt, int(tile_rows), floor=floor,
+                          site="brute_force.search")
 
 
 @traced("brute_force::knn")

@@ -514,6 +514,9 @@ def build(
     """
     import time as _time
 
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("cagra.build")
     res = res or current_resources()
     X = jnp.asarray(dataset, jnp.float32)
     n, dim = X.shape
@@ -1070,9 +1073,15 @@ def search(
         obs.add("cagra.search.iterations", nq * max_iter)
         obs.add(f"cagra.search.traversal.{mode}", 1)
 
+    from raft_tpu.core.interruptible import check_interrupt
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("cagra.search")
     fb = filter.bits if filter is not None else None
     outs = []
     for ti, s in enumerate(range(0, nq, q_tile)):
+        check_interrupt()  # tiles dispatch back-to-back; this is the only
+        # host checkpoint a multi-tile search passes through
         qs = queries[s:s + q_tile]
         if qs.shape[0] < q_tile:
             qs = jnp.pad(qs, ((0, q_tile - qs.shape[0]), (0, 0)))

@@ -562,6 +562,9 @@ def search(
         obs.add("ivf_flat.search.rows_scanned",
                 q_obs * n_probes * index.max_list_size)
         obs.add(f"ivf_flat.search.backend.{backend}", 1)
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("ivf_flat.search.scan")
     if backend == "ragged":
         if not aligned:
             raise ValueError(

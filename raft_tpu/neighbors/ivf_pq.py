@@ -1480,6 +1480,9 @@ def search(
         obs.add("ivf_pq.search.rows_scanned",
                 q_obs * n_probes * index.max_list_size)
         obs.add(f"ivf_pq.search.backend.{backend}", 1)
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("ivf_pq.search.scan")
     if backend == "ragged":
         if not aligned:
             raise ValueError(

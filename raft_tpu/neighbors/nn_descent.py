@@ -276,7 +276,16 @@ def build(
     ids, dists, is_new = _init_state(kinit, X, norms, K, block_rows=4096)
 
     threshold = params.termination_threshold * n * K
+    from raft_tpu.resilience import active_deadline
+
     for it in range(params.max_iterations):
+        # deadline checkpoint (ISSUE 3): descent is anytime — every round
+        # only improves the graph — so an expiring budget returns the
+        # current graph marked degraded instead of dying to the watchdog
+        dl = active_deadline()
+        if dl is not None and it > 0 and dl.reached():
+            dl.mark_degraded("nn_descent.build")
+            break
         check_interrupt()
         kit, key = jax.random.split(key)
         ids, dists, is_new, updates = _iteration(

@@ -1,0 +1,88 @@
+"""Fault tolerance: classify the failure, shrink the work, retry.
+
+The resilience layer the ROADMAP's "heavy traffic from millions of users"
+north star presupposes and the round-4/round-5 incidents demanded — four
+parts, each usable alone:
+
+* :mod:`~raft_tpu.resilience.errors` — :func:`classify` maps raw
+  exceptions to ``OOM | TRANSIENT | DEADLINE | FATAL``; every broad
+  ``except`` in bench and distributed paths routes through it (enforced by
+  graftlint's ``unclassified-except`` rule).
+* :mod:`~raft_tpu.resilience.retry` — :func:`with_retries` (bounded,
+  deterministically-jittered backoff for TRANSIENT) and
+  :func:`degrade_on_oom` (the adaptive executor that re-runs an OOM'd
+  callable at half the tile/chunk size down to a floor), both feeding
+  ``resilience.*`` obs counters and the :func:`recent_events` ring.
+* :mod:`~raft_tpu.resilience.deadline` — :class:`Deadline` scopes that
+  every ``check_interrupt()`` site consults; partial-capable loops return
+  degraded results (``dl.degraded``) instead of dying to the watchdog.
+* :mod:`~raft_tpu.resilience.faultinject` — :func:`faultpoint` sites armed
+  via ``RAFT_TPU_FAULTS=site=oom:1``-style specs, which is what makes all
+  of the above testable on CPU in tier-1.
+"""
+
+from raft_tpu.resilience.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    active_deadline,
+    check_deadline,
+)
+from raft_tpu.resilience.errors import (
+    DEADLINE,
+    FATAL,
+    KINDS,
+    OOM,
+    TRANSIENT,
+    classify,
+    is_retryable,
+)
+from raft_tpu.resilience.faultinject import (
+    FaultInjected,
+    arm_faults,
+    armed_sites,
+    clear_faults,
+    faultpoint,
+)
+from raft_tpu.resilience.retry import (
+    RetryPolicy,
+    backoff_delays,
+    clear_events,
+    degrade_on_oom,
+    disable_sync,
+    enable_sync,
+    force_completion,
+    recent_events,
+    record_event,
+    sync_mode,
+    with_retries,
+)
+
+__all__ = [
+    "DEADLINE",
+    "Deadline",
+    "DeadlineExceeded",
+    "FATAL",
+    "FaultInjected",
+    "KINDS",
+    "OOM",
+    "RetryPolicy",
+    "TRANSIENT",
+    "active_deadline",
+    "arm_faults",
+    "armed_sites",
+    "backoff_delays",
+    "check_deadline",
+    "classify",
+    "clear_events",
+    "clear_faults",
+    "degrade_on_oom",
+    "disable_sync",
+    "enable_sync",
+    "faultpoint",
+    "force_completion",
+    "is_retryable",
+    "recent_events",
+    "record_event",
+    "sync_mode",
+    "with_retries",
+]

@@ -36,7 +36,8 @@ def pad_and_tile(x: jax.Array, tile: int, fill=0) -> Tuple[jax.Array, int]:
     return xp.reshape((n_tiles, tile) + x.shape[1:]), n_tiles
 
 
-def map_row_tiles(fn, args: Tuple, tile: int, fills: Tuple = None):
+def map_row_tiles(fn, args: Tuple, tile: int, fills: Tuple = None,
+                  min_tile: int = 128):
     """Run ``fn`` over row tiles of several same-leading-dim arrays and
     restitch the row dimension.
 
@@ -45,16 +46,40 @@ def map_row_tiles(fn, args: Tuple, tile: int, fills: Tuple = None):
     called directly (no pad/reshape). ``fills`` optionally gives the padding
     value per arg (default 0 — searches that must ignore padded rows should
     pass sentinel fills, e.g. -1 for id arrays).
+
+    When called EAGERLY (no argument is a tracer), the tile size is
+    OOM-adaptive (ISSUE 3): a ``RESOURCE_EXHAUSTED`` dispatch retries at
+    half the tile down to ``min_tile`` via ``resilience.degrade_on_oom``
+    (the result is forced inside the attempt so the failure surfaces where
+    it can be recovered). Under jit tracing the original single-dispatch
+    path runs unchanged — recovery then belongs to the caller's host
+    wrapper.
     """
     n = args[0].shape[0]
     if tile >= n:
         return fn(args)
     fills = fills or (0,) * len(args)
-    n_tiles = ceil_div(n, tile)
-    tiled = tuple(
-        pad_and_tile(a, tile, fill)[0] for a, fill in zip(args, fills)
-    )
-    out = jax.lax.map(fn, tiled)
-    def unstitch(o):
-        return o.reshape((n_tiles * tile,) + o.shape[2:])[:n]
-    return jax.tree.map(unstitch, out)
+
+    def run(tile):
+        n_tiles = ceil_div(n, tile)
+        tiled = tuple(
+            pad_and_tile(a, tile, fill)[0] for a, fill in zip(args, fills)
+        )
+        out = jax.lax.map(fn, tiled)
+        def unstitch(o):
+            return o.reshape((n_tiles * tile,) + o.shape[2:])[:n]
+        return jax.tree.map(unstitch, out)
+
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        return run(tile)
+    from raft_tpu.resilience import degrade_on_oom, force_completion
+
+    def attempt(t):
+        # scalar host fetch, not block_until_ready: the latter does NOT
+        # synchronize on the tunneled axon runtime, and an unsurfaced
+        # async OOM would escape the executor (bench.py timing note)
+        return force_completion(run(t))
+
+    return degrade_on_oom(attempt, tile,
+                          floor=min(int(tile), max(1, int(min_tile))),
+                          site="tiling.map_row_tiles")

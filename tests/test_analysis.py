@@ -235,6 +235,27 @@ def load(path):
 """,
     ),
     (
+        "unclassified-except",
+        "bench.py",
+        """
+def run(section):
+    try:
+        return section()
+    except Exception as e:
+        return {"error": repr(e)[:300]}
+""",
+        # near-miss: the failure class is preserved via resilience.classify
+        """
+from raft_tpu.resilience import classify
+
+def run(section):
+    try:
+        return section()
+    except Exception as e:
+        return {"error": repr(e)[:300], "kind": classify(e)}
+""",
+    ),
+    (
         "unused-import",
         "mod.py",
         """
