@@ -54,6 +54,15 @@ Flags: ``--heartbeat PATH`` (default results/bench_progress.jsonl),
 ``RAFT_TPU_BENCH_TINY=1`` shrinks every section to smoke-test scale;
 ``RAFT_TPU_BENCH_SECTIONS=brute_force,ivf_flat`` runs a subset (brute force
 always runs — it is the ground-truth anchor).
+
+Telemetry (round 8): children run with obs enabled — search sections record
+per-batch latency histograms (p50/p90/p99 upper bounds ride the metric
+line) and each child writes a process-stamped metrics snapshot + Perfetto
+trace to ``RAFT_TPU_BENCH_METRICS_DIR`` / ``RAFT_TPU_BENCH_TRACE_DIR``
+(parent default: results/metrics, results) through bench/progress.py's
+fsync'd channel; the parent folds the per-process metric files into
+``results/metrics_fleet.json`` (obs/aggregate). Diff rounds with
+``scripts/bench_compare.py``.
 """
 
 import json
@@ -142,8 +151,29 @@ def _force(x):
     return float(jnp.sum(x))
 
 
-def _time_qps(run, queries, reps: int) -> float:
-    """Amortized wall-clock QPS of `run(queries)` over `reps` dispatches."""
+def _observe_batch_latency(run, queries, reps: int, hist: str) -> None:
+    """Per-batch latency pass: time each rep INDIVIDUALLY (dispatch +
+    forced completion — back-to-back amortization cannot see per-batch
+    latency) into histogram ``hist``, so metric lines carry p50/p90/p99
+    upper bounds, not just means. The ONE timing protocol shared by every
+    section (a second copy could silently drift its percentiles)."""
+    from raft_tpu import obs
+
+    for _ in range(reps):
+        t1 = time.perf_counter()
+        v, _ = run(queries)
+        _force(v)
+        obs.observe(hist, time.perf_counter() - t1)
+
+
+def _time_qps(run, queries, reps: int, hist: str = "") -> float:
+    """Amortized wall-clock QPS of `run(queries)` over `reps` dispatches.
+
+    When telemetry is on and ``hist`` names a histogram, a SECOND pass
+    (:func:`_observe_batch_latency`) records per-batch latency; the QPS
+    number still comes from the amortized loop, unchanged."""
+    from raft_tpu import obs
+
     v, _ = run(queries)
     _force(v)  # warm/compile
     t0 = time.perf_counter()
@@ -151,6 +181,8 @@ def _time_qps(run, queries, reps: int) -> float:
         v, _ = run(queries)
     _force(v)  # drains the dispatch queue
     dt = (time.perf_counter() - t0) / reps
+    if hist and obs.enabled():
+        _observe_batch_latency(run, queries, reps, hist)
     return queries.shape[0] / dt
 
 
@@ -182,6 +214,25 @@ def run_suite():
     from raft_tpu.bench import progress as prog
     from raft_tpu.bench.datasets import sift_like
     from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
+
+    # telemetry ON for the whole measured child (round-8): the bench window
+    # exists to answer where the time went, so spans/counters/latency
+    # histograms must populate — the per-call overhead is host-side
+    # microseconds against ms-scale batches, and the per-phase completion
+    # barriers it enables (cagra _sync) are exactly what build_phases_s
+    # needs to be comparable across rounds
+    obs.enable()
+    # ...but NEVER span-level sync mode: an inherited RAFT_TPU_OBS_SYNC=1
+    # would force-drain at every scan-span exit inside _time_qps's
+    # back-to-back loop, turning amortized QPS into synced per-call latency
+    # (per-batch latency already has its own dedicated pass)
+    obs.disable_sync()
+
+    def latency_percentiles(hist_name):
+        """p50/p90/p99 upper bounds of one batch-latency histogram, for the
+        section's metric line (≤2× bucket-bound error, obs/aggregate)."""
+        h = obs.snapshot()["histograms"].get(hist_name) or {}
+        return {k: h[k] for k in ("p50_ub", "p90_ub", "p99_ub") if k in h}
 
     def section_error(e):
         """Classified section-failure stamp (ISSUE 3): every section guard
@@ -262,9 +313,12 @@ def run_suite():
     def bf_run(qs):
         return brute_force.search(bf_index, qs, K, select_algo="approx")
 
-    bf_qps = _time_qps(bf_run, queries, REPS)
+    bf_qps = _time_qps(bf_run, queries, REPS,
+                       hist="bench.brute_force.batch_latency_s")
     bf_recall = float(stats.neighborhood_recall(bf_run(queries)[1], gt_ids))
-    extras["brute_force"] = {"qps": round(bf_qps, 1), "recall": round(bf_recall, 4)}
+    extras["brute_force"] = {"qps": round(bf_qps, 1), "recall": round(bf_recall, 4),
+                             **latency_percentiles(
+                                 "bench.brute_force.batch_latency_s")}
     hb.section("brute_force", extras["brute_force"])
 
     def timed_build(build):
@@ -303,7 +357,8 @@ def run_suite():
                     break
             flat["qps"] = round(_time_qps(
                 lambda qs: ivf_flat.search(flat_index, qs, K, n_probes=flat["nprobe"]),
-                queries, REPS), 1)
+                queries, REPS, hist="bench.ivf_flat.batch_latency_s"), 1)
+            flat.update(latency_percentiles("bench.ivf_flat.batch_latency_s"))
             flat["build_s"] = cold_s
             flat["build_warm_s"] = warm_s
             extras["ivf_flat"] = flat
@@ -354,7 +409,10 @@ def run_suite():
                                         n_probes=pq["nprobe"])
                 return refine.refine(dataset, qs, cand, K)
 
-            pq["qps"] = round(_time_qps(pq_timed, queries, REPS), 1)
+            pq["qps"] = round(_time_qps(
+                pq_timed, queries, REPS,
+                hist="bench.ivf_pq.batch_latency_s"), 1)
+            pq.update(latency_percentiles("bench.ivf_pq.batch_latency_s"))
             pq["build_s"] = cold_s
             pq["build_warm_s"] = warm_s
             extras["ivf_pq"] = pq
@@ -390,19 +448,13 @@ def run_suite():
             # graph_degree=64 (the reference default): measured the difference
             # between 0.87 and 0.98 recall at 1M — degree-32 graphs lose
             # navigability at this scale
-            # telemetry ON for the build: cagra's per-phase _sync barriers
-            # are obs-gated, and build_phases_s must record completion times
-            # (comparable with pre-gating rounds), not dispatch times
-            _obs_was_on = obs.enabled()
-            obs.enable()
-            try:
-                cidx = cagra.build(csub, cagra.CagraParams(
-                    intermediate_graph_degree=128 if not on_cpu else 64,
-                    graph_degree=64 if not on_cpu else 32,
-                    build_algo=calgo))
-            finally:
-                if not _obs_was_on:
-                    obs.disable()
+            # telemetry is already on suite-wide (run_suite's obs.enable()),
+            # so cagra's obs-gated per-phase _sync barriers measure
+            # completion times, which is what build_phases_s must record
+            cidx = cagra.build(csub, cagra.CagraParams(
+                intermediate_graph_degree=128 if not on_cpu else 64,
+                graph_degree=64 if not on_cpu else 32,
+                build_algo=calgo))
             _force(cidx.graph)
             if cidx.nbr_codes is not None:
                 _force(cidx.nbr_codes)  # compression is part of build_s
@@ -441,6 +493,9 @@ def run_suite():
                 # a sub-gate rung cannot beat an at-gate best: skip its timing
                 if best is not None and best["recall"] >= 0.95 > crec:
                     continue
+                # no hist here: the per-batch latency pass would run for
+                # EVERY rung and burn window budget on configs that lose
+                # the ladder — the winner gets one dedicated pass below
                 cqps = round(_time_qps(
                     lambda qs: cagra.search(cidx, qs, K, sp),
                     cq, max(1, REPS // 2)), 1)
@@ -457,6 +512,16 @@ def run_suite():
                 raise RuntimeError(
                     f"every cagra ladder rung failed; last: {last_err!r}")
             best["build_s"] = round(cbuild, 1)
+            # ONE per-batch latency pass, for the selected config only
+            # (percentiles must describe a single config, and losing rungs
+            # must not pay the individually-forced dispatches)
+            best_sp = cagra.CagraSearchParams(
+                itopk_size=best["itopk"], search_width=best["width"],
+                traversal=best["traversal"])
+            _observe_batch_latency(
+                lambda qs: cagra.search(cidx, qs, K, best_sp),
+                cq, max(1, REPS // 2), "bench.cagra.batch_latency_s")
+            best.update(latency_percentiles("bench.cagra.batch_latency_s"))
             best["build_phases_s"] = getattr(cidx, "_build_timings_s", {})
             best["n"] = cn
             best["q"] = int(cq.shape[0])
@@ -541,7 +606,37 @@ def run_suite():
         "recall_gate_met": bool(gate >= 0.95),
         "extras": extras,
     }
+
+    # --- per-host telemetry artifacts (round-8 fleet aggregation): one
+    # process-stamped metrics snapshot + one Perfetto trace per process,
+    # both through bench/progress.py's fsync'd channel (graftlint span-name
+    # flags direct export calls here). The parent merges the metric files
+    # into results/metrics_fleet.json via obs/aggregate.
+    # the run is COMPLETE: checkpoint the headline FIRST, so even a hung
+    # (not raising) telemetry write below — fsync on a wedged mount — leaves
+    # a salvageable run_end record rather than eating the finished round
     hb.finish({"metric": metric, "value": result["value"]})
+
+    # best-effort by contract: telemetry artifacts are a nice-to-have, and
+    # their write failing (read-only fs, disk full) must never downgrade a
+    # COMPLETED measured round to heartbeat salvage
+    try:
+        pi, _pc = prog.process_info()
+        mdir = os.environ.get("RAFT_TPU_BENCH_METRICS_DIR", "").strip()
+        if mdir:
+            mpath = os.path.join(mdir, f"bench_p{pi}.jsonl")
+            prog.export_metrics(mpath, obs.snapshot(),
+                                extra={"run": "bench", "metric": metric,
+                                       "platform": result["platform"]})
+            result["metrics_file"] = mpath
+        tdir = os.environ.get("RAFT_TPU_BENCH_TRACE_DIR", "").strip()
+        if tdir:
+            tpath = os.path.join(tdir, f"trace_bench_p{pi}.json")
+            prog.write_artifact(tpath, obs.chrome_trace(
+                extra={"run": "bench", "metric": metric}))
+            result["trace_file"] = tpath
+    except Exception as e:
+        extras["telemetry_export_error"] = section_error(e)
     return result
 
 
@@ -657,6 +752,23 @@ def _attempt(platform: str, timeout: float, hb_path=None):
     else:
         env = dict(os.environ)
     env["RAFT_TPU_BENCH_CHILD"] = platform
+    # per-host telemetry artifact targets (round-8): the child writes its
+    # process-stamped metrics + Perfetto trace here; the parent aggregates.
+    # Truncated PER ATTEMPT, not per run: a failed TPU attempt's per-process
+    # snapshots must not fold into the CPU fallback's fleet view (the
+    # dedup in obs/aggregate is per (source, process_index) — it cannot
+    # tell a stale attempt's p1..pN files from live ones)
+    metrics_dir = os.path.join(_REPO, "results", "metrics")
+    trace_dir = os.path.join(_REPO, "results")
+    if _PROGRESS is not None:
+        _PROGRESS.truncate_dir(metrics_dir)
+        # and the per-process traces: a dead 4-host attempt's p1..p3 traces
+        # must not sit next to the fallback's p0 looking current (prefix
+        # scoping keeps the committed round artifacts in results/ untouched)
+        _PROGRESS.truncate_dir(trace_dir, suffix=".json",
+                               prefix="trace_bench_p")
+    env["RAFT_TPU_BENCH_METRICS_DIR"] = metrics_dir
+    env["RAFT_TPU_BENCH_TRACE_DIR"] = trace_dir
     if hb_path:
         env["RAFT_TPU_BENCH_HEARTBEAT"] = hb_path
     else:
@@ -683,6 +795,39 @@ def _attempt(platform: str, timeout: float, hb_path=None):
         f"{platform} attempt rc={proc.returncode}\n"
         f"stdout: {(proc.stdout or '')[-1000:]}\nstderr: {(proc.stderr or '')[-2000:]}"
     )
+
+
+def _aggregate_fleet():
+    """Merge the children's per-process metric files into ONE fleet view
+    (results/metrics_fleet.json) via obs/aggregate — loaded by FILE PATH
+    (stdlib-only, same rule as progress/health: the parent never takes the
+    raft_tpu/jax import lock). Returns the artifact path, or None (a fleet
+    view is a nice-to-have; its absence must never cost the metric line)."""
+    metrics_dir = os.path.join(_REPO, "results", "metrics")
+    try:
+        files = sorted(
+            os.path.join(metrics_dir, f) for f in os.listdir(metrics_dir)
+            if f.endswith(".jsonl"))
+        if not files:
+            return None
+        agg = _load_by_path("_obs_aggregate", "raft_tpu", "obs",
+                            "aggregate.py")
+        fleet = agg.merge_files(files)
+        if not fleet.get("sources"):
+            # files existed but held no parseable records (torn writes from
+            # a dead child): advertising an empty fleet view would be worse
+            # than none
+            return None
+        out = os.path.join(_REPO, "results", "metrics_fleet.json")
+        _PROGRESS.write_artifact(out, fleet)
+        return out
+    # truly anything — a corrupted aggregate.py (SyntaxError from the
+    # file-path load) or a malformed record (TypeError in the merge) must
+    # degrade to "no fleet view", never crash the parent between a finished
+    # round and _emit(result); classification is unavailable here by design
+    # (the parent stays off the raft_tpu/jax import lock)
+    except Exception:  # graftlint: ignore[unclassified-except]
+        return None
 
 
 def _parse_args(argv):
@@ -733,6 +878,8 @@ def main():
                                            "bench_progress.jsonl"))
         _PROGRESS.truncate(hb_path)  # fresh file per run
         _HB_PATH = hb_path
+    # metric files are truncated per ATTEMPT inside _attempt (a failed TPU
+    # attempt's snapshots must not merge into the CPU fallback's fleet view)
 
     # --- device-health probe BEFORE committing to the TPU window (ISSUE 1:
     # the round-5 tunnel wedge burned the full window with no record) -------
@@ -763,6 +910,9 @@ def main():
             err_tpu = (f"skipped: derived TPU window {tpu_window:.0f}s < "
                        f"{MIN_ATTEMPT_SECONDS:.0f}s minimum")
     if result is not None:
+        fleet = _aggregate_fleet()
+        if fleet:
+            result["fleet_metrics"] = fleet
         _emit(result)
         return
 
@@ -772,6 +922,9 @@ def main():
     if result is not None:
         result["note"] = "tpu_attempt_failed; cpu fallback"
         result["tpu_error"] = (err_tpu or "")[-500:]
+        fleet = _aggregate_fleet()
+        if fleet:
+            result["fleet_metrics"] = fleet
         _emit(result)
         return
     # _fail salvages from the checkpoint file before emitting bench_error

@@ -21,6 +21,9 @@ Catalog (id — what it catches):
 * ``mutable-default``     — mutable default argument values
 * ``bench-io``            — bench results writes bypassing the crash-safe
   ``bench/progress.py`` channel
+* ``span-name``           — literal span names breaking the ``module::phase``
+  convention, and bench-scope ``export_jsonl``/trace exports bypassing
+  ``bench/progress.py``'s fsync'd channel
 * ``unclassified-except`` — broad except in bench.py / distributed paths
   that neither routes through ``resilience.classify()`` nor re-raises
   (the failure class must survive for recovery to see it)
@@ -36,6 +39,7 @@ from raft_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
     mutable_defaults,
     obs_coverage,
     recompile,
+    span_name,
     tracer_control,
     unclassified_except,
 )

@@ -33,6 +33,32 @@ from typing import List, Optional
 ENV_VAR = "RAFT_TPU_BENCH_HEARTBEAT"
 DEFAULT_PATH = os.path.join("results", "bench_progress.jsonl")
 
+
+def process_info() -> tuple:
+    """(process_index, process_count) for stamping records — the stdlib-only
+    twin of obs/tracing.process_info (this module must stay importable by
+    file path in jax-free parents, so it cannot share code with the obs
+    package). Same contract: env override first, then an ALREADY-initialized
+    jax backend (never triggers backend init — that is the wedge class this
+    whole module guards against), else (0, 1)."""
+    import sys as _sys
+
+    pi = os.environ.get("RAFT_TPU_PROCESS_INDEX", "").strip()
+    pc = os.environ.get("RAFT_TPU_PROCESS_COUNT", "").strip()
+    if pi.lstrip("-").isdigit():
+        return int(pi), int(pc) if pc.lstrip("-").isdigit() else 1
+    try:
+        jax = _sys.modules.get("jax")
+        xb = _sys.modules.get("jax._src.xla_bridge")
+        if jax is not None and xb is not None and \
+                getattr(xb, "_backends", None):
+            return int(jax.process_index()), int(jax.process_count())
+    # a stamp is best-effort decoration on a crash-safety path: any jax
+    # internals mismatch must degrade to (0, 1), never block a checkpoint
+    except Exception:  # graftlint: ignore[swallowed-exception]
+        pass
+    return 0, 1
+
 # single home of the headline denominator (bench.py reads it from here so a
 # retune cannot diverge between live and salvaged lines)
 NORTH_STAR_QPS = 1e6
@@ -62,9 +88,12 @@ class ProgressWriter:
             os.makedirs(d, exist_ok=True)
 
     def _write(self, rec: dict) -> None:
+        pi, pc = process_info()
         rec = {
             "t": round(time.time(), 3),
             "elapsed_s": round(time.monotonic() - self._t0, 3),
+            "process_index": pi,
+            "process_count": pc,
             **rec,
         }
         line = json.dumps(rec)
@@ -128,6 +157,70 @@ def truncate(path: str) -> None:
         os.makedirs(d, exist_ok=True)
     with open(path, "w"):
         pass
+
+
+def truncate_dir(directory: str, suffix: str = ".jsonl",
+                 prefix: str = "") -> None:
+    """Per-attempt reset of telemetry artifacts: remove stale per-process
+    files so a fleet merge (or a Perfetto session) never folds in a dead
+    attempt's output. ``prefix`` scopes the sweep when the directory also
+    holds unrelated files (results/ keeps committed round artifacts)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        # the + ".tmp" arm sweeps write_artifact temp files a SIGKILL
+        # stranded mid-write (os.replace never ran)
+        if (name.endswith(suffix) or name.endswith(suffix + ".tmp")) and \
+                name.startswith(prefix):
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
+def write_artifact(path: str, doc: dict) -> None:
+    """Crash-safely write one JSON artifact — tmp file, flush, fsync, then
+    atomic ``os.replace`` — the sanctioned channel for bench-side trace
+    exports and fleet views: a kill mid-write leaves either the old file or
+    the complete new one, never a torn one (graftlint's span-name rule
+    points direct exports here)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def export_metrics(path: str, snapshot: dict,
+                   extra: Optional[dict] = None) -> dict:
+    """Append one process-stamped metrics snapshot line to ``path`` with the
+    heartbeat file's durability (flush + fsync per record) — the bench-side
+    analog of ``obs.export_jsonl`` (which flushes but does not fsync, and
+    which bench code must not call directly). Returns the record written."""
+    pi, pc = process_info()
+    rec = {"t": round(time.time(), 3), "process_index": pi,
+           "process_count": pc, **(extra or {}), **snapshot}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return rec
 
 
 def from_env(platform: str = ""):

@@ -189,12 +189,15 @@ def _fit_full(X, n_clusters, params, res):
     # choice(replace=False)'s O(n log n) permutation compile (round 3)
     rows = jax.random.randint(k_init, (n_clusters,), 0, n)
     centers0 = X[rows].astype(jnp.float32)
+    em_attrs = None
     if obs.enabled():
         obs.add("kmeans_balanced.fits", 1)
         obs.add("kmeans_balanced.rows", n)
         # configured, not executed: the balancing loop may run up to 5× this
         # (_balanced_em does not surface its actual count)
         obs.add("kmeans_balanced.iterations_configured", int(params.n_iters))
+        em_attrs = {"rows": int(n), "clusters": int(n_clusters),
+                    "iters_configured": int(params.n_iters)}
     # host checkpoint before the (single, long) balanced-EM dispatch — the
     # interruptible docstring names k-means as a checkpoint site; the EM
     # loop itself is one compiled while_loop, so this is where a cancel or
@@ -205,16 +208,20 @@ def _fit_full(X, n_clusters, params, res):
     check_interrupt()
     faultpoint("kmeans_balanced.fit.em")
     with use_resources(res):
-        return _balanced_em(
-            X.astype(jnp.float32),
-            centers0,
-            k_adjust,
-            int(n_clusters),
-            int(params.n_iters),
-            params.metric,
-            float(params.balancing_threshold),
-            int(res.workspace_bytes),
-        )
+        # phase span: under a @traced fit/fit_predict entry this is the
+        # child node that carries the EM dispatch (and, in sync mode, its
+        # committed device time) plus rows/clusters attrs
+        with obs.record_span("kmeans_balanced::em", attrs=em_attrs):
+            return _balanced_em(
+                X.astype(jnp.float32),
+                centers0,
+                k_adjust,
+                int(n_clusters),
+                int(params.n_iters),
+                params.metric,
+                float(params.balancing_threshold),
+                int(res.workspace_bytes),
+            )
 
 
 def predict(

@@ -74,13 +74,16 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
     from raft_tpu.resilience import faultpoint
 
     faultpoint("distributed.assign_phase")
-    with obs.record_span("distributed::assign_phase"):
-        labels_sh, counts_sh = fn(work_sh, gids_sh)
-        counts_np = np.asarray(counts_sh)
+    assign_attrs = None
     if obs.enabled():
         obs.add("distributed.assign.shards", comms.size)
         obs.add("distributed.assign.rows",
                 int(work_sh.shape[0]) * int(work_sh.shape[1]))
+        assign_attrs = {"shard": int(comms.size),
+                        "rows": int(work_sh.shape[0]) * int(work_sh.shape[1])}
+    with obs.record_span("distributed::assign_phase", attrs=assign_attrs):
+        labels_sh, counts_sh = fn(work_sh, gids_sh)
+        counts_np = np.asarray(counts_sh)
     return labels_sh, counts_np
 
 
@@ -223,32 +226,44 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
     from raft_tpu.core.interruptible import check_interrupt
     from raft_tpu.resilience import faultpoint
 
-    with obs.record_span("distributed::tiled_search"):
+    search_attrs = None
+    if obs.enabled():
+        search_attrs = {"shard": int(comms.size), "queries": int(q),
+                        "probes": int(q * p)}
+    span = obs.record_span("distributed::tiled_search", attrs=search_attrs)
+    with span:
         while start < q:
             check_interrupt()  # per-tile checkpoint: cancel/hard-deadline
             # land between dispatches, not after the full query set
             faultpoint("distributed.tiled_search.tile")
             qt = min(q_tile, q - start)
-            if dense:
-                # dense_local_scan never reads the strip tables: skip the
-                # planning dispatch + its counts round-trip entirely
-                qids, strip_list, pair_strip, pair_slot = (
-                    zero2, zero, zero2, zero2)
-                layout = ((1, 1, 0, 1),)
-            else:
-                qids, strip_list, pair_strip, pair_slot, layout = plan_tile(
-                    probes, start, qt, cls_ord, classes, n_lists)
-            fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
-                              kf, dense, interpret, alpha, comms.size)
-            v, i = fn(queries_mat[start:start + qt],
-                      jax.lax.slice_in_dim(probes, start, start + qt, axis=0),
-                      pair_const[start:start + qt],
-                      qids, strip_list, pair_strip, pair_slot,
-                      data, ids_arr, bias)
+            with obs.record_span("distributed::search_tile",
+                                 attrs=({"tile": n_tiles, "rows": int(qt)}
+                                        if obs.enabled() else None)):
+                if dense:
+                    # dense_local_scan never reads the strip tables: skip
+                    # the planning dispatch + its counts round-trip entirely
+                    qids, strip_list, pair_strip, pair_slot = (
+                        zero2, zero, zero2, zero2)
+                    layout = ((1, 1, 0, 1),)
+                else:
+                    qids, strip_list, pair_strip, pair_slot, layout = \
+                        plan_tile(probes, start, qt, cls_ord, classes,
+                                  n_lists)
+                fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
+                                  kf, dense, interpret, alpha, comms.size)
+                v, i = fn(queries_mat[start:start + qt],
+                          jax.lax.slice_in_dim(probes, start, start + qt,
+                                               axis=0),
+                          pair_const[start:start + qt],
+                          qids, strip_list, pair_strip, pair_slot,
+                          data, ids_arr, bias)
             out_v.append(v)
             out_i.append(i)
             start += qt
             n_tiles += 1
+        # discovered only after the loop — attach before the span closes
+        span.set_attr("tiles", n_tiles)
     if obs.enabled():
         obs.add("distributed.search.shards", comms.size)
         obs.add("distributed.search.queries", q)

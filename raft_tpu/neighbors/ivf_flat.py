@@ -186,16 +186,20 @@ def build(
     )
 
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
-    if n_train < n:
-        key = jax.random.key(params.seed)
-        # with-replacement sampling: the ~n_train²/2n duplicate rate is noise
-        # for k-means, and it avoids the O(n log n) permutation program that
-        # choice(replace=False) compiles (round-3: ~25 s of XLA compile)
-        train_rows = jax.random.randint(key, (n_train,), 0, n)
-        centers = kmeans_balanced.fit(work[train_rows], params.n_lists, km, res=res)
-        labels = kmeans_balanced.predict(work, centers, km, res=res)
-    else:
-        centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+    # phase span (round-8): parented under the @traced entry span, so trace
+    # exports break a build into train vs pack time
+    with obs.record_span("ivf_flat::coarse_train"):
+        if n_train < n:
+            key = jax.random.key(params.seed)
+            # with-replacement sampling: the ~n_train²/2n duplicate rate is
+            # noise for k-means, and it avoids the O(n log n) permutation
+            # program that choice(replace=False) compiles (round-3: ~25 s of
+            # XLA compile)
+            train_rows = jax.random.randint(key, (n_train,), 0, n)
+            centers = kmeans_balanced.fit(work[train_rows], params.n_lists, km, res=res)
+            labels = kmeans_balanced.predict(work, centers, km, res=res)
+        else:
+            centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
 
     if obs.enabled():
         obs.add("ivf_flat.build.rows", n)
@@ -213,11 +217,12 @@ def build(
     # bf16 compute type on the fly (exact for |v| <= 256)
     store = (dataset if (jnp.issubdtype(dataset.dtype, jnp.integer)
                          and params.metric != "cosine") else work)
-    row_ids = jnp.arange(n, dtype=jnp.int32)
-    list_data, list_ids = _pack_lists(store, row_ids, labels, params.n_lists, group)
-    list_norms = None
-    if params.metric in ("sqeuclidean", "euclidean"):
-        list_norms = dist_mod.sqnorm(list_data, axis=2)
+    with obs.record_span("ivf_flat::pack"):
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+        list_data, list_ids = _pack_lists(store, row_ids, labels, params.n_lists, group)
+        list_norms = None
+        if params.metric in ("sqeuclidean", "euclidean"):
+            list_norms = dist_mod.sqnorm(list_data, axis=2)
     return IvfFlatIndex(centers, list_data, list_ids, list_norms, params.metric, group)
 
 
@@ -553,6 +558,7 @@ def search(
         backend = "ragged" if jax.default_backend() == "tpu" and aligned else "gather"
     if backend not in ("ragged", "gather"):
         raise ValueError(f"unknown backend {backend!r}")
+    scan_attrs = None
     if obs.enabled():
         q_obs = int(queries.shape[0])
         obs.add("ivf_flat.search.queries", q_obs)
@@ -562,9 +568,14 @@ def search(
         obs.add("ivf_flat.search.rows_scanned",
                 q_obs * n_probes * index.max_list_size)
         obs.add(f"ivf_flat.search.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k)}
     from raft_tpu.resilience import faultpoint
 
     faultpoint("ivf_flat.search.scan")
+    # one scan-phase span either way (attrs built under the gate above so
+    # the telemetry-off path stays a single branch)
+    scan_span = obs.record_span("ivf_flat::scan", attrs=scan_attrs)
     if backend == "ragged":
         if not aligned:
             raise ValueError(
@@ -572,24 +583,26 @@ def search(
                 f"multiple of 512, got {index.max_list_size}; rebuild with "
                 "group_size=512 (or use backend='gather')"
             )
-        return _search_ragged(index, queries, int(k), n_probes, filter,
-                              select_algo, res)
+        with scan_span:
+            return _search_ragged(index, queries, int(k), n_probes, filter,
+                                  select_algo, res)
 
     # query-tile size: the (qt, p, m, d) gather is the big intermediate
     per_query = max(1, n_probes * index.max_list_size * (index.dim + 2) * 4)
     q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
-    vals, ids = _search_impl(
-        queries,
-        index.centers,
-        index.list_data,
-        index.list_ids,
-        index.list_norms,
-        filter,
-        int(k),
-        n_probes,
-        index.metric,
-        q_tile,
-        select_algo,
-        res.compute_dtype,
-    )
+    with scan_span:
+        vals, ids = _search_impl(
+            queries,
+            index.centers,
+            index.list_data,
+            index.list_ids,
+            index.list_norms,
+            filter,
+            int(k),
+            n_probes,
+            index.metric,
+            q_tile,
+            select_algo,
+            res.compute_dtype,
+        )
     return vals, ids

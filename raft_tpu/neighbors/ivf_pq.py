@@ -477,31 +477,37 @@ def build(
     key = jax.random.key(params.seed)
     k_train, k_rot, k_cb = jax.random.split(key, 3)
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
-    if n_train < n:
-        # with-replacement: duplicates are noise for k-means, and it avoids
-        # the O(n log n) permutation program choice(replace=False) compiles
-        train_rows = jax.random.randint(k_train, (n_train,), 0, n)
-        trainset = work[train_rows]
-        centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
-        labels = kmeans_balanced.predict(work, centers, km, res=res)
-    else:
-        trainset = work
-        centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+    # phase spans (round-8): the @traced entry span parents these via the
+    # tracing contextvar, so a Perfetto export shows WHERE inside a build
+    # the time went (entry → phase → tile), not just that it ran
+    with obs.record_span("ivf_pq::coarse_train"):
+        if n_train < n:
+            # with-replacement: duplicates are noise for k-means, and it
+            # avoids the O(n log n) permutation program
+            # choice(replace=False) compiles
+            train_rows = jax.random.randint(k_train, (n_train,), 0, n)
+            trainset = work[train_rows]
+            centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
+            labels = kmeans_balanced.predict(work, centers, km, res=res)
+        else:
+            trainset = work
+            centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
 
     # --- rotation + codebooks (ivf_pq_build.cuh:119,:392) ------------------
-    rotation = make_rotation_matrix(k_rot, rot_dim)
-    train_labels = kmeans_balanced.predict(trainset, centers, km, res=res)
-    resid = _pad_rot(trainset - centers[train_labels], rot_dim) @ rotation.T
-    cb_rows = min(resid.shape[0], 65536)
-    resid_cb = resid[:cb_rows].reshape(cb_rows, pq_dim, dsub)
-    if params.codebook_kind == "cluster":
-        codebooks = _train_codebooks_cluster(
-            resid_cb, train_labels[:cb_rows], k_cb, n_codes,
-            params.codebook_n_iters, params.n_lists)
-    else:
-        codebooks = _train_codebooks(
-            resid_cb.transpose(1, 0, 2), k_cb, n_codes,
-            params.codebook_n_iters)
+    with obs.record_span("ivf_pq::codebook_train"):
+        rotation = make_rotation_matrix(k_rot, rot_dim)
+        train_labels = kmeans_balanced.predict(trainset, centers, km, res=res)
+        resid = _pad_rot(trainset - centers[train_labels], rot_dim) @ rotation.T
+        cb_rows = min(resid.shape[0], 65536)
+        resid_cb = resid[:cb_rows].reshape(cb_rows, pq_dim, dsub)
+        if params.codebook_kind == "cluster":
+            codebooks = _train_codebooks_cluster(
+                resid_cb, train_labels[:cb_rows], k_cb, n_codes,
+                params.codebook_n_iters, params.n_lists)
+        else:
+            codebooks = _train_codebooks(
+                resid_cb.transpose(1, 0, 2), k_cb, n_codes,
+                params.codebook_n_iters)
 
     if obs.enabled():
         obs.add("ivf_pq.build.rows", n)
@@ -520,25 +526,33 @@ def build(
     # OOM'd the 10M bench (round-4); chunking bounds the transient to the
     # workspace while `codes` (uint8) stays small
     enc_chunk = int(max(65536, res.workspace_bytes // max(rot_dim * 16, 1)))
-    codes_parts = []
-    for s in range(0, n, enc_chunk):
-        e = min(s + enc_chunk, n)
-        wch = lax.slice_in_dim(work, s, e, axis=0)
-        lch = lax.slice_in_dim(labels, s, e, axis=0)
-        resid = _pad_rot(wch - centers[lch], rot_dim) @ rotation.T
-        resid = resid.reshape(e - s, pq_dim, dsub)
-        raw = (_encode_cluster(resid, lch, codebooks)
-               if params.codebook_kind == "cluster"
-               else _encode(resid, codebooks))
-        codes_parts.append(pack_codes(raw, params.pq_bits))
-    codes = (jnp.concatenate(codes_parts) if len(codes_parts) > 1
-             else codes_parts[0])
-    row_ids = jnp.arange(n, dtype=jnp.int32)
-    list_codes, list_ids = _pack_lists(codes, row_ids, labels, params.n_lists, group)
-
-    b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes, list_ids,
-                           params.metric, pq_dim, params.pq_bits,
-                           cluster=params.codebook_kind == "cluster")
+    enc_attrs = ({"rows": int(n), "chunk": enc_chunk}
+                 if obs.enabled() else None)
+    with obs.record_span("ivf_pq::encode", attrs=enc_attrs):
+        codes_parts = []
+        for s in range(0, n, enc_chunk):
+            e = min(s + enc_chunk, n)
+            with obs.record_span("ivf_pq::encode_tile",
+                                 attrs=({"rows": int(e - s)}
+                                        if obs.enabled() else None)):
+                wch = lax.slice_in_dim(work, s, e, axis=0)
+                lch = lax.slice_in_dim(labels, s, e, axis=0)
+                resid = _pad_rot(wch - centers[lch], rot_dim) @ rotation.T
+                resid = resid.reshape(e - s, pq_dim, dsub)
+                raw = (_encode_cluster(resid, lch, codebooks)
+                       if params.codebook_kind == "cluster"
+                       else _encode(resid, codebooks))
+                codes_parts.append(pack_codes(raw, params.pq_bits))
+        codes = (jnp.concatenate(codes_parts) if len(codes_parts) > 1
+                 else codes_parts[0])
+    with obs.record_span("ivf_pq::pack"):
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+        list_codes, list_ids = _pack_lists(codes, row_ids, labels,
+                                           params.n_lists, group)
+        b_sum = _compute_b_sum(centers, rotation, codebooks, list_codes,
+                               list_ids, params.metric, pq_dim,
+                               params.pq_bits,
+                               cluster=params.codebook_kind == "cluster")
     return IvfPqIndex(
         centers, rotation, codebooks, list_codes, list_ids, b_sum, None,
         params.metric, params.pq_bits, group,
@@ -1471,6 +1485,7 @@ def search(
         # the LUT kernel's table is per query; PER_CLUSTER tables are per
         # list — served by the strip cache / gather paths instead
         backend = "ragged" if aligned and jax.default_backend() == "tpu" else "gather"
+    scan_attrs = None
     if obs.enabled():
         q_obs = int(queries.shape[0])
         obs.add("ivf_pq.search.queries", q_obs)
@@ -1480,9 +1495,15 @@ def search(
         obs.add("ivf_pq.search.rows_scanned",
                 q_obs * n_probes * index.max_list_size)
         obs.add(f"ivf_pq.search.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k)}
     from raft_tpu.resilience import faultpoint
 
     faultpoint("ivf_pq.search.scan")
+    # one scan-phase span regardless of backend (entered exactly once);
+    # attrs are built inside the enabled gate above so the off path stays
+    # one branch
+    scan_span = obs.record_span("ivf_pq::scan", attrs=scan_attrs)
     if backend == "ragged":
         if not aligned:
             raise ValueError(
@@ -1491,9 +1512,10 @@ def search(
                 "group_size=512 (or use backend='pallas'/'gather')"
             )
         # cosine included in _finalize_pq's fused dispatch
-        return _search_ragged_pq(
-            index, queries, int(k), n_probes, filter, select_algo, res
-        )
+        with scan_span:
+            return _search_ragged_pq(
+                index, queries, int(k), n_probes, filter, select_algo, res
+            )
     if backend == "pallas":
         if not pallas_ok:
             raise ValueError(
@@ -1526,45 +1548,47 @@ def search(
         # cap >= q_tile provably cannot drop: the loop terminates with zero
         # drops. The gather backend is NOT a fallback here — large-shape
         # take_along_axis crashes the TPU runtime.
-        while True:
-            vals, ids, dropped = _search_impl_pallas(
-                queries, index.centers, index.rotation, index.codebooks,
-                index.list_codes, index.list_ids, index.b_sum, filter,
-                int(k), n_probes, index.metric, int(q_tile), int(qpl_cap),
-                select_algo, res.compute_dtype, jax.default_backend() != "tpu",
-                index.pq_dim, index.pq_bits,
-            )
-            dropped = int(dropped)
-            if dropped == 0:
-                break
-            if qpl_cap >= q_tile:
-                raise RuntimeError(
-                    f"ivf_pq pallas scan dropped {dropped} pairs at "
-                    f"qpl_cap={qpl_cap} >= q_tile={q_tile}; this cannot "
-                    "happen — please report"
+        with scan_span:
+            while True:
+                vals, ids, dropped = _search_impl_pallas(
+                    queries, index.centers, index.rotation, index.codebooks,
+                    index.list_codes, index.list_ids, index.b_sum, filter,
+                    int(k), n_probes, index.metric, int(q_tile), int(qpl_cap),
+                    select_algo, res.compute_dtype, jax.default_backend() != "tpu",
+                    index.pq_dim, index.pq_bits,
                 )
-            qpl_cap = min(_align16(2 * qpl_cap), _align16(q_tile))
-            if index.n_lists * qpl_cap * per_slot > res.workspace_bytes:
+                dropped = int(dropped)
+                if dropped == 0:
+                    break
+                if qpl_cap >= q_tile:
+                    raise RuntimeError(
+                        f"ivf_pq pallas scan dropped {dropped} pairs at "
+                        f"qpl_cap={qpl_cap} >= q_tile={q_tile}; this cannot "
+                        "happen — please report"
+                    )
+                qpl_cap = min(_align16(2 * qpl_cap), _align16(q_tile))
+                if index.n_lists * qpl_cap * per_slot > res.workspace_bytes:
+                    _log.warning(
+                        "ivf_pq pallas scan exceeding workspace budget to avoid "
+                        "dropping pairs (qpl_cap=%d); consider a larger "
+                        "Resources.workspace_bytes", qpl_cap,
+                    )
                 _log.warning(
-                    "ivf_pq pallas scan exceeding workspace budget to avoid "
-                    "dropping pairs (qpl_cap=%d); consider a larger "
-                    "Resources.workspace_bytes", qpl_cap,
+                    "ivf_pq pallas scan dropped %d probed pairs (skewed probes); "
+                    "retrying with qpl_cap=%d (one retrace)", dropped, qpl_cap,
                 )
-            _log.warning(
-                "ivf_pq pallas scan dropped %d probed pairs (skewed probes); "
-                "retrying with qpl_cap=%d (one retrace)", dropped, qpl_cap,
-            )
     if backend == "gather":
         # tile budget: the (qt, p, m, s) code gather dominates
         per_query = max(1, n_probes * index.max_list_size * (index.pq_dim * 5 + 8))
         q_tile = int(max(1, min(queries.shape[0], res.workspace_bytes // per_query)))
-        vals, ids = _search_impl_jnp(
-            queries, index.centers, index.rotation, index.codebooks,
-            index.list_codes, index.list_ids, index.b_sum, filter,
-            int(k), n_probes, index.metric, q_tile, select_algo,
-            res.compute_dtype, index.pq_dim, index.pq_bits,
-            index.codebook_kind == "cluster",
-        )
+        with scan_span:
+            vals, ids = _search_impl_jnp(
+                queries, index.centers, index.rotation, index.codebooks,
+                index.list_codes, index.list_ids, index.b_sum, filter,
+                int(k), n_probes, index.metric, q_tile, select_algo,
+                res.compute_dtype, index.pq_dim, index.pq_bits,
+                index.codebook_kind == "cluster",
+            )
     if index.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
     return vals, ids

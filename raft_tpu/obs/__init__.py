@@ -1,24 +1,31 @@
-"""Runtime telemetry: metrics registry, trace spans, device-health probe.
+"""Runtime telemetry: metrics registry, span trees, fleet merge, health probe.
 
 The observability layer the reference ships as NVTX ranges + an spdlog logger
 (core/nvtx.hpp, core/logger.hpp), grown into something measurable: a
 process-wide registry (obs/registry.py) that hot paths feed counters and
-wall-clock spans into behind a single-branch ``obs.enabled()`` gate, and a
-subprocess-isolated device-health probe (obs/health.py) that answers "is this
-backend alive?" in bounded time — the check bench.py runs before committing
-its TPU window (the round-5 wedge ate the whole window with no record;
-ISSUE 1 / VERDICT.md round 5).
+wall-clock spans into behind a single-branch ``obs.enabled()`` gate; a
+hierarchical tracing layer (obs/tracing.py) that parents nested spans into
+trace trees exportable as Perfetto-loadable Chrome trace JSON; an exact
+fleet-wide merge of per-process snapshots (obs/aggregate.py, also
+``python -m raft_tpu.obs.aggregate``); and a subprocess-isolated
+device-health probe (obs/health.py) that answers "is this backend alive?" in
+bounded time — the check bench.py runs before committing its TPU window (the
+round-5 wedge ate the whole window with no record; ISSUE 1 / VERDICT.md
+round 5).
 
 Usage::
 
     from raft_tpu import obs
 
     obs.enable()                      # or RAFT_TPU_OBS=1 in the env
-    with obs.record_span("my::phase"):
-        ...                           # timed + profiler-annotated
+    with obs.record_span("my::phase", attrs={"rows": n}):
+        with obs.record_span("my::tile"):   # parented under my::phase
+            ...
     obs.add("my.rows", n)             # counter
+    obs.observe("my.batch_s", dt)     # pow2 histogram (p50/p90/p99 bounds)
     obs.snapshot()                    # {"counters": .., "timers": .., ..}
-    obs.export_jsonl("results/obs.jsonl", {"run": "r06"})
+    obs.export_jsonl("results/obs.jsonl", {"run": "r06"})  # process-stamped
+    obs.export_chrome_trace("results/trace_dev.json")      # open in Perfetto
 
 Instrumented code gates every emission::
 
@@ -26,8 +33,16 @@ Instrumented code gates every emission::
         obs.add("ivf_pq.search.queries", q)
 
 so the telemetry-off cost of a hot path is one function call and one branch.
+``RAFT_TPU_OBS_SYNC=1`` (or :func:`enable_sync`) opts spans into device-time
+attribution: the dispatch queue is drained at span exit so jitted phases
+report committed time, with the raw dispatch wall-clock kept as the
+``dispatch_s`` span attribute.
 """
 
+# NOTE: obs.aggregate is deliberately NOT imported here — preloading it
+# would shadow `python -m raft_tpu.obs.aggregate` (runpy double-import);
+# reach it as `from raft_tpu.obs import aggregate` when needed.
+from raft_tpu.obs import tracing
 from raft_tpu.obs.registry import (
     NOOP_SPAN,
     MetricsRegistry,
@@ -43,6 +58,16 @@ from raft_tpu.obs.registry import (
     reset,
     snapshot,
 )
+from raft_tpu.obs.tracing import (
+    chrome_trace,
+    clear_spans,
+    disable_sync,
+    enable_sync,
+    export_chrome_trace,
+    process_info,
+    spans,
+    sync_enabled,
+)
 from raft_tpu.obs.health import MAX_TIMEOUT, HealthReport, probe
 
 __all__ = [
@@ -51,15 +76,24 @@ __all__ = [
     "MetricsRegistry",
     "NOOP_SPAN",
     "add",
+    "chrome_trace",
+    "clear_spans",
     "disable",
+    "disable_sync",
     "enable",
+    "enable_sync",
     "enabled",
+    "export_chrome_trace",
     "export_jsonl",
     "observe",
     "probe",
+    "process_info",
     "record_span",
     "record_timing",
     "registry",
     "reset",
     "snapshot",
+    "spans",
+    "sync_enabled",
+    "tracing",
 ]

@@ -1,0 +1,226 @@
+"""Fleet-wide metric aggregation: merge per-process snapshots exactly.
+
+EQuARX-style per-shard collective accounting (PAPERS.md) needs one question
+answered that flat per-process files cannot: *what did the whole fleet do?*
+Every ``export_jsonl`` / heartbeat record is stamped with
+``process_index``/``process_count`` (obs/tracing.process_info); this module
+folds any number of those per-process snapshots into ONE fleet view — and the
+merge is **exact**, not approximate:
+
+* counters — integer/float sums, key-wise;
+* timers — ``count``/``total_s`` sum, ``min_s``/``max_s`` min/max, mean
+  recomputed from the merged totals;
+* histograms — ``count``/``sum`` sum, ``min``/``max`` min/max, and the
+  power-of-two buckets merged KEY-WISE (a bucket bound is a pure function of
+  the observed value, so identical bounds on different processes are the
+  same bucket — merging loses nothing the per-process histograms had).
+
+Merging is associative and commutative (sums/mins/maxes of disjoint streams),
+which ``tests/test_aggregate.py`` property-tests; percentile upper bounds
+(:func:`percentile_bounds`, the ≤2× bucket-bound estimates) are derived from
+the merged buckets, never merged themselves.
+
+CLI (the parent-side entry bench.py uses after a multichip window)::
+
+    python -m raft_tpu.obs.aggregate results/metrics/*.jsonl [--output f.json]
+
+Deliberately stdlib-only at module level: bench.py's jax-free orchestrator
+loads this file by path (``_load_by_path``) the same way it loads
+``bench/progress.py``, so fleet aggregation works even when the raft_tpu/jax
+package import is the thing that wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Iterable, List, Optional
+
+__all__ = [
+    "main",
+    "merge_files",
+    "merge_records",
+    "merge_snapshots",
+    "percentile_bounds",
+    "read_jsonl",
+]
+
+#: the quantiles snapshot()/export carry, as (key, q) pairs
+QUANTILES = (("p50_ub", 0.50), ("p90_ub", 0.90), ("p99_ub", 0.99))
+
+
+def percentile_bounds(buckets: dict, count: int) -> dict:
+    """p50/p90/p99 UPPER-BOUND estimates from power-of-two buckets.
+
+    A bucket key ``le_B`` counts observations with value ≤ B where B is the
+    smallest power of two ≥ the value — so the true q-quantile lies in
+    ``(B/2, B]`` of the first bucket whose cumulative count reaches
+    ``ceil(q·count)``, and the returned bound over-estimates it by AT MOST
+    2× (exactly the bucket resolution). Returns ``{}`` for an empty
+    histogram."""
+    if not count or not buckets:
+        return {}
+    bounds = []
+    for key, n in buckets.items():
+        try:
+            bounds.append((float(str(key)[3:]), int(n)))
+        except (ValueError, IndexError):
+            continue
+    if not bounds:
+        return {}
+    bounds.sort()
+    out = {}
+    for key, q in QUANTILES:
+        need = max(1, math.ceil(q * count))
+        cum = 0
+        for bound, n in bounds:
+            cum += n
+            if cum >= need:
+                out[key] = bound
+                break
+        else:
+            out[key] = bounds[-1][0]
+    return out
+
+
+def _merge_timer(a: dict, b: dict) -> dict:
+    count = a.get("count", 0) + b.get("count", 0)
+    total = a.get("total_s", 0.0) + b.get("total_s", 0.0)
+    return {
+        "count": count,
+        "total_s": total,
+        "min_s": min(a.get("min_s", math.inf), b.get("min_s", math.inf)),
+        "max_s": max(a.get("max_s", 0.0), b.get("max_s", 0.0)),
+        "mean_s": total / count if count else 0.0,
+    }
+
+
+def _merge_hist(a: dict, b: dict) -> dict:
+    buckets = dict(a.get("buckets") or {})
+    for key, n in (b.get("buckets") or {}).items():
+        buckets[key] = buckets.get(key, 0) + n
+    count = a.get("count", 0) + b.get("count", 0)
+    out = {
+        "count": count,
+        "sum": a.get("sum", 0.0) + b.get("sum", 0.0),
+        "min": min(a.get("min", math.inf), b.get("min", math.inf)),
+        "max": max(a.get("max", -math.inf), b.get("max", -math.inf)),
+        "buckets": buckets,
+    }
+    out.update(percentile_bounds(buckets, count))
+    return out
+
+
+def merge_snapshots(snaps: Iterable[dict]) -> dict:
+    """Fold snapshot dicts ({"counters": .., "timers": .., "histograms": ..})
+    into one fleet snapshot, exactly (module docstring). Left fold in input
+    order; the operation is associative/commutative up to float summation
+    order, and bit-exact for counters and histogram buckets."""
+    counters: dict = {}
+    timers: dict = {}
+    hists: dict = {}
+    for snap in snaps:
+        for key, val in (snap.get("counters") or {}).items():
+            counters[key] = counters.get(key, 0) + val
+        for key, val in (snap.get("timers") or {}).items():
+            timers[key] = _merge_timer(timers[key], val) if key in timers \
+                else dict(val)
+        for key, val in (snap.get("histograms") or {}).items():
+            hists[key] = _merge_hist(hists[key], val) if key in hists \
+                else _merge_hist({}, val)
+    return {"counters": counters, "timers": timers, "histograms": hists}
+
+
+def merge_records(records: List[dict]) -> dict:
+    """Fleet view from export_jsonl-shaped records: keep the NEWEST snapshot
+    per (source, process_index) — each line is a cumulative snapshot of its
+    process, so merging two generations of the same process would double
+    count — then merge the survivors. Returns the merged snapshot plus
+    provenance (``processes``, ``process_count``, t range)."""
+    latest: dict = {}
+    for rec in records:
+        src = rec.get("_source", "")
+        key = (src, rec.get("process_index", 0))
+        prev = latest.get(key)
+        if prev is None or rec.get("t", 0) >= prev.get("t", 0):
+            latest[key] = rec
+    picked = [latest[k] for k in sorted(latest, key=str)]
+    merged = merge_snapshots(picked)
+    procs = sorted({r.get("process_index", 0) for r in picked})
+    merged["processes"] = procs
+    merged["process_count"] = max(
+        [r.get("process_count", 1) for r in picked] + [len(procs)])
+    ts = [r["t"] for r in picked if isinstance(r.get("t"), (int, float))]
+    if ts:
+        merged["t_min"] = min(ts)
+        merged["t_max"] = max(ts)
+    return merged
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse one metrics JSONL file, skipping torn/corrupt lines (the same
+    tolerance bench/progress.read_progress gives heartbeat files). Each
+    record is tagged with its source path for per-process dedup."""
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    rec["_source"] = path
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+def merge_files(paths: Iterable[str]) -> dict:
+    """Read + merge any number of per-process metrics JSONL files."""
+    records: List[dict] = []
+    sources = []
+    for path in paths:
+        recs = read_jsonl(path)
+        if recs:
+            sources.append(path)
+        records.extend(recs)
+    out = merge_records(records)
+    out["sources"] = sources
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.aggregate",
+        description="Merge per-process obs metrics JSONL files into one "
+                    "fleet-wide snapshot (exact for counters and "
+                    "power-of-two histograms).")
+    ap.add_argument("files", nargs="+", help="metrics JSONL files")
+    ap.add_argument("--output", default=None, metavar="PATH",
+                    help="write the fleet view here instead of stdout")
+    ap.add_argument("--indent", type=int, default=2)
+    args = ap.parse_args(argv)
+    fleet = merge_files(args.files)
+    if not fleet.get("sources"):
+        print("aggregate: no parseable records in "
+              f"{', '.join(args.files)}", file=sys.stderr)
+        return 2
+    text = json.dumps(fleet, indent=args.indent, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+            f.flush()
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Optional
 
+from raft_tpu.obs import tracing as _tracing
+
 __all__ = [
     "MetricsRegistry",
     "NOOP_SPAN",
@@ -117,19 +119,31 @@ class _HistStat:
             self.min = value
         if value > self.max:
             self.max = value
-        # bucket upper bound = smallest power of two >= value (0 for v <= 0)
+        # bucket upper bound = smallest power of two >= value (0 for v <= 0).
+        # repr, not %g: 6-sig-digit rounding would print 2**21 as
+        # 'le_2.09715e+06', and the percentile parser reading that back
+        # would report an "upper bound" BELOW the observed max
         bound = 0.0 if value <= 0 else 2.0 ** math.ceil(math.log2(value))
-        key = f"le_{bound:g}"
+        key = f"le_{bound!r}"
         self.buckets[key] = self.buckets.get(key, 0) + 1
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "count": self.count,
             "sum": self.sum,
             "min": self.min,
             "max": self.max,
             "buckets": dict(self.buckets),
         }
+        # p50/p90/p99 UPPER bounds derived from the power-of-two buckets:
+        # over-estimates the true quantile by ≤2× (the bucket resolution);
+        # shared with the fleet merge so per-process and merged views agree.
+        # Lazy import: preloading obs.aggregate at package-import time would
+        # shadow the `python -m raft_tpu.obs.aggregate` runpy execution.
+        from raft_tpu.obs.aggregate import percentile_bounds
+
+        out.update(percentile_bounds(self.buckets, self.count))
+        return out
 
 
 class MetricsRegistry:
@@ -182,8 +196,12 @@ class MetricsRegistry:
     def export_jsonl(self, path, extra: Optional[dict] = None) -> dict:
         """Append one timestamped snapshot line to ``path``; returns the
         record written. ``extra`` keys ride at the top level (run ids, phase
-        tags)."""
-        rec = {"t": round(time.time(), 3), **(extra or {}), **self.snapshot()}
+        tags). Every record is stamped with ``process_index`` /
+        ``process_count`` (obs/tracing.process_info) so per-process files
+        merge into a fleet view via ``python -m raft_tpu.obs.aggregate``."""
+        pi, pc = _tracing.process_info()
+        rec = {"t": round(time.time(), 3), "process_index": pi,
+               "process_count": pc, **(extra or {}), **self.snapshot()}
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -216,28 +234,77 @@ def _trace_annotation():
     return _ann_cls
 
 
+def _classify_error(exc) -> str:
+    """Failure kind for a span that raised, via resilience.classify (lazy:
+    resilience imports obs, so the import must not run at module load).
+    Falls back to the bare class name if the resilience layer is absent."""
+    try:
+        from raft_tpu.resilience.errors import classify
+
+        return classify(exc)
+    except Exception:
+        return type(exc).__name__.lower()
+
+
 class _Span:
-    """Context manager: profiler trace annotation + registry wall-clock."""
+    """Context manager: profiler trace annotation + registry wall-clock +
+    one node of the span tree (obs/tracing.py).
 
-    __slots__ = ("_name", "_reg", "_t0", "_ann")
+    Exception-safe by contract: a body that raises still records its
+    duration, and the span (plus a ``span.errors.{kind}`` counter) is tagged
+    with the ``resilience.classify()`` kind of the failure. Under sync mode
+    (``RAFT_TPU_OBS_SYNC=1``) the dispatch queue is force-drained at exit so
+    ``dur_s`` is committed device-inclusive time, with the raw dispatch
+    wall-clock preserved as the ``dispatch_s`` attribute."""
 
-    def __init__(self, name: str, reg: MetricsRegistry):
+    __slots__ = ("_name", "_reg", "_t0", "_t0_epoch", "_ann", "_attrs",
+                 "_ids", "_token")
+
+    def __init__(self, name: str, reg: MetricsRegistry,
+                 attrs: Optional[dict] = None):
         self._name = name
         self._reg = reg
+        self._attrs = attrs
+
+    def set_attr(self, key: str, value):
+        """Attach one typed attribute (rows/probes/tiles/shard …) to the
+        span record; chainable. Values discovered mid-body land here."""
+        if self._attrs is None:
+            self._attrs = {}
+        self._attrs[key] = value
+        return self
 
     def __enter__(self):
         ann_cls = _trace_annotation()
         self._ann = ann_cls(self._name) if ann_cls is not None else None
         if self._ann is not None:
             self._ann.__enter__()
+        self._ids, self._token = _tracing.enter_span()
+        self._t0_epoch = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dt = time.perf_counter() - self._t0
+        dispatch_s = None
+        if exc_type is None and _tracing.sync_enabled() and \
+                _tracing.drain_device():
+            # device-time attribution: the body's wall-clock measured only
+            # dispatch; the queue drained, so re-read — dur_s is committed.
+            # A failed/no-op drain (no live backend) records NO dispatch_s:
+            # the span must not claim attribution it didn't get
+            dispatch_s = dt
+            dt = time.perf_counter() - self._t0
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
+        error = None
+        if exc is not None:
+            error = _classify_error(exc)
+            self._reg.add(f"span.errors.{error}")
         self._reg.record_timing(self._name, dt)
+        _tracing.exit_span(self._ids, self._token, name=self._name,
+                           t0=self._t0_epoch, dur_s=dt, attrs=self._attrs,
+                           error=error, dispatch_s=dispatch_s)
         return False
 
 
@@ -252,6 +319,9 @@ class _NoopSpan:
     def __exit__(self, exc_type, exc, tb):
         return False
 
+    def set_attr(self, key, value):
+        return self
+
 
 NOOP_SPAN = _NoopSpan()
 
@@ -262,14 +332,19 @@ def registry() -> MetricsRegistry:
     return _default
 
 
-def record_span(name: str, reg: Optional[MetricsRegistry] = None):
+def record_span(name: str, reg: Optional[MetricsRegistry] = None,
+                attrs: Optional[dict] = None):
     """``with obs.record_span("ivf_pq::search"): ...`` — times the block into
-    the registry AND marks it on the profiler timeline. When telemetry is
-    disabled this returns the shared :data:`NOOP_SPAN` (no allocation, no
-    registry touch)."""
+    the registry, marks it on the profiler timeline, AND records one node of
+    the span tree (parented on the enclosing span via contextvar —
+    obs/tracing.py). ``attrs`` attaches typed attributes (rows/probes/tiles/
+    shard); hot paths should build the dict inside their existing
+    ``if obs.enabled():`` block so the off path allocates nothing. When
+    telemetry is disabled this returns the shared :data:`NOOP_SPAN` (no
+    allocation, no registry touch)."""
     if not _enabled:
         return NOOP_SPAN
-    return _Span(name, reg if reg is not None else _default)
+    return _Span(name, reg if reg is not None else _default, attrs)
 
 
 def add(name: str, value: float = 1) -> None:

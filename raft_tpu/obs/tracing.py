@@ -1,0 +1,352 @@
+"""Hierarchical span trees: contextvar parenting, bounded ring, Perfetto export.
+
+PR 1's ``record_span`` produced *flat* timers — enough to say "ivf_pq::search
+ran 40 times for 12 s" but not where inside a search the time went. This
+module upgrades every enabled span into a node of a trace tree:
+
+* **Parenting** is a :mod:`contextvars` variable, so nesting follows the call
+  stack for free (threads and ``contextvars.copy_context`` tasks each get
+  their own lineage; a span opened on a fresh thread starts a new trace).
+* **Identity** is ``(trace_id, span_id, parent_id)`` — ids come from a
+  process-local counter (deterministic, no clock/RNG reads), prefixed with
+  the pid so traces from different processes never collide when merged.
+* **Storage** is a bounded ring (``RAFT_TPU_OBS_TRACE_CAP``, default 4096
+  spans) guarded by one lock; completed spans append one small dict each.
+  The ring, not an unbounded list, is what makes leaving telemetry on for a
+  whole bench window safe.
+* **Export** is Chrome trace-event JSON (:func:`chrome_trace` /
+  :func:`export_chrome_trace`) — one ``"X"`` (complete) event per span with
+  its attributes under ``args``, plus ``"i"`` (instant) events for the
+  resilience recovery ring — loadable directly in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing``.
+
+**Sync mode** (``RAFT_TPU_OBS_SYNC=1`` / :func:`enable_sync`): JAX dispatch
+is asynchronous, so a span around a jitted region measures dispatch +
+trace/compile time, not device execution — systematically under-reporting
+jitted search phases. Sync mode force-drains the dispatch queue at span exit
+(the resilience force-completion pattern: a scalar host fetch, because
+``block_until_ready`` does not synchronize on the tunneled axon runtime) and
+records BOTH numbers: ``dur_s`` becomes committed time, and the pre-drain
+wall-clock rides the span as the ``dispatch_s`` attribute. It costs one host
+round-trip per span, so it is OFF by default and meant for attribution runs,
+not amortized QPS measurement.
+
+Everything here is stdlib-only at import time (jax and resilience are
+reached lazily), so the module stays importable in jax-free parents.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import sys
+import threading
+from collections import deque
+from typing import Optional
+
+__all__ = [
+    "chrome_trace",
+    "clear_spans",
+    "current_span",
+    "disable_sync",
+    "drain_device",
+    "enable_sync",
+    "enter_span",
+    "exit_span",
+    "export_chrome_trace",
+    "process_info",
+    "push_span",
+    "set_ring_cap",
+    "spans",
+    "sync_enabled",
+]
+
+# ---------------------------------------------------------------------------
+# process identity (fleet aggregation stamps)
+# ---------------------------------------------------------------------------
+
+
+def _jax_process_info():
+    """(process_index, process_count) from jax, ONLY when a backend already
+    exists. jax.process_index() initializes the backend on first touch —
+    exactly the operation that wedged round 5 — so this never triggers init:
+    it requires jax AND an initialized xla_bridge backend to already be in
+    sys.modules, else answers None. Multi-host launchers that want stamps
+    without a live backend set RAFT_TPU_PROCESS_INDEX/COUNT instead."""
+    try:
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is None or not getattr(xb, "_backends", None):
+            return None
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return None
+
+
+def process_info() -> tuple:
+    """(process_index, process_count) for stamping telemetry records.
+
+    Resolution order: ``RAFT_TPU_PROCESS_INDEX``/``RAFT_TPU_PROCESS_COUNT``
+    env override (tests, launchers), then an already-initialized jax backend,
+    then ``(0, 1)``. Never initializes a backend (see :func:`_jax_process_info`).
+    """
+    pi = os.environ.get("RAFT_TPU_PROCESS_INDEX", "").strip()
+    pc = os.environ.get("RAFT_TPU_PROCESS_COUNT", "").strip()
+    if pi.lstrip("-").isdigit():
+        return int(pi), int(pc) if pc.lstrip("-").isdigit() else 1
+    live = _jax_process_info()
+    if live is not None:
+        return live
+    return 0, 1
+
+
+# ---------------------------------------------------------------------------
+# sync mode (device-time attribution)
+# ---------------------------------------------------------------------------
+
+_sync = os.environ.get("RAFT_TPU_OBS_SYNC", "").strip().lower() in (
+    "1", "true", "on", "yes",
+)
+
+
+def sync_enabled() -> bool:
+    return _sync
+
+
+def enable_sync() -> None:
+    global _sync
+    _sync = True
+
+
+def disable_sync() -> None:
+    global _sync
+    _sync = False
+
+
+def drain_device() -> bool:
+    """Force completion of everything dispatched so far on EVERY local
+    device: enqueue a trivial computation per device and host-fetch its
+    scalar result. Each device's stream executes in order, so the fetch
+    returning implies every earlier dispatch on that device committed (the
+    bench.py/_force and resilience.force_completion contract —
+    block_until_ready does not sync on the tunneled runtime); draining only
+    the default device would let a multi-chip span's shards run on while
+    dur_s claims they committed. Returns False (and stays silent) when jax
+    has no live backend — like :func:`_jax_process_info`, this must never
+    TRIGGER backend init (a span around pure host work would otherwise pay
+    first-touch init inside telemetry teardown, the round-5 wedge class)."""
+    try:
+        jax = sys.modules.get("jax")
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if jax is None or xb is None or not getattr(xb, "_backends", None):
+            return False
+        import jax.numpy as jnp
+
+        for dev in jax.local_devices():
+            x = jax.device_put(jnp.float32(0), dev) + jnp.float32(0)
+            float(x)
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# span ring + contextvar lineage
+# ---------------------------------------------------------------------------
+
+def _ring_cap() -> int:
+    raw = os.environ.get("RAFT_TPU_OBS_TRACE_CAP", "").strip()
+    if raw.isdigit() and int(raw) > 0:
+        return int(raw)
+    return 4096
+
+
+_SPANS: deque = deque(maxlen=_ring_cap())
+_LOCK = threading.Lock()
+
+
+def set_ring_cap(cap: int) -> None:
+    """Resize the span ring at runtime (newest spans kept). The
+    ``RAFT_TPU_OBS_TRACE_CAP`` env var is read once at import — a process
+    that decides on a long attribution run AFTER importing raft_tpu uses
+    this instead (the runtime twin, like enable_sync for the env gate)."""
+    global _SPANS
+    with _LOCK:
+        _SPANS = deque(_SPANS, maxlen=max(1, int(cap)))
+_ids = itertools.count(1)
+_ID_PREFIX = f"{os.getpid():x}"
+
+#: (trace_id, span_id) of the innermost open span in this context
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "raft_tpu_obs_span", default=None)
+
+
+def current_span() -> Optional[tuple]:
+    """(trace_id, span_id) of the innermost open span, or None."""
+    return _current.get()
+
+
+def _next_id() -> str:
+    return f"{_ID_PREFIX}-{next(_ids)}"
+
+
+def enter_span():
+    """Open a span in the current context: allocate ids, inherit the trace
+    from the enclosing span (or start a new trace at the root), and make
+    this span the parent of anything opened inside it.
+
+    Returns ``((trace_id, span_id, parent_id), token)``; the token MUST be
+    passed back to :func:`exit_span`."""
+    parent = _current.get()
+    sid = _next_id()
+    if parent is None:
+        ids = (_next_id(), sid, None)
+    else:
+        ids = (parent[0], sid, parent[1])
+    token = _current.set((ids[0], ids[1]))
+    return ids, token
+
+
+def exit_span(ids, token, *, name: str, t0: float, dur_s: float,
+              attrs: Optional[dict] = None, error: Optional[str] = None,
+              dispatch_s: Optional[float] = None) -> dict:
+    """Close a span opened by :func:`enter_span`: restore the parent context
+    and append the completed record to the ring. Returns the record."""
+    _current.reset(token)
+    trace_id, span_id, parent_id = ids
+    rec = {
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id,
+        "parent_id": parent_id,
+        "t0": t0,
+        "dur_s": dur_s,
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        rec["attrs"] = dict(attrs)
+    if error is not None:
+        rec["error"] = error
+    if dispatch_s is not None:
+        rec["dispatch_s"] = dispatch_s
+    push_span(rec)
+    return rec
+
+
+def push_span(rec: dict) -> None:
+    with _LOCK:
+        _SPANS.append(rec)
+
+
+def spans() -> list:
+    """Snapshot of the completed-span ring, oldest first."""
+    with _LOCK:
+        return list(_SPANS)
+
+
+def clear_spans() -> None:
+    with _LOCK:
+        _SPANS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(span_records: Optional[list] = None,
+                 events: Optional[list] = None,
+                 extra: Optional[dict] = None) -> dict:
+    """Assemble a Chrome trace-event JSON dict from span records (default:
+    the ring) and instant events (default: the resilience recovery ring).
+
+    Spans become ``"X"`` complete events (ts/dur in microseconds, pid =
+    ``process_index`` so multi-host traces interleave cleanly in one
+    Perfetto view); recovery events become ``"i"`` instants. Span attributes
+    and ids ride under ``args`` and round-trip through the file."""
+    if span_records is None:
+        span_records = spans()
+    if events is None:
+        events = _resilience_events()
+    pi, pc = process_info()
+    out = []
+    for rec in span_records:
+        args = {
+            "trace_id": rec.get("trace_id"),
+            "span_id": rec.get("span_id"),
+            "parent_id": rec.get("parent_id"),
+        }
+        args.update(rec.get("attrs") or {})
+        if "error" in rec:
+            args["error"] = rec["error"]
+        if "dispatch_s" in rec:
+            args["dispatch_s"] = rec["dispatch_s"]
+        out.append({
+            "name": rec.get("name", "?"),
+            "cat": "span",
+            "ph": "X",
+            "ts": round(float(rec.get("t0", 0.0)) * 1e6, 1),
+            "dur": round(float(rec.get("dur_s", 0.0)) * 1e6, 1),
+            "pid": pi,
+            "tid": rec.get("tid", 0),
+            "args": args,
+        })
+    for ev in events:
+        ev = dict(ev)
+        out.append({
+            "name": ev.pop("event", "event"),
+            "cat": "resilience",
+            "ph": "i",
+            "s": "p",
+            "ts": round(float(ev.pop("t", 0.0)) * 1e6, 1),
+            "pid": pi,
+            "tid": 0,
+            "args": ev,
+        })
+    meta = {"process_index": pi, "process_count": pc}
+    if extra:
+        meta.update(extra)
+    return {"traceEvents": out, "displayTimeUnit": "ms", "otherData": meta}
+
+
+def _resilience_events() -> list:
+    """The resilience recovery ring, reached lazily (resilience imports obs,
+    so a module-level import here would be a cycle); empty when the package
+    is only partially imported."""
+    try:
+        from raft_tpu.resilience.retry import recent_events
+
+        return recent_events()
+    except Exception:
+        return []
+
+
+def export_chrome_trace(path, extra: Optional[dict] = None) -> dict:
+    """Serialize :func:`chrome_trace` to ``path`` crash-safely (tmp file +
+    flush + fsync + atomic rename — the bench/progress.py durability
+    contract: a kill mid-write leaves the old file or the complete new one)
+    and return the dict. Bench code must route through
+    ``bench/progress.write_artifact`` instead (graftlint ``span-name``
+    enforces it); this is the library entry."""
+    doc = chrome_trace(extra=extra)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return doc

@@ -90,8 +90,10 @@ _EV_LOCK = threading.Lock()
 
 
 def record_event(event: str, site: str = "", **detail) -> None:
-    """Append one structured recovery event (thread-safe, bounded ring)."""
-    rec = {"event": event, "site": site, **detail}
+    """Append one structured recovery event (thread-safe, bounded ring).
+    Events are timestamped so trace exports (obs/tracing.chrome_trace) can
+    place them as instant markers alongside the span timeline."""
+    rec = {"event": event, "site": site, "t": round(time.time(), 6), **detail}
     with _EV_LOCK:
         _EVENTS.append(rec)
 

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Bench regression tracking: diff two runs, emit a markdown delta table.
+
+Closes the telemetry loop the round-8 ISSUE names: BENCH_r01–r05 /
+MULTICHIP_r01–r05 give the repo a trajectory, but until now every round's
+headline was a one-off — nothing diffed round N against N−1, so a 10% QPS
+regression would ship unremarked. This tool compares any two of:
+
+* driver round files (``BENCH_r04.json``: ``{"rc": .., "parsed": {...}}``) —
+  a ``parsed: null`` round (the r05 wedge) degrades to an honest
+  "no data" column, never an error;
+* raw metric lines (bench.py's single-JSON-line output);
+* obs metrics JSONL files (``results/metrics/*.jsonl``) — merged per process
+  via obs/aggregate, then compared on timer means, counters and histogram
+  percentile bounds.
+
+Direction is inferred per metric (qps/recall/value up is good; ``*_s`` /
+``*_ub`` latency down is good; config counters are informational), and the
+regression threshold is configurable globally (``--threshold 0.05``) and
+per metric (``--metric-threshold ivf_pq.qps=0.02``, repeatable).
+
+Usage::
+
+    python scripts/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_compare.py A.json B.json --output delta.md
+    python scripts/bench_compare.py old.jsonl new.jsonl --fail-on-regression
+
+Exit 0 always (report tools must not eat a bench round), unless
+``--fail-on-regression`` is set and a regression verdict exists (exit 1), or
+the inputs are unreadable (exit 2).
+
+Stdlib-only + file-path loading of obs/aggregate.py: runnable right after a
+wedged round without touching the raft_tpu/jax package import lock.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_aggregate():
+    spec = importlib.util.spec_from_file_location(
+        "_obs_aggregate",
+        os.path.join(_REPO, "raft_tpu", "obs", "aggregate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_obs_aggregate"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# loading + flattening
+# ---------------------------------------------------------------------------
+
+#: extras keys that are run CONFIG, not measurements — reported only when
+#: they differ (a shape change silently explains every other delta)
+_CONFIG_KEYS = {"n", "dim", "q", "k", "n_lists", "nprobe", "k_fetch",
+                "itopk", "width", "scale", "tile", "chunk"}
+
+
+def load_run(path):
+    """(label, metric_line_or_None, note). Accepts a driver round file, a
+    raw metric line, or a metrics JSONL file."""
+    label = os.path.basename(path)
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return label, None, f"unreadable: {e}"
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # not one JSON document: try metrics JSONL via the fleet merge
+        agg = _load_aggregate()
+        records = agg.read_jsonl(path)
+        if not records:
+            return label, None, "no parseable JSON"
+        return label, {"_jsonl": agg.merge_records(records)}, ""
+    if isinstance(doc, dict) and "parsed" in doc:
+        parsed = doc.get("parsed")
+        rc = doc.get("rc")
+        if not isinstance(parsed, dict):
+            return label, None, (f"no data (rc={rc}, parsed=null — the "
+                                 f"round died before emitting a line)")
+        note = "" if rc in (0, None) else f"rc={rc}"
+        return label, parsed, note
+    if isinstance(doc, dict) and ("counters" in doc or "timers" in doc or
+                                  "histograms" in doc):
+        # a one-line metrics JSONL file parses as a single document too
+        agg = _load_aggregate()
+        doc["_source"] = path
+        return label, {"_jsonl": agg.merge_records([doc])}, ""
+    if isinstance(doc, dict):
+        return label, doc, ""
+    return label, None, "unrecognized JSON shape"
+
+
+def _flatten(prefix, obj, out):
+    for key, val in obj.items():
+        name = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            _flatten(name, val, out)
+        elif isinstance(val, bool):
+            out[name] = 1.0 if val else 0.0
+        elif isinstance(val, (int, float)):
+            out[name] = float(val)
+    return out
+
+
+def metrics_of(line):
+    """Flat {metric: float} view of one loaded run."""
+    if line is None:
+        return {}
+    if "_jsonl" in line:
+        merged = line["_jsonl"]
+        out = {}
+        for key, val in (merged.get("counters") or {}).items():
+            out[f"counters.{key}"] = float(val)
+        for key, t in (merged.get("timers") or {}).items():
+            out[f"timers.{key}.mean_s"] = t.get("mean_s", 0.0)
+            out[f"timers.{key}.count"] = float(t.get("count", 0))
+        for key, h in (merged.get("histograms") or {}).items():
+            for q in ("p50_ub", "p90_ub", "p99_ub"):
+                if q in h:
+                    out[f"histograms.{key}.{q}"] = float(h[q])
+        return out
+    out = {}
+    for key in ("value", "vs_baseline"):
+        if isinstance(line.get(key), (int, float)):
+            out[key] = float(line[key])
+    extras = line.get("extras")
+    if isinstance(extras, dict):
+        _flatten("", extras, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+def direction(metric: str) -> str:
+    """'up' (bigger better), 'down' (smaller better) or 'info'."""
+    tail = metric.rsplit(".", 1)[-1]
+    if tail in _CONFIG_KEYS or metric.startswith("counters."):
+        return "info"
+    if tail.endswith("_ub") or tail.endswith("_s") or "latency" in tail:
+        return "down"
+    if "qps" in tail or tail in ("value", "vs_baseline", "recall",
+                                 "recall_gate_met", "ann_beats_brute"):
+        return "up"
+    return "info"
+
+
+def compare(a: dict, b: dict, threshold: float, per_metric: dict):
+    """Rows of (metric, a, b, delta_frac, verdict), union of both runs."""
+    rows = []
+    for metric in sorted(set(a) | set(b)):
+        va, vb = a.get(metric), b.get(metric)
+        if va is None:
+            rows.append((metric, None, vb, None, "new"))
+            continue
+        if vb is None:
+            rows.append((metric, va, None, None, "gone"))
+            continue
+        delta = (vb - va) / abs(va) if va else (0.0 if vb == va else None)
+        dirn = direction(metric)
+        thr = per_metric.get(metric, threshold)
+        if dirn == "info":
+            verdict = "·"
+        elif delta is None:
+            # from-zero transition (va == 0, vb != 0): no finite delta, but
+            # the direction still decides — latency appearing from 0 is a
+            # regression the gate must not wave through as informational
+            verdict = ("improved" if (dirn == "up") == (vb > va)
+                       else "regression")
+        elif dirn == "up":
+            verdict = ("regression" if delta < -thr
+                       else "improved" if delta > thr else "ok")
+        else:
+            verdict = ("regression" if delta > thr
+                       else "improved" if delta < -thr else "ok")
+        rows.append((metric, va, vb, delta, verdict))
+    return rows
+
+
+def _fmt(v):
+    if v is None:
+        return "—"
+    if abs(v) >= 1000:
+        return f"{v:,.1f}"
+    return f"{v:.4g}"
+
+
+def markdown(rows, label_a, label_b, note_a, note_b, threshold) -> str:
+    lines = [
+        f"# Bench delta: {label_a} → {label_b}",
+        "",
+        f"Default regression threshold: ±{threshold:.0%} "
+        f"(direction-aware; `·` = informational).",
+    ]
+    for label, note in ((label_a, note_a), (label_b, note_b)):
+        if note:
+            lines.append(f"- **{label}**: {note}")
+    lines.append("")
+    if not rows:
+        lines.append("_No comparable metrics — nothing to diff._")
+        return "\n".join(lines) + "\n"
+    lines += [
+        f"| metric | {label_a} | {label_b} | Δ | verdict |",
+        "|---|---:|---:|---:|---|",
+    ]
+    order = {"regression": 0, "improved": 1, "ok": 2, "new": 3, "gone": 4,
+             "·": 5}
+    for metric, va, vb, delta, verdict in sorted(
+            rows, key=lambda r: (order.get(r[4], 9), r[0])):
+        d = "—" if delta is None else f"{delta:+.1%}"
+        lines.append(
+            f"| `{metric}` | {_fmt(va)} | {_fmt(vb)} | {d} | {verdict} |")
+    n_reg = sum(1 for r in rows if r[4] == "regression")
+    n_imp = sum(1 for r in rows if r[4] == "improved")
+    lines += ["",
+              f"**{n_reg} regression(s), {n_imp} improvement(s), "
+              f"{len(rows)} metrics compared.**"]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/bench_compare.py",
+        description="Diff two bench runs into a markdown delta table.")
+    ap.add_argument("run_a", help="older run (driver JSON / metric line / "
+                                  "metrics JSONL)")
+    ap.add_argument("run_b", help="newer run")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="default regression threshold as a fraction "
+                         "(default 0.05 = 5%%)")
+    ap.add_argument("--metric-threshold", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric override, repeatable "
+                         "(e.g. ivf_pq.qps=0.02)")
+    ap.add_argument("--output", default=None, metavar="PATH",
+                    help="also write the markdown report here")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when any regression verdict exists")
+    args = ap.parse_args(argv)
+
+    per_metric = {}
+    for spec in args.metric_threshold:
+        metric, _, frac = spec.partition("=")
+        try:
+            per_metric[metric.strip()] = float(frac)
+        except ValueError:
+            print(f"bench_compare: bad --metric-threshold {spec!r}",
+                  file=sys.stderr)
+            return 2
+
+    label_a, line_a, note_a = load_run(args.run_a)
+    label_b, line_b, note_b = load_run(args.run_b)
+    if line_a is None and line_b is None:
+        print(f"bench_compare: neither input is readable "
+              f"({note_a}; {note_b})", file=sys.stderr)
+        return 2
+
+    rows = compare(metrics_of(line_a), metrics_of(line_b),
+                   args.threshold, per_metric)
+    report = markdown(rows, label_a, label_b, note_a, note_b, args.threshold)
+    sys.stdout.write(report)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report)
+            f.flush()
+    if args.fail_on_regression and any(r[4] == "regression" for r in rows):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,29 @@ JAX_PLATFORMS=cpu python -m raft_tpu.analysis raft_tpu tests bench.py scripts \
     || fail=1
 
 echo
+echo "== bench_compare (BENCH_r04 → BENCH_r05 trajectory diff) =="
+python scripts/bench_compare.py BENCH_r04.json BENCH_r05.json >/dev/null \
+    && echo "bench_compare: OK" || fail=1
+
+echo
+echo "== trace-export smoke (span tree → Chrome trace JSON) =="
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import json, os, tempfile
+from raft_tpu import obs
+obs.enable()
+with obs.record_span("check::entry", attrs={"rows": 1}):
+    with obs.record_span("check::phase"):
+        with obs.record_span("check::tile"):
+            pass
+path = os.path.join(tempfile.mkdtemp(), "trace_check.json")
+obs.export_chrome_trace(path)
+doc = json.load(open(path))
+names = {e["name"] for e in doc["traceEvents"]}
+assert {"check::entry", "check::phase", "check::tile"} <= names, names
+print("trace-export: OK (%d events)" % len(doc["traceEvents"]))
+EOF
+
+echo
 echo "== ruff (advisory — does not gate) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check raft_tpu tests bench.py scripts || true

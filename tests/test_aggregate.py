@@ -1,0 +1,202 @@
+"""Fleet aggregation tests (round-8 satellite): the merge must be
+associative and EXACT for counters and power-of-two histograms, and the
+``python -m raft_tpu.obs.aggregate`` CLI must fold two fake per-process
+files into one correct fleet view end to end."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu.obs import aggregate
+from raft_tpu.obs.registry import MetricsRegistry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# percentile bounds
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_bounds_basics():
+    assert aggregate.percentile_bounds({}, 0) == {}
+    assert aggregate.percentile_bounds({"le_8": 1}, 1) == \
+        {"p50_ub": 8.0, "p90_ub": 8.0, "p99_ub": 8.0}
+    # 90 values ≤2, 10 values ≤1024: p50 in the low bucket, p99 in the high
+    b = {"le_2": 90, "le_1024": 10}
+    out = aggregate.percentile_bounds(b, 100)
+    assert out == {"p50_ub": 2.0, "p90_ub": 2.0, "p99_ub": 1024.0}
+
+
+def test_percentile_bounds_ignore_malformed_keys():
+    out = aggregate.percentile_bounds({"le_4": 3, "garbage": 5}, 8)
+    assert out["p50_ub"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# exactness + associativity (property-style over random streams)
+# ---------------------------------------------------------------------------
+
+
+def _feed(reg, counters, timings, values):
+    for name, v in counters:
+        reg.add(name, v)
+    for name, s in timings:
+        reg.record_timing(name, s)
+    for name, v in values:
+        reg.observe(name, v)
+
+
+def _random_stream(rng, n):
+    names = ["a.rows", "b.rows", "c.hits"]
+    counters = [(names[rng.integers(3)], int(rng.integers(1, 100)))
+                for _ in range(n)]
+    timings = [(f"t.{rng.integers(2)}", float(rng.uniform(1e-4, 2.0)))
+               for _ in range(n)]
+    values = [(f"h.{rng.integers(2)}", float(rng.uniform(0.01, 500.0)))
+              for _ in range(n)]
+    return counters, timings, values
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_merge_of_split_streams_equals_whole(seed):
+    """Split one stream across three fake processes: the merge of the three
+    snapshots must equal the snapshot of one registry fed everything —
+    bit-exact for counters and histogram buckets."""
+    rng = np.random.default_rng(seed)
+    counters, timings, values = _random_stream(rng, 200)
+    whole = MetricsRegistry()
+    _feed(whole, counters, timings, values)
+    parts = [MetricsRegistry() for _ in range(3)]
+    for i in range(3):
+        _feed(parts[i], counters[i::3], timings[i::3], values[i::3])
+
+    merged = aggregate.merge_snapshots([p.snapshot() for p in parts])
+    expect = whole.snapshot()
+    assert merged["counters"] == expect["counters"]
+    for name, h in expect["histograms"].items():
+        m = merged["histograms"][name]
+        assert m["buckets"] == h["buckets"]
+        assert m["count"] == h["count"]
+        assert m["min"] == h["min"] and m["max"] == h["max"]
+        # percentile bounds derive from identical buckets → identical
+        for q in ("p50_ub", "p90_ub", "p99_ub"):
+            assert m[q] == h[q]
+        assert m["sum"] == pytest.approx(h["sum"])
+    for name, t in expect["timers"].items():
+        m = merged["timers"][name]
+        assert m["count"] == t["count"]
+        assert m["min_s"] == t["min_s"] and m["max_s"] == t["max_s"]
+        assert m["total_s"] == pytest.approx(t["total_s"])
+        assert m["mean_s"] == pytest.approx(t["mean_s"])
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_merge_is_associative(seed):
+    rng = np.random.default_rng(100 + seed)
+    snaps = []
+    for _ in range(3):
+        reg = MetricsRegistry()
+        _feed(reg, *_random_stream(rng, 60))
+        snaps.append(reg.snapshot())
+    a, b, c = snaps
+    left = aggregate.merge_snapshots(
+        [aggregate.merge_snapshots([a, b]), c])
+    right = aggregate.merge_snapshots(
+        [a, aggregate.merge_snapshots([b, c])])
+    assert left["counters"] == right["counters"]
+    for name in left["histograms"]:
+        lh, rh = left["histograms"][name], right["histograms"][name]
+        assert lh["buckets"] == rh["buckets"]
+        assert lh["count"] == rh["count"]
+        assert {k: lh[k] for k in ("p50_ub", "p90_ub", "p99_ub")} == \
+            {k: rh[k] for k in ("p50_ub", "p90_ub", "p99_ub")}
+    for name in left["timers"]:
+        assert left["timers"][name]["count"] == right["timers"][name]["count"]
+        assert left["timers"][name]["total_s"] == \
+            pytest.approx(right["timers"][name]["total_s"])
+
+
+def test_merge_records_keeps_newest_per_process():
+    """Each line is a CUMULATIVE snapshot of its process: only the newest
+    per (source, process_index) may contribute, or counts double."""
+    recs = [
+        {"_source": "f0", "process_index": 0, "t": 1.0,
+         "counters": {"rows": 10}},
+        {"_source": "f0", "process_index": 0, "t": 2.0,
+         "counters": {"rows": 25}},  # supersedes the first line
+        {"_source": "f1", "process_index": 1, "t": 1.5,
+         "counters": {"rows": 7}},
+    ]
+    out = aggregate.merge_records(recs)
+    assert out["counters"]["rows"] == 32
+    assert out["processes"] == [0, 1]
+    assert out["t_min"] == 1.5 and out["t_max"] == 2.0
+
+
+def test_merge_empty_is_empty():
+    out = aggregate.merge_snapshots([])
+    assert out == {"counters": {}, "timers": {}, "histograms": {}}
+    assert aggregate.merge_records([])["processes"] == []
+
+
+# ---------------------------------------------------------------------------
+# two-fake-process end-to-end through the CLI
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_cli_two_processes(tmp_path, monkeypatch):
+    files = []
+    for pi in (0, 1):
+        monkeypatch.setenv("RAFT_TPU_PROCESS_INDEX", str(pi))
+        monkeypatch.setenv("RAFT_TPU_PROCESS_COUNT", "2")
+        reg = MetricsRegistry()
+        reg.add("search.queries", 100 * (pi + 1))
+        reg.record_timing("ivf_pq::search", 0.25 + pi)
+        for v in range(1, 33):
+            reg.observe("batch_s", v * (pi + 1))
+        path = str(tmp_path / f"m{pi}.jsonl")
+        reg.export_jsonl(path, extra={"run": "fake"})
+        reg.add("search.queries", 1)  # newer cumulative line supersedes
+        reg.export_jsonl(path, extra={"run": "fake"})
+        files.append(path)
+    monkeypatch.delenv("RAFT_TPU_PROCESS_INDEX")
+    monkeypatch.delenv("RAFT_TPU_PROCESS_COUNT")
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.aggregate", *files],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "found in sys.modules" not in proc.stderr  # clean -m execution
+    fleet = json.loads(proc.stdout)
+    # newest line per process: (100+1) + (200+1)
+    assert fleet["counters"]["search.queries"] == 302
+    t = fleet["timers"]["ivf_pq::search"]
+    assert t["count"] == 2
+    assert t["total_s"] == pytest.approx(0.25 + 1.25)
+    assert t["min_s"] == pytest.approx(0.25)
+    h = fleet["histograms"]["batch_s"]
+    assert h["count"] == 64
+    assert h["max"] == 64.0
+    assert h["p99_ub"] == 64.0
+    assert fleet["processes"] == [0, 1]
+    assert fleet["process_count"] == 2
+    assert len(fleet["sources"]) == 2
+
+
+def test_aggregate_cli_no_records(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("not json\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.aggregate", str(empty)],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2
+    assert "no parseable records" in proc.stderr

@@ -235,6 +235,30 @@ def load(path):
 """,
     ),
     (
+        "span-name",
+        "raft_tpu/bench/mod.py",
+        """
+from raft_tpu import obs
+from raft_tpu.core.trace import traced
+
+@traced("run suite")
+def run(path):
+    with obs.record_span("benchScan"):
+        obs.export_jsonl(path)
+""",
+        # near-miss: module::phase names + the progress.py export channel
+        """
+from raft_tpu import obs
+from raft_tpu.bench import progress
+from raft_tpu.core.trace import traced
+
+@traced("bench.mod::run")
+def run(path):
+    with obs.record_span("bench.mod::scan"):
+        progress.export_metrics(path, obs.snapshot())
+""",
+    ),
+    (
         "unclassified-except",
         "bench.py",
         """

@@ -1,0 +1,119 @@
+"""scripts/bench_compare.py tests: the checked-in r04→r05 diff must work
+(the acceptance criterion — r05 is the parsed=null wedge), and synthetic
+runs must get direction-aware verdicts with configurable thresholds."""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "bench_compare.py")
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, _SCRIPT, *args],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+    )
+
+
+def _driver_file(tmp_path, name, extras, value, rc=0):
+    line = {"metric": "ivf_pq_qps_x", "value": value, "unit": "QPS",
+            "vs_baseline": value / 1e6, "extras": extras}
+    path = tmp_path / name
+    path.write_text(json.dumps({"n": 1, "rc": rc, "tail": "", "parsed": line}))
+    return str(path)
+
+
+def test_checked_in_r04_vs_r05_runs_clean():
+    """The first trajectory datapoint: r05 is the rc=124 wedge with
+    parsed=null — the comparator must produce a report, not an error."""
+    proc = _run("BENCH_r04.json", "BENCH_r05.json")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "Bench delta" in proc.stdout
+    assert "no data (rc=124" in proc.stdout
+    assert "ivf_pq.qps" in proc.stdout
+    assert "0 regression(s)" in proc.stdout  # "gone" rows are not verdicts
+
+
+def test_direction_aware_verdicts(tmp_path):
+    a = _driver_file(tmp_path, "a.json",
+                     {"ivf_pq": {"qps": 1000.0, "recall": 0.96,
+                                 "build_s": 10.0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"ivf_pq": {"qps": 800.0, "recall": 0.97,
+                                 "build_s": 5.0}}, 800.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    # qps down 20% → regression; build_s halved → improved (lower-better);
+    # recall +1% → inside the 5% default threshold
+    assert rows["ivf_pq.qps"] == "regression"
+    assert rows["value"] == "regression"
+    assert rows["ivf_pq.build_s"] == "improved"
+    assert rows["ivf_pq.recall"] == "ok"
+
+
+def test_fail_on_regression_and_thresholds(tmp_path):
+    a = _driver_file(tmp_path, "a.json", {"ivf_pq": {"qps": 1000.0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json", {"ivf_pq": {"qps": 960.0}}, 960.0)
+    # -4% is inside the default 5% gate
+    assert _run(a, b, "--fail-on-regression").returncode == 0
+    # a 2% per-metric gate flips it (value still passes at 5%)
+    proc = _run(a, b, "--fail-on-regression",
+                "--metric-threshold", "ivf_pq.qps=0.02")
+    assert proc.returncode == 1
+    assert "1 regression(s)" in proc.stdout
+    # a global 1% gate catches both
+    proc = _run(a, b, "--fail-on-regression", "--threshold", "0.01")
+    assert proc.returncode == 1
+
+
+def test_output_file_and_metrics_jsonl_inputs(tmp_path, monkeypatch):
+    # metrics-JSONL mode: timers compare on mean_s (lower-better)
+    for name, mean in (("old.jsonl", 0.10), ("new.jsonl", 0.30)):
+        rec = {"t": 1.0, "process_index": 0, "process_count": 1,
+               "counters": {"rows": 5}, "histograms": {},
+               "timers": {"ivf_pq::search": {
+                   "count": 4, "total_s": 4 * mean, "min_s": mean,
+                   "max_s": mean, "mean_s": mean}}}
+        (tmp_path / name).write_text(json.dumps(rec) + "\n")
+    out = str(tmp_path / "delta.md")
+    proc = _run(str(tmp_path / "old.jsonl"), str(tmp_path / "new.jsonl"),
+                "--output", out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = open(out).read()
+    assert text == proc.stdout
+    assert "`timers.ivf_pq::search.mean_s`" in text
+    # 3× slower timer is a regression; counters stay informational
+    assert any("mean_s" in l and "regression" in l
+               for l in text.splitlines())
+    assert any("counters.rows" in l and "·" in l for l in text.splitlines())
+
+
+def test_from_zero_transition_gets_a_verdict(tmp_path):
+    """va == 0 has no finite delta, but direction still decides — a latency
+    appearing from 0 must gate, not slip through as informational."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"ivf_pq": {"qps": 0.0, "build_s": 0.0}}, 0.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"ivf_pq": {"qps": 500.0, "build_s": 9.0}}, 500.0)
+    proc = _run(a, b, "--fail-on-regression")
+    assert proc.returncode == 1, proc.stdout
+    rows = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    assert rows["ivf_pq.qps"] == "improved"      # up-metric from zero
+    assert rows["ivf_pq.build_s"] == "regression"  # down-metric from zero
+
+
+def test_unreadable_inputs_exit_2(tmp_path):
+    proc = _run(str(tmp_path / "nope.json"), str(tmp_path / "nope2.json"))
+    assert proc.returncode == 2

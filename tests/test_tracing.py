@@ -1,0 +1,293 @@
+"""Span-tree tracing tests (round-8 ISSUE 5 acceptance criteria).
+
+Covers: contextvar parenting + attribute round-trip, exception-safe
+classified spans (enabled AND disabled — the satellite regression test),
+the bounded ring, Chrome trace-event export from an instrumented ivf_pq
+build+search (≥3-level tree: entry → phase → tile), sync-mode device-time
+attribution, and the histogram percentile upper bounds."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import tracing
+
+
+@pytest.fixture
+def telemetry():
+    """Enabled gate + clean registry/ring before and after."""
+    obs.reset()
+    obs.clear_spans()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+        obs.clear_spans()
+
+
+def _by_name(records):
+    out = {}
+    for rec in records:
+        out.setdefault(rec["name"], rec)
+    return out
+
+
+def _depth(rec, records):
+    ids = {r["span_id"]: r for r in records}
+    depth, pid = 1, rec["parent_id"]
+    while pid is not None:
+        rec = ids[pid]
+        depth += 1
+        pid = rec["parent_id"]
+    return depth
+
+
+# ---------------------------------------------------------------------------
+# tree structure + attributes
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_parenting_and_attrs(telemetry):
+    with obs.record_span("unit::entry", attrs={"rows": 128}):
+        with obs.record_span("unit::phase"):
+            with obs.record_span("unit::tile") as sp:
+                sp.set_attr("tile", 3)
+    recs = obs.spans()
+    assert [r["name"] for r in recs] == \
+        ["unit::tile", "unit::phase", "unit::entry"]  # close order
+    by = _by_name(recs)
+    assert by["unit::entry"]["parent_id"] is None
+    assert by["unit::phase"]["parent_id"] == by["unit::entry"]["span_id"]
+    assert by["unit::tile"]["parent_id"] == by["unit::phase"]["span_id"]
+    # one trace spans the whole tree; attrs round-trip
+    assert len({r["trace_id"] for r in recs}) == 1
+    assert by["unit::entry"]["attrs"] == {"rows": 128}
+    assert by["unit::tile"]["attrs"] == {"tile": 3}
+    assert all(r["dur_s"] >= 0.0 for r in recs)
+
+
+def test_sibling_spans_share_parent(telemetry):
+    with obs.record_span("unit::entry"):
+        with obs.record_span("unit::a"):
+            pass
+        with obs.record_span("unit::b"):
+            pass
+    by = _by_name(obs.spans())
+    assert by["unit::a"]["parent_id"] == by["unit::entry"]["span_id"]
+    assert by["unit::b"]["parent_id"] == by["unit::entry"]["span_id"]
+    assert by["unit::a"]["span_id"] != by["unit::b"]["span_id"]
+
+
+def test_new_thread_starts_new_trace(telemetry):
+    done = threading.Event()
+
+    def worker():
+        with obs.record_span("unit::threaded"):
+            pass
+        done.set()
+
+    with obs.record_span("unit::main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert done.wait(1)
+    by = _by_name(obs.spans())
+    assert by["unit::threaded"]["parent_id"] is None
+    assert by["unit::threaded"]["trace_id"] != by["unit::main"]["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# exception safety + classification (satellite: raise-inside-record_span
+# must be covered for BOTH enabled and disabled telemetry)
+# ---------------------------------------------------------------------------
+
+
+def test_span_raise_enabled_records_and_classifies(telemetry):
+    with pytest.raises(RuntimeError):
+        with obs.record_span("unit::oom"):
+            raise RuntimeError("RESOURCE_EXHAUSTED: hbm over budget")
+    with pytest.raises(ValueError):
+        with obs.record_span("unit::bug"):
+            raise ValueError("shape mismatch")
+    snap = obs.snapshot()
+    # durations recorded despite the raise
+    assert snap["timers"]["unit::oom"]["count"] == 1
+    assert snap["timers"]["unit::bug"]["count"] == 1
+    # spans tagged with the resilience.classify kind + error counters
+    by = _by_name(obs.spans())
+    assert by["unit::oom"]["error"] == "oom"
+    assert by["unit::bug"]["error"] == "fatal"
+    assert snap["counters"]["span.errors.oom"] == 1
+    assert snap["counters"]["span.errors.fatal"] == 1
+
+
+def test_span_raise_disabled_is_pure_passthrough():
+    assert not obs.enabled()
+    obs.clear_spans()
+    span = obs.record_span("unit::never")
+    assert span is obs.NOOP_SPAN
+    with pytest.raises(RuntimeError):
+        with span:
+            raise RuntimeError("boom")
+    # nothing recorded anywhere: registry, ring, or error counters
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+    assert obs.spans() == []
+    assert obs.NOOP_SPAN.set_attr("k", 1) is obs.NOOP_SPAN
+
+
+# ---------------------------------------------------------------------------
+# ring bound
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_is_bounded(telemetry):
+    cap = tracing._SPANS.maxlen
+    assert cap and cap > 0
+    for i in range(cap + 100):
+        tracing.push_span({"name": "unit::flood", "span_id": str(i),
+                           "parent_id": None, "trace_id": "t", "t0": 0.0,
+                           "dur_s": 0.0})
+    recs = obs.spans()
+    assert len(recs) == cap
+    # oldest entries evicted, newest kept
+    assert recs[-1]["span_id"] == str(cap + 99)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export (the acceptance test: instrumented ivf_pq build+search
+# → parseable Perfetto JSON with a ≥3-level span tree and attr round-trip)
+# ---------------------------------------------------------------------------
+
+
+def test_ivf_pq_trace_export_acceptance(telemetry, rng, tmp_path):
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq
+
+    data = jnp.asarray(rng.standard_normal((512, 16), dtype=np.float32))
+    queries = jnp.asarray(rng.standard_normal((8, 16), dtype=np.float32))
+    index = ivf_pq.build(data, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8))
+    vals, _ = ivf_pq.search(index, queries, 5, n_probes=4)
+    np.asarray(vals)  # force completion inside the traced session
+
+    path = str(tmp_path / "trace_ivf_pq.json")
+    obs.export_chrome_trace(path, extra={"run": "tier1"})
+    with open(path) as f:
+        doc = json.load(f)  # must parse as strict JSON
+
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert events, "no span events exported"
+    # rebuild the tree from the exported args (round-trip, not the ring)
+    by_id = {e["args"]["span_id"]: e for e in events}
+
+    def depth(e):
+        d, pid = 1, e["args"]["parent_id"]
+        while pid is not None:
+            e = by_id[pid]
+            d += 1
+            pid = e["args"]["parent_id"]
+        return d
+
+    names = {e["name"] for e in events}
+    assert {"ivf_pq::build", "ivf_pq::encode", "ivf_pq::encode_tile",
+            "ivf_pq::search", "ivf_pq::scan"} <= names
+    # entry → phase → tile: the tile span sits ≥3 levels deep
+    tile = next(e for e in events if e["name"] == "ivf_pq::encode_tile")
+    assert depth(tile) >= 3
+    # typed attributes round-trip through the file
+    encode = next(e for e in events if e["name"] == "ivf_pq::encode")
+    assert encode["args"]["rows"] == 512
+    scan = next(e for e in events if e["name"] == "ivf_pq::scan")
+    assert scan["args"]["backend"] == "gather"
+    assert scan["args"]["queries"] == 8 and scan["args"]["probes"] == 4
+    # timestamps are microseconds and parent intervals contain children
+    build = next(e for e in events if e["name"] == "ivf_pq::build")
+    assert build["ts"] <= tile["ts"]
+    assert build["ts"] + build["dur"] >= tile["ts"]
+    assert doc["otherData"]["run"] == "tier1"
+
+
+def test_chrome_trace_includes_resilience_instants(telemetry):
+    from raft_tpu import resilience
+
+    resilience.clear_events()
+    try:
+        with obs.record_span("unit::recovering"):
+            resilience.record_event("degraded_tile", site="unit.test",
+                                    from_size=8, to_size=4)
+        doc = obs.chrome_trace()
+        inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "degraded_tile" and
+                   e["args"]["site"] == "unit.test" and
+                   e["args"]["to_size"] == 4 and e["ts"] > 0
+                   for e in inst)
+    finally:
+        resilience.clear_events()
+
+
+# ---------------------------------------------------------------------------
+# sync mode (device-time attribution)
+# ---------------------------------------------------------------------------
+
+
+def test_sync_mode_records_dispatch_and_committed(telemetry):
+    import jax.numpy as jnp
+
+    assert not obs.sync_enabled()
+    obs.enable_sync()
+    try:
+        with obs.record_span("unit::jitted"):
+            jnp.sum(jnp.ones((64, 64)))  # dispatched, not fetched
+    finally:
+        obs.disable_sync()
+    rec = _by_name(obs.spans())["unit::jitted"]
+    # dispatch wall-clock preserved; committed duration includes the drain
+    assert "dispatch_s" in rec
+    assert rec["dispatch_s"] <= rec["dur_s"]
+    # the registry timer carries the committed (drained) duration
+    assert obs.snapshot()["timers"]["unit::jitted"]["total_s"] == \
+        pytest.approx(rec["dur_s"])
+
+
+def test_sync_mode_off_has_no_dispatch_attr(telemetry):
+    with obs.record_span("unit::plain"):
+        time.sleep(0.001)
+    assert "dispatch_s" not in _by_name(obs.spans())["unit::plain"]
+
+
+# ---------------------------------------------------------------------------
+# histogram percentile upper bounds (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentile_upper_bounds(telemetry):
+    values = list(range(1, 101))
+    for v in values:
+        obs.observe("unit.lat", v)
+    h = obs.snapshot()["histograms"]["unit.lat"]
+    for key, q in (("p50_ub", 50), ("p90_ub", 90), ("p99_ub", 99)):
+        true_q = float(np.percentile(values, q))
+        # documented contract: an UPPER bound, within 2× of the truth
+        assert h[key] >= true_q, (key, h[key], true_q)
+        assert h[key] <= 2.0 * true_q, (key, h[key], true_q)
+    # export carries the same derived keys
+    assert h["p50_ub"] == 64.0
+
+
+def test_export_jsonl_carries_process_stamp(telemetry, tmp_path,
+                                            monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_PROCESS_INDEX", "3")
+    monkeypatch.setenv("RAFT_TPU_PROCESS_COUNT", "8")
+    obs.add("unit.rows", 7)
+    rec = obs.export_jsonl(str(tmp_path / "m.jsonl"))
+    assert rec["process_index"] == 3
+    assert rec["process_count"] == 8
+    line = json.loads((tmp_path / "m.jsonl").read_text())
+    assert line["counters"]["unit.rows"] == 7
+    assert line["process_index"] == 3
