@@ -230,8 +230,14 @@ def search(
     width = int(params.search_width)
     max_iter = int(params.max_iterations) or max(16, itopk // width)
     min_iter = int(min(params.min_iterations, max_iter))
+    # allow_fused=False: the fused Pallas hop is a single-device kernel;
+    # shard bodies ride the unfused compressed loop (traversal="fused"
+    # downgrades, "auto" resolves straight to compressed here)
     mode, rt = sl._resolve_traversal(params, index.nbr_codes is not None,
-                                     int(k), itopk)
+                                     int(k), itopk,
+                                     size=index.rows_per_shard,
+                                     allow_fused=False,
+                                     b=width * index.graph_degree)
     compressed = mode == "compressed"
     has_cents = compressed and index.centroids is not None
     fn = _make_search_fn(

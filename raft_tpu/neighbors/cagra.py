@@ -75,7 +75,11 @@ from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.neighbors import nn_descent as nnd
+from raft_tpu.ops.cagra_hop import MAX_FUSED_ROWS, fused_hop
 from raft_tpu.ops.segment import merge_topk_dedup, segment_take
+# hoisted to module scope (code-review r6): the loop-body copies of this
+# import re-executed on every trace of the compressed search
+from raft_tpu.ops.select_k import iter_topk_min, iter_topk_min_packed
 from raft_tpu.utils.tiling import ceil_div
 
 
@@ -141,10 +145,12 @@ class CagraSearchParams:
     min_iterations: int = 0
     search_width: int = 1
     num_random_samplings: int = 1
-    # "auto" rides the compressed (inlined-int8-codes) loop whenever the
-    # index carries the payload; "exact" forces full-precision traversal
-    # (the pre-round-5 loop); "compressed" errors if the payload is absent
-    traversal: str = "auto"  # "auto" | "compressed" | "exact"
+    # "auto" rides the fused one-kernel hop (ops/cagra_hop.py) whenever the
+    # index carries the inlined-int8-codes payload and the backend compiles
+    # it (TPU), the unfused compressed loop otherwise; "fused"/"compressed"
+    # force their loop (both error if the payload is absent); "exact"
+    # forces full-precision traversal (the pre-round-5 loop)
+    traversal: str = "auto"  # "auto" | "fused" | "compressed" | "exact"
     # exact re-rank depth for the compressed loop: the final answer ranks
     # the best refine_topk buffer entries against the raw dataset
     # (0 = the whole itopk buffer — safest; shrink to trade a little
@@ -155,7 +161,7 @@ class CagraSearchParams:
     def __post_init__(self):
         if self.itopk_size <= 0 or self.search_width <= 0:
             raise ValueError("itopk_size and search_width must be positive")
-        if self.traversal not in ("auto", "compressed", "exact"):
+        if self.traversal not in ("auto", "fused", "compressed", "exact"):
             raise ValueError(f"unknown traversal mode {self.traversal!r}")
 
 
@@ -708,8 +714,6 @@ def _merge_candidates(bids, bd, bvis, cids, cd, itopk: int, packed: bool,
     the mantissa-packed iter select (2 VPU ops/pass) over ``lax.top_k``;
     top_k/packed are both stable, so the first copy — the buffer's,
     carrying its visited flag — is the one kept."""
-    from raft_tpu.ops.select_k import iter_topk_min_packed
-
     inf = jnp.float32(jnp.inf)
     dup_buf = jnp.any(cids[:, :, None] == bids[:, None, :], axis=2)
     bb = cids.shape[1]
@@ -851,57 +855,16 @@ def _search_impl(
     return out_d, out_ids
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "n_rand",
-                     "refine_topk"),
-)
-def _search_impl_compressed(
-    dataset, graph, nbr_codes, proj, code_scale, centroids, reps,
-    proj_energy, queries, key, filter_bits, n_bits,
-    k, itopk, width, max_iter, min_iter, n_rand, refine_topk,
-):
-    """Round-5 traversal over inlined neighbor codes (module docstring).
-
-    Cost shape per iteration at (q, w, deg, p): q·w graph-row gathers +
-    q·w code-record gathers (the ONLY per-row-op-bound work — the exact
-    loop paid q·w·deg), one (q, w·deg, p) int8→bf16 MXU contraction, a
-    compare-matrix dedup, and a mantissa-packed itopk select over
-    itopk + w·deg entries. Distances are projected-space ranking scores;
-    the exit re-ranks the best ``refine_topk`` buffer entries exactly.
-    """
-    from raft_tpu.ops.select_k import iter_topk_min_packed
-
+def _seed_compressed(dataset, proj, code_scale, centroids, reps, proj_energy,
+                     qf, qp, key, itopk: int, n_rand: int, merge):
+    """Seed the compressed-traversal buffer (shared by the unfused loop and
+    the fused driver — one implementation so seeds stay bit-identical):
+    centroid-guided when the payload carries a seeding table, random rows
+    projected on the fly otherwise. Returns the merged (ids, d, vis)."""
     n, dim = dataset.shape
-    q = queries.shape[0]
-    deg = graph.shape[1]
     p = proj.shape[1]
-    b = width * deg
-    qf = queries.astype(jnp.float32)
-    qp = (qf @ proj) / code_scale  # query in code units
+    q = qf.shape[0]
     inf = jnp.float32(jnp.inf)
-    iota_itopk = jnp.arange(itopk, dtype=jnp.int32)
-
-    def code_dists(codes, ids):
-        """(q, m) projected ranking scores ‖c‖² − 2⟨qp, c⟩ from int8 codes
-        (query-norm term dropped: constant per query)."""
-        cf = codes.astype(jnp.bfloat16)
-        ip = jnp.einsum("qmp,qp->qm", cf, qp.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)
-        nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
-                         preferred_element_type=jnp.float32)
-        return jnp.where(ids >= 0, nrm - 2.0 * ip, inf)
-
-    def merge(bids, bd, bvis, cids, cd):
-        # shared buffer∪candidate merge; mantissa-packed select.
-        # _CAGRA_DEDUP_LIMIT (internal tuning knob): whether candidate
-        # dedup pays the (q, b, b) compare tensor pre-select or the
-        # slack + re-select path — the crossover is hardware-dependent
-        return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
-                                 packed=True,
-                                 dedup_limit=_CAGRA_DEDUP_LIMIT)
-
-    # ---- seeds ------------------------------------------------------------
     if centroids is not None:
         # guided: one (q, c) MXU gemm, zero gathers. Centroid distances
         # live in the FULL space; scale by the projection's captured
@@ -934,12 +897,88 @@ def _search_impl_compressed(
         seed_d = jnp.sum(xp * xp, axis=2) - 2.0 * jnp.einsum(
             "qmp,qp->qm", xp, qp, preferred_element_type=jnp.float32)
 
-    buf_ids, buf_d, buf_vis = merge(
+    return merge(
         jnp.full((q, itopk), -1, jnp.int32),
         jnp.full((q, itopk), inf, jnp.float32),
         jnp.ones((q, itopk), jnp.bool_),
         seed_ids, seed_d,
     )
+
+
+def _exact_rerank(dataset, qf, buf_ids, filter_bits, n_bits, k: int, rt: int):
+    """Exact re-rank of the buffer head against the raw dataset — the
+    CAGRA-Q refinement exit both compressed traversals share. The buffer is
+    ascending post-merge, so its head IS the best ``rt`` candidates."""
+    inf = jnp.float32(jnp.inf)
+    r_ids = buf_ids[:, :rt]
+    xv = dataset[jnp.maximum(r_ids, 0)].astype(jnp.float32)  # (q, rt, dim)
+    ip = jnp.einsum("qmd,qd->qm", xv, qf, preferred_element_type=jnp.float32)
+    d_exact = jnp.sum(xv * xv, axis=2) - 2.0 * ip
+    d_exact = jnp.where(r_ids >= 0, d_exact, inf)
+    if filter_bits is not None:
+        allowed = Bitset(filter_bits, n_bits).test(r_ids)
+        d_exact = jnp.where(allowed, d_exact, inf)
+    out_d, sel = iter_topk_min(d_exact, k)
+    out_ids = jnp.take_along_axis(r_ids, sel, axis=1)
+    qn = jnp.sum(qf * qf, axis=1)
+    out_ids = jnp.where(jnp.isinf(out_d), -1, out_ids)
+    out_d = jnp.where(jnp.isinf(out_d), inf,
+                      jnp.maximum(out_d + qn[:, None], 0.0))
+    return out_d, out_ids
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "width", "max_iter", "min_iter", "n_rand",
+                     "refine_topk"),
+)
+def _search_impl_compressed(
+    dataset, graph, nbr_codes, proj, code_scale, centroids, reps,
+    proj_energy, queries, key, filter_bits, n_bits,
+    k, itopk, width, max_iter, min_iter, n_rand, refine_topk,
+):
+    """Round-5 traversal over inlined neighbor codes (module docstring).
+
+    Cost shape per iteration at (q, w, deg, p): q·w graph-row gathers +
+    q·w code-record gathers (the ONLY per-row-op-bound work — the exact
+    loop paid q·w·deg), one (q, w·deg, p) int8→bf16 MXU contraction, a
+    compare-matrix dedup, and a mantissa-packed itopk select over
+    itopk + w·deg entries. Distances are projected-space ranking scores;
+    the exit re-ranks the best ``refine_topk`` buffer entries exactly.
+    """
+    n, dim = dataset.shape
+    q = queries.shape[0]
+    deg = graph.shape[1]
+    p = proj.shape[1]
+    b = width * deg
+    qf = queries.astype(jnp.float32)
+    qp = (qf @ proj) / code_scale  # query in code units
+    inf = jnp.float32(jnp.inf)
+    iota_itopk = jnp.arange(itopk, dtype=jnp.int32)
+
+    def code_dists(codes, ids):
+        """(q, m) projected ranking scores ‖c‖² − 2⟨qp, c⟩ from int8 codes
+        (query-norm term dropped: constant per query)."""
+        cf = codes.astype(jnp.bfloat16)
+        ip = jnp.einsum("qmp,qp->qm", cf, qp.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+        nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
+                         preferred_element_type=jnp.float32)
+        return jnp.where(ids >= 0, nrm - 2.0 * ip, inf)
+
+    def merge(bids, bd, bvis, cids, cd):
+        # shared buffer∪candidate merge; mantissa-packed select.
+        # _CAGRA_DEDUP_LIMIT (internal tuning knob): whether candidate
+        # dedup pays the (q, b, b) compare tensor pre-select or the
+        # slack + re-select path — the crossover is hardware-dependent
+        return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
+                                 packed=True,
+                                 dedup_limit=_CAGRA_DEDUP_LIMIT)
+
+    # ---- seeds (shared with the fused driver) -----------------------------
+    buf_ids, buf_d, buf_vis = _seed_compressed(
+        dataset, proj, code_scale, centroids, reps, proj_energy,
+        qf, qp, key, itopk, n_rand, merge)
 
     def cond(state):
         ids_b, _, vis, it = state
@@ -948,10 +987,8 @@ def _search_impl_compressed(
 
     def body(state):
         ids_b, d_b, vis, it = state
-        from raft_tpu.ops.select_k import iter_topk_min_packed as topk_p
-
         pkey = jnp.where(vis | (ids_b < 0), inf, d_b)
-        pv, ppos = topk_p(pkey, width)
+        pv, ppos = iter_topk_min_packed(pkey, width)
         parent_ids = jnp.take_along_axis(ids_b, ppos, axis=1)  # (q, w)
         parent_ok = ~jnp.isinf(pv)
         vis = vis | jnp.any(
@@ -969,45 +1006,164 @@ def _search_impl_compressed(
         cond, body, (buf_ids, buf_d, buf_vis, jnp.int32(0))
     )
 
-    # ---- exit: exact re-rank of the buffer head against the raw dataset ---
-    # (the CAGRA-Q refinement step; buffer is ascending post-merge, so the
-    # head IS the best refine_topk candidates)
-    rt = refine_topk
-    r_ids = buf_ids[:, :rt]
-    xv = dataset[jnp.maximum(r_ids, 0)].astype(jnp.float32)  # (q, rt, dim)
-    ip = jnp.einsum("qmd,qd->qm", xv, qf, preferred_element_type=jnp.float32)
-    d_exact = jnp.sum(xv * xv, axis=2) - 2.0 * ip
-    d_exact = jnp.where(r_ids >= 0, d_exact, inf)
-    if filter_bits is not None:
-        allowed = Bitset(filter_bits, n_bits).test(r_ids)
-        d_exact = jnp.where(allowed, d_exact, inf)
-    from raft_tpu.ops.select_k import iter_topk_min
+    # ---- exit: exact re-rank of the buffer head (shared with fused) -------
+    return _exact_rerank(dataset, qf, buf_ids, filter_bits, n_bits, k,
+                         refine_topk)
 
-    out_d, sel = iter_topk_min(d_exact, k)
-    out_ids = jnp.take_along_axis(r_ids, sel, axis=1)
-    qn = jnp.sum(qf * qf, axis=1)
-    out_ids = jnp.where(jnp.isinf(out_d), -1, out_ids)
-    out_d = jnp.where(jnp.isinf(out_d), inf,
-                      jnp.maximum(out_d + qn[:, None], 0.0))
-    return out_d, out_ids
 
+
+# ---------------------------------------------------------------------------
+# Round-6 fused traversal: the compressed loop with its five per-hop ops
+# (graph gather, code gather, int8 einsum, dedup, merge) collapsed into one
+# Pallas kernel (ops/cagra_hop.py). The host drives hops in chunks so every
+# dispatch carries a `cagra::hop` span + faultpoint, while termination stays
+# on-device (each chunk is a lax.while_loop that no-ops once the frontier
+# closes — no host sync in the hop loop).
+# ---------------------------------------------------------------------------
+
+# hops per chunk dispatch: large enough that chunk overhead amortizes, small
+# enough that spans/deadline checkpoints see the traversal progressing
+_CAGRA_HOP_CHUNK = int(_os.environ.get("RAFT_TPU_CAGRA_HOP_CHUNK", "8"))
+# queries per kernel grid step (VMEM-bound: the (b, b) dedup compare and the
+# (q_block·w, deg, p) code scratch scale with it)
+_CAGRA_QBLOCK = int(_os.environ.get("RAFT_TPU_CAGRA_QBLOCK", "32"))
+# parents ride the kernel's scalar-prefetch channel (SMEM): cap the query
+# tile so the (q_tile, w) int32 table stays small
+_FUSED_MAX_TILE = 4096
+
+
+@functools.partial(jax.jit, static_argnames=("itopk", "n_rand"))
+def _fused_init(dataset, proj, code_scale, centroids, reps, proj_energy,
+                queries, key, itopk, n_rand):
+    """Project queries into code units and seed the buffer — identical ops
+    to the unfused loop's preamble (seeds shared via _seed_compressed), with
+    the visited flags widened to fp32 for the kernel."""
+    qf = queries.astype(jnp.float32)
+    qp = (qf @ proj) / code_scale
+
+    def merge(bids, bd, bvis, cids, cd):
+        return _merge_candidates(bids, bd, bvis, cids, cd, itopk,
+                                 packed=True,
+                                 dedup_limit=_CAGRA_DEDUP_LIMIT)
+
+    buf_ids, buf_d, buf_vis = _seed_compressed(
+        dataset, proj, code_scale, centroids, reps, proj_energy,
+        qf, qp, key, itopk, n_rand, merge)
+    return buf_ids, buf_d, buf_vis.astype(jnp.float32), qp
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("itopk", "width", "min_iter", "q_block", "interpret"),
+)
+def _fused_hop_chunk(graph, nbr_codes, qp, buf_ids, buf_d, buf_vis, it,
+                     budget, itopk, width, min_iter, q_block, interpret):
+    """Up to ``budget - it`` fused hops in one dispatch. Parent pickup is
+    the same packed top-width as the unfused body; everything after it —
+    gathers, distances, dedup, merge — happens inside the fused_hop kernel.
+    Once the frontier closes the while_loop exits immediately, so chunks
+    dispatched after termination cost one condition evaluation."""
+    inf = jnp.float32(jnp.inf)
+    iota_itopk = jnp.arange(itopk, dtype=jnp.int32)
+
+    def cond(state):
+        ids_b, _, vis, i = state
+        frontier_open = jnp.any((vis == 0) & (ids_b >= 0))
+        return (i < budget) & (frontier_open | (i < min_iter))
+
+    def body(state):
+        ids_b, d_b, vis, i = state
+        # pickup_next_parents: best `width` unvisited buffer entries
+        pkey = jnp.where((vis > 0) | (ids_b < 0), inf, d_b)
+        pv, ppos = iter_topk_min_packed(pkey, width)
+        parent_ids = jnp.take_along_axis(ids_b, ppos, axis=1)  # (q, w)
+        parents = jnp.where(jnp.isinf(pv), -1, parent_ids)
+        picked = jnp.any(
+            iota_itopk[None, None, :] == ppos[:, :, None], axis=1)
+        vis = jnp.where(picked, jnp.float32(1.0), vis)
+        ids2, d2, vis2 = fused_hop(
+            ids_b, d_b, vis, parents, qp, graph, nbr_codes,
+            q_block=q_block, interpret=interpret)
+        return ids2, d2, vis2, i + 1
+
+    return lax.while_loop(cond, body, (buf_ids, buf_d, buf_vis, it))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "rt"))
+def _fused_finish(dataset, queries, buf_ids, filter_bits, n_bits, k, rt):
+    qf = queries.astype(jnp.float32)
+    return _exact_rerank(dataset, qf, buf_ids, filter_bits, n_bits, k, rt)
+
+
+def _run_fused_tile(index: "CagraIndex", qs, key, fb, k, itopk, width,
+                    max_iter, min_iter, n_rand, rt, q_block, interpret):
+    """One query tile through the fused traversal: init → chunked hop
+    dispatches (each with a `cagra::hop` span and an armable faultpoint at
+    the host dispatch site) → exact exit re-rank. Returns (d, ids, hops)."""
+    from raft_tpu.resilience import faultpoint
+
+    buf_ids, buf_d, buf_vis, qp = _fused_init(
+        index.dataset, index.proj, index.code_scale, index.centroids,
+        index.centroid_reps, index.proj_energy, qs, key, itopk, n_rand)
+    it = jnp.int32(0)
+    for start in range(0, max_iter, _CAGRA_HOP_CHUNK):
+        budget = min(start + _CAGRA_HOP_CHUNK, max_iter)
+        faultpoint("cagra.search.hop")
+        with obs.record_span("cagra::hop",
+                             attrs={"budget": budget, "width": width}):
+            buf_ids, buf_d, buf_vis, it = _fused_hop_chunk(
+                index.graph, index.nbr_codes, qp, buf_ids, buf_d, buf_vis,
+                it, jnp.int32(budget), itopk=itopk, width=width,
+                min_iter=min_iter, q_block=q_block, interpret=interpret)
+    out_d, out_ids = _fused_finish(
+        index.dataset, qs, buf_ids, fb, index.size, int(k), rt)
+    return out_d, out_ids, it
 
 
 def _resolve_traversal(params: CagraSearchParams, has_payload: bool,
-                       k: int, itopk: int):
+                       k: int, itopk: int, size: int = 0,
+                       allow_fused: bool = True, b: int = 0):
     """Resolve the traversal mode + exact-re-rank depth once for every
     search wrapper (single-device and distributed share this — the two
     copies had already drifted, code-review r5). Returns
-    ``(mode, refine_topk)`` with refine_topk = 0 for the exact loop."""
+    ``(mode, refine_topk)`` with refine_topk = 0 for the exact loop.
+
+    "auto" picks the fused Pallas loop when the codes are inlined and the
+    backend compiles it (TPU); the compiled-interpret route stays available
+    by asking for ``traversal="fused"`` explicitly (tests). Fused falls
+    back to the unfused compressed loop when the caller can't host the
+    kernel (``allow_fused=False`` — distributed shard bodies), the index
+    exceeds the kernel's exact-id bound (MAX_FUSED_ROWS), or the candidate
+    set ``b`` (width·degree) is past _CAGRA_DEDUP_LIMIT — there the
+    unfused merge switches to its slack+re-select dedup, and fused
+    results could no longer be bit-identical to it (which is both the
+    parity contract and what makes the mid-batch fallback seamless).
+
+    Parity scope: with a centroid seeding table (every index past the
+    small-n threshold) fused per-query results are bit-identical to the
+    unfused loop regardless of batch shape. Small centroid-less indexes
+    seed by ``jax.random.randint`` at the (possibly q-block-padded) tile
+    shape, so there parity additionally needs q to be a tile/block
+    multiple — a different draw yields different (equally valid) seeds,
+    not wrong results."""
     mode = params.traversal
+    fused_capable = (has_payload and allow_fused
+                     and 0 < size < MAX_FUSED_ROWS
+                     and 0 < b <= _CAGRA_DEDUP_LIMIT)
     if mode == "auto":
-        mode = "compressed" if has_payload else "exact"
-    elif mode == "compressed" and not has_payload:
+        if has_payload:
+            mode = ("fused" if fused_capable
+                    and jax.default_backend() == "tpu" else "compressed")
+        else:
+            mode = "exact"
+    elif mode in ("compressed", "fused") and not has_payload:
         raise ValueError(
-            "traversal='compressed' needs the compression payload "
+            f"traversal={mode!r} needs the compression payload "
             "(build with CagraParams.compress)")
+    if mode == "fused" and not fused_capable:
+        mode = "compressed"
     rt = 0
-    if mode == "compressed":
+    if mode in ("compressed", "fused"):
         rt = int(params.refine_topk) or itopk
         if not k <= rt <= itopk:
             raise ValueError(
@@ -1045,17 +1201,23 @@ def search(
     max_iter = int(params.max_iterations) or max(16, itopk // width)
     min_iter = int(min(params.min_iterations, max_iter))
     key = jax.random.key(params.seed)
+    b = width * index.graph_degree
     mode, rt = _resolve_traversal(params, index.nbr_codes is not None,
-                                  int(k), itopk)
+                                  int(k), itopk, size=index.size, b=b)
 
     # query tiling: one traversal's live set is ~per_q bytes/query (the
     # (b, b) dedup compares + gathered codes/vectors + merge passes);
     # un-tiled q=10k runs RESOURCE_EXHAUST a 16 GB chip. Tiles dispatch
     # back-to-back (no host sync between them), so the loop costs no
     # dispatch-amortization at large q.
-    b = width * index.graph_degree
     p = index.proj.shape[1] if index.proj is not None else index.dim
-    if mode == "compressed":
+    if mode == "fused":
+        # the kernel block-streams the traversal state, so only the exit
+        # re-rank gather and the per-query buffer/qp rows count against the
+        # workspace — tiles grow ~10× vs the unfused loop and the q-block
+        # grid keeps the MXU fed across the whole batch
+        per_q = 6 * rt * index.dim + 24 * itopk + 4 * p + 8 * width
+    elif mode == "compressed":
         per_q = b * b + 4 * b * p + 8 * (itopk + b) + 4 * itopk * index.dim
     else:
         per_q = b * b + 6 * b * index.dim + 8 * (itopk + b)
@@ -1063,9 +1225,18 @@ def search(
     if nq == 0:
         return (jnp.zeros((0, k), jnp.float32), jnp.zeros((0, k), jnp.int32))
     q_tile = int(max(256, min(nq, res.workspace_bytes // max(per_q, 1))))
+    if mode == "fused":
+        # parents ride the kernel's SMEM scalar-prefetch channel: bound the
+        # tile, then align it to the kernel's query-block grid (pad rows
+        # traverse as zero-queries and are sliced off below)
+        q_tile = min(q_tile, _FUSED_MAX_TILE)
     n_tiles = ceil_div(nq, q_tile)
     q_tile = ceil_div(nq, n_tiles)  # equalize; pad the tail tile below so
     # every dispatch shares ONE compiled shape
+    q_block = 0
+    if mode == "fused":
+        q_block = int(max(8, min(_CAGRA_QBLOCK, q_tile)))
+        q_tile = ceil_div(q_tile, q_block) * q_block
 
     if obs.enabled():
         obs.add("cagra.search.queries", nq)
@@ -1073,11 +1244,14 @@ def search(
         obs.add("cagra.search.iterations", nq * max_iter)
         obs.add(f"cagra.search.traversal.{mode}", 1)
 
+    from raft_tpu import resilience
     from raft_tpu.core.interruptible import check_interrupt
     from raft_tpu.resilience import faultpoint
 
     faultpoint("cagra.search")
     fb = filter.bits if filter is not None else None
+    n_rand = int(max(1, params.num_random_samplings))
+    interpret = jax.default_backend() != "tpu"
     outs = []
     for ti, s in enumerate(range(0, nq, q_tile)):
         check_interrupt()  # tiles dispatch back-to-back; this is the only
@@ -1086,21 +1260,53 @@ def search(
         if qs.shape[0] < q_tile:
             qs = jnp.pad(qs, ((0, q_tile - qs.shape[0]), (0, 0)))
         tkey = jax.random.fold_in(key, ti) if ti else key
+        if mode == "fused":
+            try:
+                od, oi, hops = _run_fused_tile(
+                    index, qs, tkey, fb, int(k), itopk, width, max_iter,
+                    min_iter, n_rand, rt, q_block, interpret)
+                # int(hops) blocks on the tile's last chunk, so the count
+                # is opt-in on top of telemetry: back-to-back QPS loops
+                # stay pipelined, and the bench samples hops only inside
+                # its per-batch latency pass (which forces every call
+                # anyway)
+                if obs.enabled() and _os.environ.get(
+                        "RAFT_TPU_CAGRA_COUNT_HOPS"):
+                    obs.add("cagra.search.hops", int(hops))
+                outs.append((od, oi))
+                continue
+            except Exception as e:
+                # classified fallback to the unfused compressed loop (the
+                # round-7 recovery contract: a failed kernel dispatch —
+                # injected or real, e.g. a Mosaic lowering gap on an
+                # unusual shape — degrades to the slower traversal instead
+                # of sinking the search)
+                kind = resilience.classify(e)
+                if kind == resilience.DEADLINE:
+                    # expired scopes / cooperative cancels are NEVER
+                    # retried (resilience contract): re-running the tile
+                    # on the slower loop only digs the hole deeper
+                    raise
+                resilience.record_event(
+                    "fused_fallback", site="cagra.search.hop", kind=kind,
+                    error=repr(e)[:200])
+                if obs.enabled():
+                    obs.add(f"cagra.search.fused_fallback.{kind}")
+                mode = "compressed"
         if mode == "compressed":
             outs.append(_search_impl_compressed(
                 index.dataset, index.graph, index.nbr_codes, index.proj,
                 index.code_scale, index.centroids, index.centroid_reps,
                 index.proj_energy, qs, tkey, fb, index.size,
-                int(k), itopk, width, max_iter, min_iter,
-                int(max(1, params.num_random_samplings)), rt,
+                int(k), itopk, width, max_iter, min_iter, n_rand, rt,
             ))
         else:
             outs.append(_search_impl(
                 index.dataset, index.graph, qs, tkey, fb, index.size,
-                int(k), itopk, width, max_iter, min_iter,
-                int(max(1, params.num_random_samplings)),
+                int(k), itopk, width, max_iter, min_iter, n_rand,
             ))
     if len(outs) == 1:
-        return outs[0]
+        # the fused q-block alignment can pad even a single tile
+        return outs[0][0][:nq], outs[0][1][:nq]
     return (jnp.concatenate([o[0] for o in outs], axis=0)[:nq],
             jnp.concatenate([o[1] for o in outs], axis=0)[:nq])

@@ -1,0 +1,247 @@
+"""Pallas TPU kernel for the fused CAGRA traversal hop.
+
+One iteration of the compressed best-first loop
+(neighbors/cagra._search_impl_compressed) costs five separate XLA ops —
+graph-row gather, neighbor-code gather, int8→bf16 einsum, compare-matrix
+dedup, itopk merge — each materializing its intermediate in HBM. At the
+1M bench shape (q=10k, w=4, deg=64, p=64) the (q, w·deg, p) code
+intermediate alone is ~160 MB written+read back per hop, and the two
+gathers are op-bound (~12 ns/row regardless of width, the round-5
+measurement). This kernel performs the whole hop in one ``pallas_call``:
+
+* **gather** — for a block of queries, the ``width`` parent graph rows and
+  their inlined ``(deg, p)`` int8 code records are DMA'd HBM→VMEM directly
+  (the Ragged Paged Attention pattern, PAPERS.md: page indices ride scalar
+  prefetch, the kernel issues per-record ``make_async_copy``); neither
+  array is ever materialized through an XLA gather;
+* **distance** — one int8→bf16 MXU contraction per block
+  (``‖c‖² − 2⟨qp, c⟩`` in projected code units, exactly the unfused
+  ``code_dists``), accumulated fp32, entirely in VMEM;
+* **dedup** — the exact compare-matrix branch of cagra's
+  ``_merge_candidates`` (candidate-vs-buffer and candidate-vs-earlier-
+  candidate); the (b, b) compare lives in VMEM so the slack+re-select
+  fallback the unfused loop needs for wide candidate sets never applies;
+* **merge** — the mantissa-packed iter select (ops/select_k.
+  ``iter_topk_min_packed`` — the kernel calls the very same function, so
+  tie/±inf/NaN semantics cannot drift) over ``[buffer ‖ candidates]``,
+  with id/visited payloads extracted by an exact fp32 one-hot contraction
+  (single-term sums — bit-identical to ``take_along_axis``, but it lowers
+  to an MXU matmul instead of a per-lane gather Mosaic can't do).
+
+Parent *selection* (best ``width`` unvisited buffer slots) stays a tiny
+jnp op in the caller's loop body: the DMA engine needs the parent ids as
+scalars, and scalar-prefetch is how a Pallas TPU kernel receives them.
+
+Layout/limits:
+
+* queries are processed in ``q_block`` rows per grid step; callers pad q
+  to a multiple (the padded rows ride with ids=-1/vis=1 and are sliced
+  off by the caller);
+* payload ids are extracted through an exact fp32 contraction, so dataset
+  ids must stay below 2**24 (asserted); the unfused loop has no such
+  bound and remains the route past 16.7M rows per shard;
+* ``interpret=True`` is the CPU/test route (pq_scan.py precedent); the
+  compiled path is TPU-only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.select_k import iter_topk_min_packed
+
+# exact-id bound of the fp32 one-hot payload extraction (24-bit mantissa)
+MAX_FUSED_ROWS = 1 << 24
+
+
+def _hop_kernel(parents_smem, parents_ref, qp_ref, bids_ref, bd_ref,
+                bvis_ref, graph_hbm, codes_hbm, oid_ref, od_ref, ovis_ref,
+                gr_s, code_s, gsem, csem, *, w, itopk):
+    qb = pl.program_id(0)
+    q_block, p = qp_ref.shape
+    deg = graph_hbm.shape[1]
+    b = w * deg
+    inf = jnp.float32(jnp.inf)
+    base = qb * q_block
+
+    # ---- gather: DMA the parent graph rows + code records HBM→VMEM -------
+    # all copies are issued before any is awaited so their latencies
+    # overlap; the two shared semaphores drain exactly the issued bytes
+    def issue(r, _):
+        pid = jnp.maximum(parents_smem[base + r // w, r % w], 0)
+        pltpu.make_async_copy(graph_hbm.at[pid], gr_s.at[r], gsem).start()
+        pltpu.make_async_copy(codes_hbm.at[pid], code_s.at[r], csem).start()
+        return 0
+
+    def drain(r, _):
+        pid = jnp.maximum(parents_smem[base + r // w, r % w], 0)
+        pltpu.make_async_copy(graph_hbm.at[pid], gr_s.at[r], gsem).wait()
+        pltpu.make_async_copy(codes_hbm.at[pid], code_s.at[r], csem).wait()
+        return 0
+
+    lax.fori_loop(0, q_block * w, issue, 0)
+    lax.fori_loop(0, q_block * w, drain, 0)
+
+    # ---- candidates: invalid parents (slot -1) poison their whole row ----
+    pvalid = parents_ref[...] >= 0  # (q_block, w)
+    gr = gr_s[...].reshape(q_block, b)
+    vmask = jnp.broadcast_to(
+        pvalid[:, :, None], (q_block, w, deg)).reshape(q_block, b)
+    nbrs = jnp.where(vmask & (gr >= 0), gr, -1)
+
+    # ---- distance: one int8→bf16 MXU contraction (code_dists analog) -----
+    cf = code_s[...].astype(jnp.bfloat16).reshape(q_block, b, p)
+    qpv = qp_ref[...].astype(jnp.bfloat16)
+    ip = jnp.einsum("qmp,qp->qm", cf, qpv,
+                    preferred_element_type=jnp.float32)
+    nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
+                     preferred_element_type=jnp.float32)
+    cd = jnp.where(nbrs >= 0, nrm - 2.0 * ip, inf)
+
+    # ---- dedup: the exact branch of _merge_candidates, VMEM-resident -----
+    bids = bids_ref[...]
+    dup_buf = jnp.any(nbrs[:, :, None] == bids[:, None, :], axis=2)
+    ii = lax.broadcasted_iota(jnp.int32, (b, b), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (b, b), 1)
+    dup_self = jnp.any(
+        (nbrs[:, :, None] == nbrs[:, None, :]) & (jj < ii)[None], axis=2)
+    cd = jnp.where(dup_buf | dup_self | (nbrs < 0), inf, cd)
+
+    # ---- merge: packed select over [buffer ‖ candidates] -----------------
+    allv = jnp.concatenate([bd_ref[...], cd], axis=1)
+    alli = jnp.concatenate([bids, nbrs], axis=1)
+    allvis = jnp.concatenate(
+        [bvis_ref[...], jnp.zeros((q_block, b), jnp.float32)], axis=1)
+    nv, sel = iter_topk_min_packed(allv, itopk)
+    cat_w = itopk + b
+    cols = lax.broadcasted_iota(jnp.int32, (q_block, 1, cat_w), 2)
+    oh = (sel[:, :, None] == cols).astype(jnp.float32)
+    # single-term fp32 sums: exact for ids < 2**24 and for 0/1 vis flags —
+    # but ONLY at highest precision: the TPU MXU's default fp32 matmul is
+    # single-pass bf16 (~8 mantissa bits, see ops/distance.py), which would
+    # round any id > 256 before the multiply
+    ni = jnp.einsum("qkc,qc->qk", oh, alli.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                    precision=lax.Precision.HIGHEST).astype(jnp.int32)
+    nvis = jnp.einsum("qkc,qc->qk", oh, allvis,
+                      preferred_element_type=jnp.float32,
+                      precision=lax.Precision.HIGHEST)
+    oid_ref[...] = jnp.where(jnp.isinf(nv), -1, ni)
+    od_ref[...] = nv
+    ovis_ref[...] = nvis
+
+
+@functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
+def fused_hop(buf_ids, buf_d, buf_vis, parents, qp, graph, nbr_codes,
+              q_block: int = 32, interpret: bool = False,
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused traversal hop for every query.
+
+    buf_ids/buf_d/buf_vis: (q, itopk) int32/fp32/fp32 — the candidate
+      buffer (vis is 1.0 at visited slots; parents must already be marked).
+    parents: (q, w) int32 — parent ids to expand, -1 = no parent (its
+      candidates are masked, mirroring the unfused ``parent_ok`` path).
+    qp: (q, p) fp32 — queries in code units ((q @ proj) / code_scale).
+    graph: (n, deg) int32; nbr_codes: (n, deg, p) int8 — HBM-resident.
+
+    Returns the merged (ids, distances, vis) buffer. q must be a multiple
+    of ``q_block`` (callers pad; see neighbors/cagra's fused driver).
+    """
+    q, itopk = buf_ids.shape
+    w = parents.shape[1]
+    n, deg = graph.shape
+    p = qp.shape[1]
+    assert q % q_block == 0, (q, q_block)
+    assert nbr_codes.shape == (n, deg, p), (nbr_codes.shape, (n, deg, p))
+    assert n <= MAX_FUSED_ROWS, \
+        f"fused hop id extraction is exact below {MAX_FUSED_ROWS} rows"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(q // q_block,),
+        in_specs=[
+            pl.BlockSpec((q_block, w), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, p), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_block, itopk), lambda qb, P: (qb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q_block * w, deg), jnp.int32),
+            pltpu.VMEM((q_block * w, deg, p), jnp.int8),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_hop_kernel, w=w, itopk=itopk),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((q, itopk), jnp.int32),
+            jax.ShapeDtypeStruct((q, itopk), jnp.float32),
+            jax.ShapeDtypeStruct((q, itopk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(parents, parents, qp, buf_ids, buf_d, buf_vis, graph, nbr_codes)
+
+
+def fused_hop_reference(buf_ids, buf_d, buf_vis, parents, qp, graph,
+                        nbr_codes) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pure-jnp oracle with the exact fused_hop contract (kernel tests):
+    the unfused gather/einsum/dedup/merge ops of cagra's compressed loop
+    body, candidate-side duplicates masked exactly pre-select."""
+    q, itopk = buf_ids.shape
+    w = parents.shape[1]
+    deg = graph.shape[1]
+    p = qp.shape[1]
+    b = w * deg
+    inf = jnp.float32(jnp.inf)
+
+    pid_c = jnp.maximum(parents, 0)
+    gr = graph[pid_c]                       # (q, w, deg)
+    codes = nbr_codes[pid_c]                # (q, w, deg, p)
+    nbrs = jnp.where((parents >= 0)[:, :, None] & (gr >= 0), gr, -1)
+    nbrs = nbrs.reshape(q, b)
+    cf = codes.reshape(q, b, p).astype(jnp.bfloat16)
+    ip = jnp.einsum("qmp,qp->qm", cf, qp.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32)
+    nrm = jnp.einsum("qmp,qmp->qm", cf, cf,
+                     preferred_element_type=jnp.float32)
+    cd = jnp.where(nbrs >= 0, nrm - 2.0 * ip, inf)
+
+    dup_buf = jnp.any(nbrs[:, :, None] == buf_ids[:, None, :], axis=2)
+    tri = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)
+    dup_self = jnp.any(
+        (nbrs[:, :, None] == nbrs[:, None, :]) & tri[None], axis=2)
+    cd = jnp.where(dup_buf | dup_self | (nbrs < 0), inf, cd)
+
+    allv = jnp.concatenate([buf_d, cd], axis=1)
+    alli = jnp.concatenate([buf_ids, nbrs], axis=1)
+    allvis = jnp.concatenate([buf_vis, jnp.zeros((q, b), jnp.float32)],
+                             axis=1)
+    nv, sel = iter_topk_min_packed(allv, itopk)
+    ni = jnp.take_along_axis(alli, sel, axis=1)
+    nvis = jnp.take_along_axis(allvis, sel, axis=1)
+    return jnp.where(jnp.isinf(nv), -1, ni), nv, nvis

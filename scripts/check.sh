@@ -55,6 +55,24 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 
 echo
+echo "== bench tiny smoke (fused cagra traversal kernel) =="
+RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
+RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1
+import json, subprocess, sys
+proc = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                      text=True, timeout=600)
+assert proc.returncode == 0, proc.stderr[-2000:]
+line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+cag = json.loads(line)["extras"]["cagra"]
+# hops_per_batch only populates from SUCCESSFUL fused tiles — a silent
+# kernel-failure fallback keeps the rung label "fused" but records no hops
+assert cag.get("traversal") == "fused", cag
+assert cag.get("hops_per_batch", 0) > 0, cag
+print("tiny fused smoke: OK (qps=%s recall=%s hops/batch=%s)"
+      % (cag["qps"], cag["recall"], cag["hops_per_batch"]))
+EOF
+
+echo
 echo "== tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -257,6 +257,133 @@ class TestCagraCompressed:
         assert _recall(np.asarray(v1), np.asarray(gt)) >= 0.9
 
 
+class TestCagraFused:
+    """Round-6 fused Pallas traversal (one-kernel hop, ops/cagra_hop.py):
+    interpret-mode parity vs the unfused compressed loop, the 10k-point
+    recall gate, and mode resolution."""
+
+    @pytest.fixture(scope="class")
+    def cidx(self, data):
+        X, _ = data
+        return cagra.build(X, cagra.CagraParams(
+            graph_degree=16, intermediate_graph_degree=32, compress="on"))
+
+    def test_fused_parity_with_unfused_reference(self, data, cidx):
+        """The ISSUE acceptance criterion: the fused hop — interpret=True
+        on CPU — is bit-identical (ids) / allclose (distances) to the
+        unfused _search_impl_compressed reference. q is a q-block multiple
+        so both paths draw identical random seeds."""
+        X, Q = data
+        k = 10
+        for itopk, w in ((64, 4), (32, 1)):
+            sp_f = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
+                                           traversal="fused")
+            sp_c = cagra.CagraSearchParams(itopk_size=itopk, search_width=w,
+                                           traversal="compressed")
+            vf, i_f = cagra.search(cidx, Q, k, sp_f)
+            vc, i_c = cagra.search(cidx, Q, k, sp_c)
+            np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_c))
+            np.testing.assert_allclose(np.asarray(vf), np.asarray(vc),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_fused_parity_with_filter(self, data, cidx):
+        X, Q = data
+        n = X.shape[0]
+        keep = np.zeros(n, bool)
+        keep[: n // 2] = True
+        filt = Bitset.from_mask(keep)
+        sp_f = cagra.CagraSearchParams(itopk_size=64, traversal="fused")
+        sp_c = cagra.CagraSearchParams(itopk_size=64, traversal="compressed")
+        _, i_f = cagra.search(cidx, Q, 5, sp_f, filter=filt)
+        _, i_c = cagra.search(cidx, Q, 5, sp_c, filter=filt)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_c))
+        got = np.asarray(i_f)
+        assert ((got < n // 2) | (got == -1)).all()
+
+    def test_fused_padded_query_batch(self, data, cidx):
+        """q not a multiple of the kernel's query block: padded rows must
+        be sliced off and the real rows keep fused-vs-itself determinism."""
+        X, Q = data
+        sp = cagra.CagraSearchParams(itopk_size=32, traversal="fused")
+        v1, i1 = cagra.search(cidx, Q[:41], 5, sp)
+        v2, i2 = cagra.search(cidx, Q[:41], 5, sp)
+        assert i1.shape == (41, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_fused_recall_gate_10k(self):
+        """Recall gate on the synthetic 10k dataset (ISSUE 6): the fused
+        traversal holds >= 0.95 recall vs the exact oracle."""
+        from raft_tpu.bench.datasets import sift_like
+
+        data_u8, queries_u8 = sift_like(10_000, 32, 64, seed=3)
+        X = data_u8.astype(np.float32)
+        Q = queries_u8.astype(np.float32)
+        idx = cagra.build(X, cagra.CagraParams(
+            graph_degree=32, intermediate_graph_degree=64, compress="on"))
+        _, gt = brute_force.knn(Q, X, 10)
+        _, vi = cagra.search(idx, Q, 10, cagra.CagraSearchParams(
+            itopk_size=64, search_width=4, traversal="fused"))
+        rec = _recall(np.asarray(vi), np.asarray(gt))
+        assert rec >= 0.95, rec
+
+    def test_fused_requires_payload(self, data):
+        X, Q = data
+        plain = cagra.build(X, cagra.CagraParams(
+            graph_degree=16, intermediate_graph_degree=32, compress="off"))
+        with pytest.raises(ValueError, match="compression payload"):
+            cagra.search(plain, Q, 5,
+                         cagra.CagraSearchParams(traversal="fused"))
+        with pytest.raises(ValueError, match="unknown traversal"):
+            cagra.CagraSearchParams(traversal="pallas")
+
+    def test_resolve_traversal_modes(self):
+        """auto → fused only on a TPU backend with the payload present and
+        the index under the kernel's exact-id bound; explicit fused
+        downgrades to compressed when the caller disallows the kernel
+        (distributed shard bodies)."""
+        import jax as _jax
+
+        sp = cagra.CagraSearchParams()
+        mode, _ = cagra._resolve_traversal(sp, True, 5, 64, size=1000, b=64)
+        expect = "fused" if _jax.default_backend() == "tpu" else "compressed"
+        assert mode == expect
+        mode, _ = cagra._resolve_traversal(sp, False, 5, 64, size=1000, b=64)
+        assert mode == "exact"
+        sp_f = cagra.CagraSearchParams(traversal="fused")
+        mode, rt = cagra._resolve_traversal(sp_f, True, 5, 64, size=1000,
+                                            b=64)
+        assert mode == "fused" and rt == 64
+        mode, _ = cagra._resolve_traversal(sp_f, True, 5, 64, size=1000,
+                                           allow_fused=False, b=64)
+        assert mode == "compressed"
+        mode, _ = cagra._resolve_traversal(sp_f, True, 5, 64,
+                                           size=cagra.MAX_FUSED_ROWS + 1,
+                                           b=64)
+        assert mode == "compressed"
+        # wide candidate sets (b past the exact-dedup limit) downgrade:
+        # the unfused merge would switch to slack+re-select dedup there,
+        # so fused could not stay bit-identical to it
+        mode, _ = cagra._resolve_traversal(sp_f, True, 5, 64, size=1000,
+                                           b=cagra._CAGRA_DEDUP_LIMIT + 1)
+        assert mode == "compressed"
+
+    def test_fused_hops_counter(self, data, cidx, monkeypatch):
+        from raft_tpu import obs
+
+        X, Q = data
+        obs.enable()
+        obs.reset()
+        # hop counting is opt-in on top of telemetry (the fetch blocks on
+        # the tile's last chunk; back-to-back QPS loops must stay async)
+        monkeypatch.setenv("RAFT_TPU_CAGRA_COUNT_HOPS", "1")
+        sp = cagra.CagraSearchParams(itopk_size=32, traversal="fused")
+        cagra.search(cidx, Q, 5, sp)
+        c = obs.snapshot()["counters"]
+        obs.disable()
+        assert c.get("cagra.search.traversal.fused") == 1
+        assert c.get("cagra.search.hops", 0) >= 1
+
+
 class TestRefineKnnGraph:
     """Device-resident NN-descent sweep (cagra.refine_knn_graph)."""
 

@@ -1,0 +1,78 @@
+"""Fused CAGRA traversal-hop kernel (ops/cagra_hop.py) — interpret-mode
+parity vs the pure-jnp oracle, including the adversarial cases the dedup
+and masking logic exists for (duplicate candidates, invalid parents,
+-1 graph edges, +inf buffer slots)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.ops.cagra_hop import MAX_FUSED_ROWS, fused_hop, fused_hop_reference
+
+
+def _case(rng, n, deg, p, q, w, itopk, frac_invalid=0.0, dup_heavy=False):
+    """Random mid-traversal state: a partially filled, ascending buffer and
+    a parent set pointing into a graph with some -1 edges."""
+    lo, hi = (0, max(2, n // 8)) if dup_heavy else (0, n)
+    graph = rng.integers(lo, hi, (n, deg)).astype(np.int32)
+    graph[rng.random((n, deg)) < 0.1] = -1  # ragged rows
+    codes = rng.integers(-127, 128, (n, deg, p)).astype(np.int8)
+    qp = rng.normal(size=(q, p)).astype(np.float32)
+    buf_ids = rng.integers(0, n, (q, itopk)).astype(np.int32)
+    buf_d = np.sort(rng.normal(size=(q, itopk)).astype(np.float32) * 10, axis=1)
+    empty = rng.random((q, itopk)) < 0.15  # +inf tail-style holes
+    buf_ids[empty] = -1
+    buf_d[empty] = np.inf
+    buf_vis = (rng.random((q, itopk)) < 0.5).astype(np.float32)
+    parents = rng.integers(0, n, (q, w)).astype(np.int32)
+    if frac_invalid:
+        parents[rng.random((q, w)) < frac_invalid] = -1
+    return tuple(jnp.asarray(a) for a in
+                 (buf_ids, buf_d, buf_vis, parents, qp, graph, codes))
+
+
+@pytest.mark.parametrize("dup_heavy", [False, True])
+@pytest.mark.parametrize("q_block", [8, 16])
+def test_kernel_matches_oracle(dup_heavy, q_block):
+    rng = np.random.default_rng(3 if dup_heavy else 4)
+    args = _case(rng, n=300, deg=8, p=16, q=32, w=3, itopk=24,
+                 frac_invalid=0.25, dup_heavy=dup_heavy)
+    ki, kd, kv = fused_hop(*args, q_block=q_block, interpret=True)
+    ri, rd, rv = fused_hop_reference(*args)
+    np.testing.assert_array_equal(np.asarray(ki), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(kd), np.asarray(rd))
+    np.testing.assert_array_equal(np.asarray(kv), np.asarray(rv))
+
+
+def test_all_parents_invalid_is_noop():
+    """A hop past a closed frontier (every parent slot -1) must return the
+    buffer unchanged — the chunked driver relies on this to over-dispatch
+    safely after termination."""
+    rng = np.random.default_rng(5)
+    buf_ids, buf_d, buf_vis, parents, qp, graph, codes = _case(
+        rng, n=200, deg=4, p=8, q=16, w=2, itopk=16)
+    parents = jnp.full_like(parents, -1)
+    ki, kd, kv = fused_hop(buf_ids, buf_d, buf_vis, parents, qp, graph,
+                           codes, q_block=8, interpret=True)
+    ri, rd, rv = fused_hop_reference(buf_ids, buf_d, buf_vis, parents, qp,
+                                     graph, codes)
+    np.testing.assert_array_equal(np.asarray(ki), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(kd), np.asarray(rd))
+    # ids survive, ascending order preserved
+    kd_np = np.asarray(kd)
+    assert (np.diff(np.where(np.isinf(kd_np), 1e30, kd_np), axis=1)
+            >= -1e-6).all()
+
+
+def test_shape_validation():
+    rng = np.random.default_rng(6)
+    args = _case(rng, n=100, deg=4, p=8, q=12, w=2, itopk=8)
+    with pytest.raises(AssertionError):
+        fused_hop(*args, q_block=8, interpret=True)  # 12 % 8 != 0
+
+
+def test_max_rows_bound_documented():
+    # the fp32 one-hot id extraction is exact below 2**24 rows; the cagra
+    # resolver must keep fused off larger indexes
+    assert MAX_FUSED_ROWS == 1 << 24

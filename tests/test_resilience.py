@@ -278,6 +278,53 @@ def test_brute_force_oom_recovers_degraded(rng):
                for e in resilience.recent_events())
 
 
+def test_cagra_fused_hop_fault_falls_back_unfused(rng):
+    """Round-6 recovery gate (ISSUE 6): RAFT_TPU_FAULTS arms an OOM at the
+    fused traversal's host dispatch site (cagra.search.hop); the search
+    classifies it, records a fused_fallback event, and completes on the
+    unfused compressed loop with identical results."""
+    from raft_tpu.neighbors import cagra
+
+    X, _ = _dataset(rng, n=600, dim=16, q=8)
+    Q = np.asarray(rng.normal(size=(32, 16)), np.float32)  # q-block multiple
+    idx = cagra.build(X, cagra.CagraParams(
+        graph_degree=8, intermediate_graph_degree=16, compress="on"))
+    sp_f = cagra.CagraSearchParams(itopk_size=32, traversal="fused")
+    sp_c = cagra.CagraSearchParams(itopk_size=32, traversal="compressed")
+    gt_v, gt_i = cagra.search(idx, Q, 5, sp_c)
+    resilience.arm_faults("cagra.search.hop=oom:1")
+    obs.enable()
+    v, i = cagra.search(idx, Q, 5, sp_f)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(gt_i))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(gt_v),
+                               rtol=1e-6, atol=1e-6)
+    c = obs.snapshot()["counters"]
+    assert c.get("cagra.search.fused_fallback.oom", 0) >= 1
+    ev = [e for e in resilience.recent_events()
+          if e["event"] == "fused_fallback"]
+    assert ev and ev[-1]["site"] == "cagra.search.hop"
+    assert ev[-1]["kind"] == resilience.OOM
+
+
+def test_cagra_fused_hop_deadline_reraises(rng):
+    """DEADLINE-class failures at the fused hop must NOT fall back to the
+    slower unfused loop (expired scopes are never retried — the resilience
+    contract); they re-raise so cancellation surfaces."""
+    from raft_tpu.neighbors import cagra
+
+    X, _ = _dataset(rng, n=600, dim=16, q=8)
+    Q = np.asarray(rng.normal(size=(32, 16)), np.float32)
+    idx = cagra.build(X, cagra.CagraParams(
+        graph_degree=8, intermediate_graph_degree=16, compress="on"))
+    resilience.arm_faults("cagra.search.hop=hang:1:5")
+    with pytest.raises(resilience.DeadlineExceeded):
+        with resilience.Deadline(0.3, label="fused-hop-test"):
+            cagra.search(idx, Q, 5, cagra.CagraSearchParams(
+                itopk_size=32, traversal="fused"))
+    assert not [e for e in resilience.recent_events()
+                if e["event"] == "fused_fallback"]
+
+
 def test_search_out_of_core_oom_recovers(rng):
     X, Q = _dataset(rng)
     gt_v, gt_i = brute_force.knn(Q, X, 5)
