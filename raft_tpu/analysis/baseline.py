@@ -44,7 +44,8 @@ class Baseline:
         return cls([e for e in entries if isinstance(e, dict)])
 
     def save(self, path) -> None:
-        path = Path(path)
+        from raft_tpu.core.fsio import atomic_write
+
         entries = sorted(
             self.entries,
             key=lambda e: (e.get("path", ""), e.get("rule", ""),
@@ -57,7 +58,10 @@ class Baseline:
                     " every entry needs a one-line justification",
             "entries": entries,
         }
-        path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+        # atomic (ISSUE 7): a baseline truncated by a mid-write kill would
+        # turn every grandfathered finding loud on the next tier-1 run
+        with atomic_write(Path(path), "w") as f:
+            f.write(json.dumps(payload, indent=2) + "\n")
 
     # -- matching -----------------------------------------------------------
 

@@ -1,14 +1,17 @@
-"""bench-io: bench results writes must go through ``bench/progress.py``.
+"""bench-io: bench results writes must go through a crash-safe channel.
 
 Round 5's lesson (BENCH_r05.json rc=124, no output): any bench result that
 lives only in process memory — or in a file written without flush+fsync —
 is lost the moment the watchdog kills the run. ``bench/progress.py`` is the
-crash-safe channel (append, flush, fsync per record, salvageable by
-``scripts/bench_salvage.py``). Direct write-mode ``open()`` / ``np.save*`` /
-``Path.write_text`` in bench code bypasses that guarantee, so it gets
-flagged; ``progress.py`` itself and read-mode opens are exempt. Legitimate
-non-results writes (dataset caches, user-pointed ``--output``) are
-baselined with justifications rather than silently allowed.
+crash-safe channel for results (append, flush, fsync per record,
+salvageable by ``scripts/bench_salvage.py``) and
+``core/fsio.atomic_write`` for whole-file artifacts (ISSUE 7). Direct
+write-mode ``open()`` / ``np.save*`` / ``.tofile()`` / ``Path.write_text``
+in bench code bypasses both guarantees, so it gets flagged; writes INSIDE
+a ``with atomic_write(...)`` block, ``progress.py`` itself and read-mode
+opens are exempt. Legitimate non-results writes (dataset caches,
+user-pointed ``--output``) are baselined with justifications rather than
+silently allowed.
 """
 
 from __future__ import annotations
@@ -22,6 +25,47 @@ _WRITE_MODES = set("wax")
 _NP_WRITERS = {"numpy.save", "numpy.savez", "numpy.savez_compressed",
                "numpy.savetxt"}
 _PATH_WRITERS = {"write_text", "write_bytes"}
+_ARRAY_WRITERS = {"tofile"}
+#: context managers that ARE the crash-safe channel — everything written
+#: inside their ``with`` block is sanctioned
+_SAFE_CTX = {"atomic_write"}
+
+
+def _sanctioned_nodes(tree) -> set:
+    """ids of Call nodes that write THROUGH an atomic stream: inside a
+    ``with atomic_write(...) as f`` block, only calls that take ``f`` as
+    receiver or argument (``f.write(...)``, ``arr.tofile(f)``,
+    ``np.save(f, ...)``) are sanctioned — an unrelated ``open(b, "wb")``
+    nested in the same block stays flagged."""
+    out: set = set()
+    for w in ast.walk(tree):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        aliases = set()
+        for item in w.items:
+            c = item.context_expr
+            if isinstance(c, ast.Call) and isinstance(
+                    c.func, (ast.Name, ast.Attribute)):
+                name = (c.func.id if isinstance(c.func, ast.Name)
+                        else c.func.attr)
+                if name in _SAFE_CTX and isinstance(
+                        item.optional_vars, ast.Name):
+                    aliases.add(item.optional_vars.id)
+        if not aliases:
+            continue
+        for node in ast.walk(w):
+            if not isinstance(node, ast.Call):
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            uses = any(isinstance(a, ast.Name) and a.id in aliases
+                       for a in args)
+            if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name) and \
+                    node.func.value.id in aliases:
+                uses = True
+            if uses:
+                out.add(id(node))
+    return out
 
 
 def _open_mode(node: ast.Call) -> str:
@@ -47,8 +91,9 @@ class BenchIoRule(Rule):
             "bench" in ctx.rel.split("/")[:-1])
         if not in_scope or ctx.rel.endswith("/progress.py"):
             return
+        sanctioned = _sanctioned_nodes(ctx.tree)
         for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
+            if not isinstance(node, ast.Call) or id(node) in sanctioned:
                 continue
             label = ""
             if isinstance(node.func, ast.Name) and node.func.id == "open":
@@ -57,11 +102,11 @@ class BenchIoRule(Rule):
             elif resolve_call(ctx, node.func) in _NP_WRITERS:
                 label = resolve_call(ctx, node.func)
             elif isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _PATH_WRITERS:
+                    node.func.attr in _PATH_WRITERS | _ARRAY_WRITERS:
                 label = f".{node.func.attr}()"
             if label:
                 yield self.finding(
                     ctx, node,
                     f"direct {label} in bench code — route results through "
-                    f"bench/progress.py (fsync'd, salvageable) so a killed "
-                    f"run keeps its checkpoints")
+                    f"bench/progress.py or core/fsio.atomic_write (fsync'd, "
+                    f"crash-safe) so a killed run keeps its artifacts")

@@ -11,6 +11,12 @@ and the ``raft_tpu/distributed/`` paths. A broad handler there must call
 ``raise``; anything else is a finding. Deliberate holdouts (the parent
 orchestrator, which must stay off the raft_tpu import lock) are baselined
 with a justification via ``scripts/analysis_baseline.py``.
+
+ISSUE 7 widened the scope to the other incident homes: the resilience
+package (the degraded-mode dispatch gate lives there) and the crash-safe
+write path (``core/serialize.py`` / ``core/fsio.py``) — a broad handler
+that eats a snapshot-corruption error would erase exactly the failure
+class the v2 container exists to classify.
 """
 
 from __future__ import annotations
@@ -27,7 +33,11 @@ _CLASSIFY_NAMES = {"classify", "section_error"}
 
 def _in_scope(rel: str) -> bool:
     parts = rel.split("/")
-    return parts[-1] == "bench.py" or "distributed" in parts[:-1]
+    dirs = parts[:-1]
+    if parts[-1] == "bench.py" or "distributed" in dirs or \
+            "resilience" in dirs:
+        return True
+    return "core" in dirs and parts[-1] in ("serialize.py", "fsio.py")
 
 
 def _handles(handler: ast.ExceptHandler, ctx) -> bool:

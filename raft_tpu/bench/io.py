@@ -73,7 +73,11 @@ def read_vecs(path, count: Optional[int] = None) -> np.ndarray:
 
 
 def write_vecs(path, arr: np.ndarray) -> None:
-    """Write (n, dim) → TEXMEX format (dtype chosen by extension)."""
+    """Write (n, dim) → TEXMEX format (dtype chosen by extension).
+    Atomic (core/fsio): a killed writer leaves no truncated dataset that a
+    later bench run would trip over as a cryptic size-mismatch."""
+    from raft_tpu.core.fsio import atomic_write
+
     ext = os.path.splitext(str(path))[1]
     dtype, _ = _VEC_PAYLOAD[ext]
     arr = np.ascontiguousarray(arr, dtype)
@@ -81,7 +85,8 @@ def write_vecs(path, arr: np.ndarray) -> None:
     hdr = np.full((n, 1), dim, np.int32)
     out = np.concatenate([hdr.view(np.uint8).reshape(n, 4),
                           arr.view(np.uint8).reshape(n, -1)], axis=1)
-    out.tofile(path)
+    with atomic_write(path) as f:
+        out.tofile(f)
 
 
 def read_bin(path, count: Optional[int] = None) -> np.ndarray:
@@ -100,9 +105,12 @@ def read_bin(path, count: Optional[int] = None) -> np.ndarray:
 
 
 def write_bin(path, arr: np.ndarray) -> None:
+    """Atomic big-ann bin writer (same contract as :func:`write_vecs`)."""
+    from raft_tpu.core.fsio import atomic_write
+
     ext = os.path.splitext(str(path))[1]
     arr = np.ascontiguousarray(arr, _BIN_PAYLOAD[ext])
-    with open(path, "wb") as f:
+    with atomic_write(path) as f:
         np.array(arr.shape, np.int32).tofile(f)
         arr.tofile(f)
 

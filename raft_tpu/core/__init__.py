@@ -10,7 +10,9 @@ row-major layout is the array vocabulary; helpers here cover what jnp doesn't
 """
 
 from raft_tpu.core.resources import Resources, current_resources, use_resources
+from raft_tpu.core.fsio import atomic_write, atomic_replace
 from raft_tpu.core.serialize import (
+    SnapshotCorruptError,
     serialize_array,
     deserialize_array,
     save_arrays,
@@ -22,6 +24,9 @@ from raft_tpu.core.interruptible import InterruptedException, check_interrupt, c
 
 __all__ = [
     "Resources",
+    "SnapshotCorruptError",
+    "atomic_replace",
+    "atomic_write",
     "current_resources",
     "use_resources",
     "serialize_array",

@@ -1,0 +1,101 @@
+"""Crash-safe file writes: the one atomic-write helper every artifact uses.
+
+Round-5's wedge proved the failure mode (a killed process leaves torn
+files); ISSUE 7 closes the remaining exposure: an index checkpoint written
+with a plain ``open(path, "wb")`` that dies mid-write leaves a truncated
+container whose reload fails with a cryptic ``np.load`` error — the same
+unclassified-failure class. :func:`atomic_write` is the shared contract:
+
+    tmp file in the same directory  →  write  →  flush + fsync  →
+    ``os.replace`` onto the target
+
+so a crash at ANY point leaves either the previous file or the complete
+new one, never a torn one. The bench heartbeat channel
+(``bench/progress.py``) carries its own copy of this pattern by design —
+it must stay importable by file path in jax-free parents and cannot take
+the package import lock; this module is the package-side home for
+everything else (index saves, baselines, dataset writers, hnsw export).
+
+Stdlib-only on purpose: ``raft_tpu.analysis`` (no jax) routes its baseline
+store through here too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import tempfile
+
+# per-process uniquifier for atomic_replace tmp names (mkstemp covers
+# atomic_write); pid + counter keeps concurrent processes AND threads from
+# ever sharing a tmp path — two writers interleaving into one tmp file is
+# exactly the torn-write class this module exists to prevent
+_COUNTER = itertools.count()
+
+
+def _prepare(path) -> str:
+    path = os.fspath(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    return path
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode: str = "wb"):
+    """Context manager yielding a stream whose contents replace ``path``
+    atomically on clean exit (unique tmp + flush + fsync + ``os.replace``).
+    On any exception the tmp file is removed and ``path`` is untouched.
+
+    The tmp file lives next to the target (same directory, unique
+    ``.tmp``-suffixed name) so the final rename never crosses a filesystem
+    boundary and concurrent writers to the same target never share a tmp:
+    last ``os.replace`` wins with each result complete, never torn."""
+    path = _prepare(path)
+    if "r" in mode or "+" in mode or "a" in mode:
+        raise ValueError(f"atomic_write is write-only, got mode {mode!r}")
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        # mkstemp creates 0600; match open()'s umask-honoring default so a
+        # snapshot stays readable to whoever could read the old file
+        os.chmod(tmp, 0o666 & ~_umask())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _umask() -> int:
+    """The process umask (read-modify-write: stdlib offers no getter)."""
+    cur = os.umask(0o022)
+    os.umask(cur)
+    return cur
+
+
+def atomic_replace(path, producer) -> None:
+    """Call ``producer(tmp_path)`` to materialize the new contents at a
+    unique tmp path, then atomically rename onto ``path`` — the variant for
+    writers that insist on owning the file themselves (the native hnsw
+    writer takes a path, not a stream). ``producer`` must have
+    closed/synced the file before returning."""
+    path = _prepare(path)
+    tmp = f"{path}.{os.getpid()}.{next(_COUNTER)}.tmp"
+    try:
+        producer(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise

@@ -11,5 +11,8 @@ per-device indexes, the raft-dask one-model-per-worker architecture).
 """
 
 from raft_tpu.distributed import brute_force, cagra, ivf_flat, ivf_pq, kmeans
+from raft_tpu.distributed import snapshot
+from raft_tpu.distributed._sharding import SearchResult, ShardReport, probe_shards
 
-__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "kmeans"]
+__all__ = ["SearchResult", "ShardReport", "brute_force", "cagra", "ivf_flat",
+           "ivf_pq", "kmeans", "probe_shards", "snapshot"]

@@ -1,13 +1,17 @@
 """Shared shard-preparation helpers for the distributed IVF indexes.
 
 One implementation of the row-sharding, SPMD assign+spill phase, padded
-list sizing, local dense fallback scan, and cross-shard merge — ivf_flat
-and ivf_pq compose these (round-3 review: the two modules had begun to
-drift apart with four copies of this logic)."""
+list sizing, local dense fallback scan, cross-shard merge, and the
+degraded-mode dispatch gate (:func:`probe_shards` + :class:`SearchResult`)
+— ivf_flat and ivf_pq compose these (round-3 review: the two modules had
+begun to drift apart with four copies of this logic); brute_force and
+cagra share the availability pieces."""
 
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -15,11 +19,143 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu import obs
+from raft_tpu import obs, resilience
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.compat import shard_map
+from raft_tpu.core.interruptible import InterruptedException
 from raft_tpu.neighbors import _packing
 from raft_tpu.ops.select_k import select_k
+from raft_tpu.resilience.retry import record_event
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode dispatch: shard probe, coverage accounting, result carrier
+# ---------------------------------------------------------------------------
+
+
+class SearchResult(tuple):
+    """A ``(distances, indices)`` pair with availability metadata riding
+    along. Unpacks exactly like the plain 2-tuple every caller already
+    writes (``vals, ids = search(...)``); degraded-mode consumers read the
+    attributes:
+
+    * ``coverage`` — fraction of index rows held by the shards whose
+      candidates entered the top-k merge (1.0 on the healthy path).
+    * ``degraded`` — True when any shard's candidates were dropped.
+    * ``lost_shards`` — the shard ranks dropped from this dispatch.
+    """
+
+    def __new__(cls, distances, indices, coverage: float = 1.0,
+                degraded: bool = False, lost_shards: Tuple[int, ...] = ()):
+        self = tuple.__new__(cls, (distances, indices))
+        self.coverage = float(coverage)
+        self.degraded = bool(degraded)
+        self.lost_shards = tuple(int(s) for s in lost_shards)
+        return self
+
+    @property
+    def distances(self):
+        return self[0]
+
+    @property
+    def indices(self):
+        return self[1]
+
+
+@dataclass(frozen=True)
+class ShardReport:
+    """One dispatch's availability verdict (:func:`probe_shards`)."""
+
+    ok: np.ndarray            # (world,) bool — shards serving this dispatch
+    coverage: float           # fraction of rows the serving shards hold
+    degraded: bool
+    dropped: Tuple[int, ...]  # shard ranks excluded from this dispatch
+
+
+def shard_rows_held(world: int, n_total: int):
+    """Real (unpadded) rows per shard under the one row-partitioning
+    convention every distributed index uses: ``rows_per = ceil(n/world)``
+    contiguous rows per shard, short tail on the last."""
+    rows_per = -(-int(n_total) // int(world))
+    return [max(0, min(rows_per, int(n_total) - r * rows_per))
+            for r in range(int(world))]
+
+
+def probe_shards(algo: str, world: int, n_total: int,
+                 health: Optional[resilience.ShardHealth] = None
+                 ) -> ShardReport:
+    """Host-side per-shard dispatch gate — the availability layer's entry.
+
+    For every shard not already LOST, fires the
+    ``distributed.<algo>.search.shard`` faultpoint (the injectable stand-in
+    for a dead host's dispatch error) and folds the verdict into the
+    health registry: a failing shard is dropped from THIS dispatch (its
+    candidates never enter the merge) and marked SUSPECT/LOST for the next.
+
+    An active hard :class:`~raft_tpu.resilience.Deadline` slices its
+    remaining budget evenly across the shards still to be probed — a shard
+    that burns its slice (hang-class failure) costs its slice, not the
+    query: it is dropped and the remainder re-sliced over the survivors.
+    An expired OUTER budget still propagates.
+
+    Raises :class:`~raft_tpu.resilience.ShardQuorumError` when the
+    surviving coverage falls below the registry's minimum-coverage quorum.
+    """
+    health = health or resilience.shard_health()
+    site = f"distributed.{algo}.search.shard"
+    world = int(world)
+    rows = shard_rows_held(world, n_total)
+    dl = resilience.active_deadline()
+    ok = []
+    probe_attrs = ({"shard": world} if obs.enabled() else None)
+    with obs.record_span("distributed::shard_probe", attrs=probe_attrs):
+        for r in range(world):
+            if health.state(r) == resilience.LOST:
+                ok.append(False)
+                continue
+            try:
+                if dl is not None and dl.hard:
+                    left = sum(1 for rr in range(r, world)
+                               if health.state(rr) != resilience.LOST)
+                    slice_s = max(dl.remaining(), 0.0) / max(1, left)
+                    with resilience.Deadline(slice_s, hard=True,
+                                             label=f"{site}[{r}]"):
+                        resilience.faultpoint(site)
+                else:
+                    resilience.faultpoint(site)
+                health.report_success(r)
+                ok.append(True)
+            except InterruptedException:
+                raise  # cross-thread cancel kills the query, never a shard
+            except Exception as e:
+                kind = resilience.classify(e)
+                if kind == resilience.DEADLINE and (
+                        dl is None or (dl.hard and dl.reached())):
+                    # the QUERY's budget is spent (or there was no per-shard
+                    # slice to absorb it) — propagate, don't blame the shard
+                    raise
+                health.report_failure(r, e)
+                ok.append(False)
+    ok_np = np.asarray(ok, dtype=bool)
+    covered = sum(rows[r] for r in range(world) if ok_np[r])
+    coverage = covered / max(1, int(n_total))
+    dropped = tuple(int(r) for r in range(world) if not ok_np[r])
+    degraded = bool(dropped)
+    if degraded:
+        health.check_quorum(coverage, context=site)
+        obs.add("distributed.partial_merge")
+        record_event("partial_merge", site=site, coverage=round(coverage, 4),
+                     dropped=list(dropped))
+    return ShardReport(ok_np, coverage, degraded, dropped)
+
+
+def shard_ok_device(ok: np.ndarray, comms):
+    """Place a (world,) serving mask as a (world, 1) fp32 array sharded over
+    the mesh axis, so each SPMD shard body reads its own flag (``ok[0, 0]``)
+    and masks its candidates out of the merge when it is marked dead. A
+    traced array input: flipping the mask never recompiles the search."""
+    arr = jnp.asarray(np.asarray(ok, np.float32).reshape(-1, 1))
+    return jax.device_put(arr, comms.sharding(comms.axis, None))
 
 
 def shard_rows(work, comms):
@@ -71,9 +207,7 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
         out_specs=(P(axis, None), P(axis, None)),
         check_vma=False,
     ))
-    from raft_tpu.resilience import faultpoint
-
-    faultpoint("distributed.assign_phase")
+    resilience.faultpoint("distributed.assign_phase")
     assign_attrs = None
     if obs.enabled():
         obs.add("distributed.assign.shards", comms.size)
@@ -161,11 +295,13 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
     """shard_map'd search tile shared by the distributed IVF indexes: local
     scan (strip kernel, or dense gather for sub-512 lists) on the shard's
     (data, ids, bias) triple + butterfly merge. Bias carries +inf at
-    padding (precomputed at build)."""
+    padding (precomputed at build). ``ok`` is the (world, 1) serving mask
+    (shard_ok_device): a dead shard's candidates are blanked to (+inf, -1)
+    BEFORE the merge, so the partial merge is exact over the survivors."""
     from raft_tpu.ops.strip_scan import _strip_tile_body
 
     def body(queries, probes, pair_const, qids, strip_list, pair_strip,
-             pair_slot, data, ids_arr, bias):
+             pair_slot, data, ids_arr, bias, ok):
         ld, li, b = data[0], ids_arr[0], bias[0]
         if dense:
             vals, ids = dense_local_scan(queries, probes, ld, b, li, k,
@@ -176,13 +312,16 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
                 ld, b, li, class_layout, k, kf, alpha, interpret,
                 pair_const,
             )
+        alive = ok[0, 0] > 0
+        vals = jnp.where(alive, vals, jnp.inf)
+        ids = jnp.where(alive, ids, -1)
         return merge_shards(vals, ids, k, axis, world)
 
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(),
                   P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None)),
+                  P(axis, None, None), P(axis, None)),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -191,14 +330,18 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
 
 def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                  alpha, dense, interpret, data, ids_arr, bias,
-                 pair_const=None):
+                 pair_const=None, algo="ivf", n_total=0, health=None):
     """Query-tiled SPMD search loop shared by the distributed IVF indexes.
 
     Plans are built ON DEVICE (ops/strip_scan._plan_device, replicated —
     every shard runs the identical grid from the per-list MAX fill) and the
     host fetches only the per-class strip counts; round-3: host-built plan
     tables cost several MB of ~25 MB/s uploads per tile on the tunneled
-    runtime. ``probes`` is a device array — no host copy of it exists."""
+    runtime. ``probes`` is a device array — no host copy of it exists.
+
+    Returns ``(vals, ids, report)``: the dispatch runs through
+    :func:`probe_shards` first, so a dead shard costs coverage (its
+    candidates are masked out of every tile's merge), not the query."""
     from raft_tpu.core.resources import current_resources
     from raft_tpu.ops.strip_scan import class_info, fit_q_tile, plan_tile
 
@@ -206,6 +349,11 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
         raise ValueError(
             f"distributed strip search supports k <= 512, got {k}"
         )
+    if n_total <= 0:
+        raise ValueError("tiled_search needs the true row count (n_total) "
+                         "for coverage accounting")
+    report = probe_shards(algo, comms.size, n_total, health=health)
+    ok_dev = shard_ok_device(report.ok, comms)
     kf = min(int(k), 512)
     q = queries_mat.shape[0]
     probes = jnp.asarray(probes)
@@ -224,18 +372,18 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
     zero = jnp.zeros((1,), jnp.int32)
     zero2 = jnp.zeros((1, 1), jnp.int32)
     from raft_tpu.core.interruptible import check_interrupt
-    from raft_tpu.resilience import faultpoint
 
     search_attrs = None
     if obs.enabled():
         search_attrs = {"shard": int(comms.size), "queries": int(q),
-                        "probes": int(q * p)}
+                        "probes": int(q * p),
+                        "coverage": round(report.coverage, 4)}
     span = obs.record_span("distributed::tiled_search", attrs=search_attrs)
     with span:
         while start < q:
             check_interrupt()  # per-tile checkpoint: cancel/hard-deadline
             # land between dispatches, not after the full query set
-            faultpoint("distributed.tiled_search.tile")
+            resilience.faultpoint("distributed.tiled_search.tile")
             qt = min(q_tile, q - start)
             with obs.record_span("distributed::search_tile",
                                  attrs=({"tile": n_tiles, "rows": int(qt)}
@@ -257,7 +405,7 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                                                axis=0),
                           pair_const[start:start + qt],
                           qids, strip_list, pair_strip, pair_slot,
-                          data, ids_arr, bias)
+                          data, ids_arr, bias, ok_dev)
             out_v.append(v)
             out_i.append(i)
             start += qt
@@ -271,7 +419,7 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
         obs.add("distributed.search.tiles", n_tiles)
     vals = out_v[0] if len(out_v) == 1 else jnp.concatenate(out_v, 0)
     ids = out_i[0] if len(out_i) == 1 else jnp.concatenate(out_i, 0)
-    return vals, ids
+    return vals, ids, report
 
 
 def dense_local_scan(queries, probes, ld, bias, li, k: int, alpha: float,

@@ -83,7 +83,7 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
     bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
     needs_norms = metric in ("sqeuclidean", "euclidean", "cosine")
 
-    def body(shard, shard_norms, queries, filter_words):
+    def body(shard, shard_norms, queries, filter_words, ok):
         rows = shard.shape[0]
         rank = jax.lax.axis_index(axis)
         gids = rank * rows + jnp.arange(rows, dtype=jnp.int32)
@@ -103,6 +103,11 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
             gids = jnp.pad(gids, (0, k - rows), constant_values=-1)
         vals, sel = select_k(d, k, select_min=select_min, algo=select_algo)
         ids = jnp.where(vals == bad, -1, jnp.take(gids, sel))
+        # degraded mode: a dead shard's candidates are blanked before the
+        # merge, so the partial merge is exact over the survivors
+        alive = ok[0, 0] > 0
+        vals = jnp.where(alive, vals, bad)
+        ids = jnp.where(alive, ids, -1)
         # cross-shard butterfly merge (knn_merge_parts analog; per-link
         # bytes k·log2(world) — see _sharding.merge_shards)
         from raft_tpu.distributed._sharding import merge_shards
@@ -113,7 +118,7 @@ def _make_search_fn(mesh, axis, metric, metric_arg, k, n_total, select_algo,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis, None), nspec, P(), P()),
+        in_specs=(P(axis, None), nspec, P(), P(), P(axis, None)),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -128,9 +133,12 @@ def search(
     filter: Optional[Bitset] = None,
     select_algo: str = "exact",
     res: Optional[Resources] = None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sharded exact kNN: (distances (q, k), global indices (q, k)),
-    replicated on every mesh slot."""
+    replicated on every mesh slot, as a
+    :class:`~raft_tpu.distributed._sharding.SearchResult` (carries
+    ``coverage``/``degraded`` when shards were dropped from the merge)."""
     res = res or current_resources()
     queries = jnp.asarray(queries)
     if queries.shape[1] != index.dim:
@@ -161,4 +169,13 @@ def search(
         if index.norms is not None
         else jnp.zeros((index.dataset.shape[0],), jnp.float32)
     )
-    return fn(index.dataset, norms, queries, fwords)
+    from raft_tpu.distributed._sharding import (SearchResult, probe_shards,
+                                                shard_ok_device)
+
+    report = probe_shards("brute_force", comms.size, index.n_total,
+                          health=health)
+    ok_dev = shard_ok_device(report.ok, comms)
+    vals, ids = fn(index.dataset, norms, queries, fwords, ok_dev)
+    return SearchResult(vals, ids, coverage=report.coverage,
+                        degraded=report.degraded,
+                        lost_shards=report.dropped)

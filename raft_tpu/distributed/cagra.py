@@ -163,7 +163,7 @@ def build(
 def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
                     n_total, seed, world=0, compressed=False, rt=0,
                     has_cents=False):
-    def body(shard, graph, queries, *payload):
+    def body(shard, graph, queries, ok, *payload):
         rows = shard.shape[1]
         rank = jax.lax.axis_index(axis)
         key = jax.random.key(seed)
@@ -185,8 +185,10 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
         gids = jnp.where(local_ids >= 0,
                          rank * rows + local_ids, -1).astype(jnp.int32)
         # padded sentinel rows carry ~1e36 distances already; also mask any
-        # global id beyond the true row count
-        bad = (gids < 0) | (gids >= n_total)
+        # global id beyond the true row count — and a dead shard's whole
+        # candidate list (degraded mode: coverage, not availability)
+        alive = ok[0, 0] > 0
+        bad = (gids < 0) | (gids >= n_total) | ~alive
         vals = jnp.where(bad, jnp.inf, vals)
         gids = jnp.where(bad, -1, gids)
         from raft_tpu.distributed._sharding import merge_shards
@@ -203,7 +205,8 @@ def _make_search_fn(mesh, axis, k, itopk, width, max_iter, min_iter, n_rand,
         pay_specs = ()
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None, None), P()) + pay_specs,
+        in_specs=(P(axis, None, None), P(axis, None, None), P(),
+                  P(axis, None)) + pay_specs,
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -217,10 +220,13 @@ def search(
     k: int,
     params: sl.CagraSearchParams = sl.CagraSearchParams(),
     res: Optional[Resources] = None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD CAGRA search: every shard walks its local graph, one all-gather
     merges the (world·k) candidates exactly. Returns (distances (q, k),
-    GLOBAL row ids (q, k)), replicated."""
+    GLOBAL row ids (q, k)), replicated, as a
+    :class:`~raft_tpu.distributed._sharding.SearchResult` (carries
+    ``coverage``/``degraded`` when shards were dropped)."""
     queries = jnp.asarray(queries, jnp.float32)
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries must be (q, {index.dim})")
@@ -244,10 +250,20 @@ def search(
         index.comms.mesh, index.comms.axis, int(k), itopk, width, max_iter,
         min_iter, int(max(1, params.num_random_samplings)), index.n_total,
         int(params.seed), index.comms.size, compressed, rt, has_cents)
+    from raft_tpu.distributed._sharding import (SearchResult, probe_shards,
+                                                shard_ok_device)
+
+    report = probe_shards("cagra", index.comms.size, index.n_total,
+                          health=health)
+    ok_dev = shard_ok_device(report.ok, index.comms)
     if compressed:
         args = (index.proj, index.code_scale, index.nbr_codes)
         if has_cents:
             args += (index.centroids, index.centroid_reps)
         args += (index.proj_energy,)
-        return fn(index.dataset, index.graph, queries, *args)
-    return fn(index.dataset, index.graph, queries)
+        vals, ids = fn(index.dataset, index.graph, queries, ok_dev, *args)
+    else:
+        vals, ids = fn(index.dataset, index.graph, queries, ok_dev)
+    return SearchResult(vals, ids, coverage=report.coverage,
+                        degraded=report.degraded,
+                        lost_shards=report.dropped)

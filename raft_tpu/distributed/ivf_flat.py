@@ -160,11 +160,14 @@ def search(
     k: int,
     n_probes: int = 20,
     res: Optional[Resources] = None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD search: replicated queries, sharded lists, one shard_map per
-    query tile. Returns global (distances (q, k), row ids (q, k)),
-    replicated on every mesh slot."""
-    from raft_tpu.distributed._sharding import tiled_search
+    query tile. Returns a :class:`~raft_tpu.distributed._sharding.SearchResult`
+    — unpacks as global (distances (q, k), row ids (q, k)), replicated on
+    every mesh slot, and carries ``coverage``/``degraded`` when shards
+    were dropped (``health`` defaults to the process registry)."""
+    from raft_tpu.distributed._sharding import SearchResult, tiled_search
     from raft_tpu.neighbors.ivf_flat import _coarse_probes
     from raft_tpu.ops.strip_scan import strip_eligible
 
@@ -186,12 +189,13 @@ def search(
     # brute scaled at 1.0, IVF at 0.6-0.8 purely from this). The dense
     # XLA scan is the honest off-TPU backend.
     interpret = jax.default_backend() != "tpu"
-    vals, ids = tiled_search(
+    vals, ids, report = tiled_search(
         queries, probes, index.lens_max, index.n_lists, int(k),
         index.comms, -2.0 if l2 else -1.0,
         dense=interpret or not strip_eligible(index.max_list_size),
         interpret=interpret,
         data=index.list_data, ids_arr=index.list_ids, bias=index.bias,
+        algo="ivf_flat", n_total=index.n_total, health=health,
     )
     if l2:
         vals = jnp.maximum(vals + dist_mod.sqnorm(queries)[:, None], 0.0)
@@ -202,4 +206,6 @@ def search(
         vals = jnp.where(ids >= 0, 1.0 + vals, jnp.inf)
     else:
         vals = jnp.where(ids >= 0, -vals, -jnp.inf)
-    return vals, ids
+    return SearchResult(vals, ids, coverage=report.coverage,
+                        degraded=report.degraded,
+                        lost_shards=report.dropped)

@@ -235,11 +235,14 @@ def search(
     k: int,
     n_probes: int = 20,
     res: Optional[Resources] = None,
+    health=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD IVF-PQ search over the sharded code lists. Returns PQ-approximate
-    (distances (q, k), global row ids (q, k)), replicated; re-rank with
-    neighbors/refine for the headline configuration."""
-    from raft_tpu.distributed._sharding import tiled_search
+    (distances (q, k), global row ids (q, k)) as a
+    :class:`~raft_tpu.distributed._sharding.SearchResult` (replicated;
+    carries ``coverage``/``degraded`` when shards were dropped); re-rank
+    with neighbors/refine for the headline configuration."""
+    from raft_tpu.distributed._sharding import SearchResult, tiled_search
     from raft_tpu.neighbors.ivf_flat import _coarse_probes
     from raft_tpu.ops.strip_scan import strip_eligible
 
@@ -269,13 +272,14 @@ def search(
     # dense XLA scan off-TPU: the interpreted strip kernel serializes
     # virtual-mesh shards (see distributed/ivf_flat.py)
     interpret = jax.default_backend() != "tpu"
-    vals, ids = tiled_search(
+    vals, ids, report = tiled_search(
         qr_scaled, probes, index.lens_max, index.n_lists,
         int(k), index.comms, alpha,
         dense=interpret or not strip_eligible(index.max_list_size),
         interpret=interpret,
         data=index.decoded, ids_arr=index.list_ids, bias=index.bias,
         pair_const=pair_const,
+        algo="ivf_pq", n_total=index.n_total, health=health,
     )
 
     if l2:
@@ -288,4 +292,6 @@ def search(
         vals = jnp.where(ids >= 0, -vals, -jnp.inf)
     if index.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
-    return vals, ids
+    return SearchResult(vals, ids, coverage=report.coverage,
+                        degraded=report.degraded,
+                        lost_shards=report.dropped)

@@ -37,6 +37,7 @@ def save_to_hnswlib(index, path) -> None:
         raise ValueError(f"graph rows {n} != dataset rows {data.shape[0]}")
     entry = n // 2  # the reference picks size/2 as the entrypoint
 
+    from raft_tpu.core.fsio import atomic_replace, atomic_write
     from raft_tpu.native import get_native_lib
 
     lib = get_native_lib()
@@ -44,19 +45,26 @@ def save_to_hnswlib(index, path) -> None:
     if lib is not None:
         import ctypes
 
-        rc = lib.raft_tpu_write_hnsw(
-            path.encode(), n, dim, degree,
-            graph.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            entry,
-        )
-        if rc != 0:
-            raise OSError(f"native hnsw writer failed with code {rc} for {path}")
+        def produce(tmp_path):
+            # native writer owns the file; atomic_replace renames the
+            # completed tmp onto the target so a crash never leaves a
+            # torn export
+            rc = lib.raft_tpu_write_hnsw(
+                tmp_path.encode(), n, dim, degree,
+                graph.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                entry,
+            )
+            if rc != 0:
+                raise OSError(
+                    f"native hnsw writer failed with code {rc} for {path}")
+
+        atomic_replace(path, produce)
         return
 
-    # pure-Python fallback: identical bytes
+    # pure-Python fallback: identical bytes (atomic, same contract)
     size_per_el = degree * 4 + 4 + dim * 4 + 8
-    with open(path, "wb") as f:
+    with atomic_write(path) as f:
         f.write(_HEADER.pack(0, n, n, size_per_el, size_per_el - 8,
                              degree * 4 + 4, 0, entry, degree // 2, degree,
                              degree // 2, 0.42424242, 500))
@@ -83,16 +91,43 @@ class HnswIndex:
     @classmethod
     def load(cls, path, dim: int) -> "HnswIndex":
         """Parse an hnswlib file of known ``dim`` (hnswlib's loader also
-        needs the space dim up front)."""
+        needs the space dim up front).
+
+        The hnswlib layout carries no magic, so the header is validated
+        structurally BEFORE any parse (ISSUE 7 satellite): a wrong-kind or
+        corrupt file fails with a classified ``ValueError`` naming what is
+        wrong, like the other index loaders — not a downstream reshape or
+        view error."""
         with open(path, "rb") as f:
-            hdr = _HEADER.unpack(f.read(_HEADER.size))
+            head = f.read(_HEADER.size)
+            if head[:8] == b"RAFTTPU\x00":
+                raise ValueError(
+                    f"{path} is a raft_tpu container, not an hnswlib "
+                    f"index — load it with the matching Index.load()")
+            if len(head) < _HEADER.size:
+                raise ValueError(
+                    f"not an hnswlib index: {path} holds {len(head)} bytes, "
+                    f"shorter than the {_HEADER.size}-byte header")
+            hdr = _HEADER.unpack(head)
             (_, max_el, n, size_per_el, label_off, offset_data, max_level,
              entry, _, max_m0, _, _, _) = hdr
             degree = (offset_data - 4) // 4
+            if not (0 < n <= max_el) or degree <= 0 or \
+                    offset_data != degree * 4 + 4 or \
+                    label_off != size_per_el - 8 or not 0 <= entry < n:
+                raise ValueError(
+                    f"not a CAGRA-exported hnswlib index: header invariants "
+                    f"violated (n={n}, max_el={max_el}, degree={degree}, "
+                    f"offset_data={offset_data}, label_off={label_off}, "
+                    f"size_per_el={size_per_el}, entry={entry}) in {path}")
             if size_per_el != degree * 4 + 4 + dim * 4 + 8:
                 raise ValueError(
                     f"dim {dim} inconsistent with element size {size_per_el}")
             raw = np.fromfile(f, np.uint8, n * size_per_el)
+            if raw.size < n * size_per_el:
+                raise ValueError(
+                    f"truncated hnswlib index: {path} holds {raw.size} of "
+                    f"{n * size_per_el} element bytes — partial write")
         el = raw.reshape(n, size_per_el)
         counts = el[:, :4].view(np.int32)[:, 0]
         graph = np.ascontiguousarray(el[:, 4:offset_data]).view(np.uint32).reshape(n, degree)

@@ -19,6 +19,10 @@ parts, each usable alone:
 * :mod:`~raft_tpu.resilience.faultinject` — :func:`faultpoint` sites armed
   via ``RAFT_TPU_FAULTS=site=oom:1``-style specs, which is what makes all
   of the above testable on CPU in tier-1.
+* :mod:`~raft_tpu.resilience.shard_health` — per-shard
+  HEALTHY/SUSPECT/LOST registry + minimum-coverage quorum that the
+  distributed searches consult so a lost shard degrades coverage
+  (partial merge, ``degraded`` marker) instead of failing the query.
 """
 
 from raft_tpu.resilience.deadline import (
@@ -43,6 +47,15 @@ from raft_tpu.resilience.faultinject import (
     clear_faults,
     faultpoint,
 )
+from raft_tpu.resilience.shard_health import (
+    HEALTHY,
+    LOST,
+    SUSPECT,
+    ShardHealth,
+    ShardQuorumError,
+    reset_shard_health,
+    shard_health,
+)
 from raft_tpu.resilience.retry import (
     RetryPolicy,
     backoff_delays,
@@ -63,9 +76,14 @@ __all__ = [
     "DeadlineExceeded",
     "FATAL",
     "FaultInjected",
+    "HEALTHY",
     "KINDS",
+    "LOST",
     "OOM",
     "RetryPolicy",
+    "SUSPECT",
+    "ShardHealth",
+    "ShardQuorumError",
     "TRANSIENT",
     "active_deadline",
     "arm_faults",
@@ -83,6 +101,8 @@ __all__ = [
     "is_retryable",
     "recent_events",
     "record_event",
+    "reset_shard_health",
+    "shard_health",
     "sync_mode",
     "with_retries",
 ]

@@ -55,6 +55,51 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 
 echo
+echo "== degraded-mode shard-loss smoke (ISSUE 7) =="
+# Arm a one-shot fatal at every distributed per-shard dispatch site on the
+# 8-virtual-device CPU mesh (the repo's multi-chip stand-in): each algo
+# must return PARTIAL results stamped degraded with coverage < 1 — a lost
+# shard costs coverage, never the query. Non-zero exit on full failure.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+RAFT_TPU_FAULTS="distributed.brute_force.search.shard=fatal:1,distributed.ivf_flat.search.shard=fatal:1,distributed.ivf_pq.search.shard=fatal:1,distributed.cagra.search.shard=fatal:1" \
+python - <<'EOF' || fail=1
+import numpy as np
+from raft_tpu import resilience
+from raft_tpu.comms import Comms, local_mesh
+from raft_tpu.distributed import brute_force as dbf, cagra as dcagra, \
+    ivf_flat as divf, ivf_pq as dpq
+from raft_tpu.neighbors import cagra as slcagra, ivf_pq
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((1024, 16)).astype(np.float32)
+Q = rng.standard_normal((8, 16)).astype(np.float32)
+comms = Comms(local_mesh(8))
+runs = {
+    "brute_force": lambda: dbf.search(dbf.build(X, comms=comms), Q, 5),
+    "ivf_flat": lambda: divf.search(
+        divf.build(X, divf.IvfFlatParams(n_lists=8), comms=comms),
+        Q, 5, n_probes=8),
+    "ivf_pq": lambda: dpq.search(
+        dpq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8), comms=comms),
+        Q, 5, n_probes=8),
+    "cagra": lambda: dcagra.search(
+        dcagra.build(X, slcagra.CagraParams(
+            intermediate_graph_degree=16, graph_degree=8,
+            build_algo="brute"), comms=comms),
+        Q, 5, slcagra.CagraSearchParams(itopk_size=32)),
+}
+for name, run in runs.items():
+    resilience.reset_shard_health()
+    res = run()
+    assert res.degraded and res.coverage < 1.0, (name, res.coverage)
+    ids = np.asarray(res.indices)
+    assert ids.max() < 1024 and (ids[ids >= 0] >= 128).all(), name
+    print(f"  {name}: degraded ok (coverage={res.coverage:.3f}, "
+          f"lost={res.lost_shards})")
+print("shard-loss smoke: OK")
+EOF
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

@@ -2,9 +2,12 @@
 match the single-device library path (tier-1 oracle, SURVEY.md §4.3 — the
 LocalCUDACluster-analog fixture is the conftest virtual CPU mesh)."""
 
+import time
+
 import numpy as np
 import pytest
 
+from raft_tpu import resilience
 from raft_tpu.cluster import kmeans as kmeans_sd
 from raft_tpu.comms import Comms, local_mesh
 from raft_tpu.core.bitset import Bitset
@@ -16,6 +19,18 @@ from raft_tpu.neighbors import brute_force as bf
 @pytest.fixture(scope="module")
 def comms():
     return Comms(local_mesh(8))
+
+
+@pytest.fixture
+def clean_resilience():
+    """Disarmed faults + a fresh shard-health registry around each
+    degraded-mode test (LOST is sticky by design — it must not leak)."""
+    resilience.clear_faults()
+    resilience.reset_shard_health()
+    resilience.clear_events()
+    yield
+    resilience.clear_faults()
+    resilience.reset_shard_health()
 
 
 def _data(rng, n=500, dim=16, q=20):
@@ -323,3 +338,215 @@ class TestDistributedCagraCompressed:
         overlap_e = np.mean([
             len(set(np.asarray(ce)[r]) & set(ei[r])) / k for r in range(q)])
         assert overlap_e >= 0.8, overlap_e
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode search (ISSUE 7): a lost shard costs coverage, not the query
+# ---------------------------------------------------------------------------
+
+
+def _surviving_reference(X, Q, k, lost_shards, world=8):
+    """Exact top-k restricted to the rows the SURVIVING shards hold, mapped
+    to global ids (the acceptance oracle: partial merge must be exact over
+    the survivors)."""
+    rows_per = -(-X.shape[0] // world)
+    keep = np.ones(X.shape[0], bool)
+    for r in lost_shards:
+        keep[r * rows_per:(r + 1) * rows_per] = False
+    gid = np.arange(X.shape[0])[keep]
+    vd, vi = bf.search(bf.build(X[keep]), Q, k)
+    return np.asarray(vd), gid[np.asarray(vi)]
+
+
+class TestDegradedSearch:
+    def test_brute_force_shard_loss(self, rng, comms, clean_resilience):
+        X, Q = _data(rng, n=501)
+        idx = dbf.build(X, comms=comms)
+        resilience.arm_faults("distributed.brute_force.search.shard=fatal:1")
+        res = dbf.search(idx, Q, 10)
+        vd, vi = res  # SearchResult unpacks like the plain pair
+        assert res.degraded and res.coverage < 1.0
+        assert res.lost_shards == (0,)
+        ed, ei = _surviving_reference(X, Q, 10, res.lost_shards)
+        np.testing.assert_array_equal(np.asarray(vi), ei)
+        np.testing.assert_allclose(np.asarray(vd), ed, rtol=1e-5, atol=1e-5)
+        # every incident is observable
+        events = [e["event"] for e in resilience.recent_events()]
+        assert "shard_lost" in events and "partial_merge" in events
+        # a FATAL loss is sticky: the next dispatch skips the shard without
+        # re-probing and stays honestly degraded
+        res2 = dbf.search(idx, Q, 10)
+        assert res2.degraded and res2.lost_shards == (0,)
+        assert resilience.shard_health().state(0) == resilience.LOST
+
+    def test_brute_force_healthy_is_full_coverage(self, rng, comms,
+                                                  clean_resilience):
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        res = dbf.search(idx, Q, 10)
+        assert res.coverage == 1.0 and not res.degraded
+        assert res.lost_shards == ()
+
+    def test_ivf_flat_shard_loss_exact_over_survivors(self, comms,
+                                                      clean_resilience):
+        from raft_tpu.distributed import ivf_flat as divf
+
+        rng = np.random.default_rng(13)
+        X = rng.standard_normal((2000, 16)).astype(np.float32)
+        Q = rng.standard_normal((16, 16)).astype(np.float32)
+        idx = divf.build(X, divf.IvfFlatParams(n_lists=8), comms=comms)
+        resilience.arm_faults("distributed.ivf_flat.search.shard=fatal:1")
+        res = divf.search(idx, Q, 10, n_probes=8)  # exhaustive probes
+        assert res.degraded and res.coverage < 1.0
+        _, ei = _surviving_reference(X, Q, 10, res.lost_shards)
+        np.testing.assert_array_equal(np.asarray(res.indices), ei)
+
+    def test_ivf_pq_shard_loss(self, comms, clean_resilience):
+        from raft_tpu import stats
+        from raft_tpu.distributed import ivf_pq as dpq
+        from raft_tpu.neighbors import ivf_pq, refine
+
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((2000, 32)).astype(np.float32)
+        Q = rng.standard_normal((16, 32)).astype(np.float32)
+        idx = dpq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=16),
+                        comms=comms)
+        resilience.arm_faults("distributed.ivf_pq.search.shard=fatal:1")
+        res = dpq.search(idx, Q, 40, n_probes=8)  # exhaustive + over-fetch
+        assert res.degraded and res.coverage < 1.0
+        ids = np.asarray(res.indices)
+        rows_per = -(-2000 // 8)
+        assert (ids[ids >= 0] >= rows_per).all()  # no lost-shard rows
+        # exact refine of the degraded candidates must hit the recall gate
+        # against the reference restricted to the SURVIVING shards
+        _, i_ref = refine.refine(X, Q, res.indices, 10)
+        _, gt = _surviving_reference(X, Q, 10, res.lost_shards)
+        assert float(stats.neighborhood_recall(i_ref, gt)) >= 0.95
+
+    def test_cagra_shard_loss(self, comms, clean_resilience):
+        from raft_tpu.distributed import cagra as dcagra
+        from raft_tpu.neighbors import cagra as slcagra
+
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((1600, 16)).astype(np.float32)
+        Q = rng.standard_normal((16, 16)).astype(np.float32)
+        idx = dcagra.build(X, slcagra.CagraParams(
+            intermediate_graph_degree=16, graph_degree=8,
+            build_algo="brute"), comms=comms)
+        resilience.arm_faults("distributed.cagra.search.shard=fatal:1")
+        res = dcagra.search(idx, Q, 5,
+                            slcagra.CagraSearchParams(itopk_size=32))
+        assert res.degraded and res.coverage < 1.0
+        ids = np.asarray(res.indices)
+        rows_per = -(-1600 // 8)
+        assert (ids[ids >= 0] >= rows_per).all()
+        # merged top-k tracks the exact reference over the surviving shards
+        # (each small shard walks essentially all its rows at itopk=32)
+        _, gt = _surviving_reference(X, Q, 5, res.lost_shards)
+        overlap = np.mean([len(set(ids[r]) & set(gt[r])) / 5
+                           for r in range(Q.shape[0])])
+        assert overlap >= 0.8, overlap
+
+    def test_transient_shard_heals(self, rng, comms, clean_resilience):
+        """A TRANSIENT verdict marks the shard SUSPECT (one degraded
+        dispatch); the next clean probe reinstates it — full coverage."""
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        resilience.arm_faults(
+            "distributed.brute_force.search.shard=transient:1")
+        res = dbf.search(idx, Q, 10)
+        assert res.degraded and res.lost_shards == (0,)
+        assert resilience.shard_health().state(0) == resilience.SUSPECT
+        res2 = dbf.search(idx, Q, 10)
+        assert not res2.degraded and res2.coverage == 1.0
+        assert resilience.shard_health().state(0) == resilience.HEALTHY
+
+    def test_quorum_loss_raises_classified(self, rng, comms,
+                                           clean_resilience):
+        """Below the minimum-coverage quorum a degraded result would be
+        noise: the dispatch fails with a classified FATAL instead."""
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        resilience.arm_faults("distributed.brute_force.search.shard=fatal:5")
+        with pytest.raises(resilience.ShardQuorumError) as ei:
+            dbf.search(idx, Q, 10)
+        assert resilience.classify(ei.value) == resilience.FATAL
+
+    def test_deadline_slices_budget_over_shards(self, rng, comms,
+                                                clean_resilience):
+        """A shard that hangs burns its SLICE of the query deadline, not
+        the whole budget: the query returns degraded well inside it."""
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        resilience.arm_faults(
+            "distributed.brute_force.search.shard=hang:1:60")
+        t0 = time.monotonic()
+        with resilience.Deadline(5.0, label="query") as dl:
+            res = dbf.search(idx, Q, 10)
+        assert time.monotonic() - t0 < 5.0
+        assert res.degraded and res.lost_shards == (0,)
+        assert not dl.reached()  # survivors answered inside the budget
+
+
+# ---------------------------------------------------------------------------
+# Sharded snapshots (ISSUE 7): LOST recovery = reload, not rebuild
+# ---------------------------------------------------------------------------
+
+
+class TestShardedSnapshot:
+    def test_manifest_and_roundtrip(self, comms, tmp_path,
+                                    clean_resilience):
+        import json
+        import os
+
+        from raft_tpu.distributed import ivf_flat as divf, snapshot
+
+        rng = np.random.default_rng(23)
+        X = rng.standard_normal((2000, 16)).astype(np.float32)
+        Q = rng.standard_normal((16, 16)).astype(np.float32)
+        idx = divf.build(X, divf.IvfFlatParams(n_lists=8), comms=comms)
+        d = str(tmp_path / "snap")
+        mpath = snapshot.save(idx, d)
+        manifest = json.load(open(mpath))
+        assert manifest["kind"] == "ivf_flat" and manifest["world"] == 8
+        assert len(manifest["shards"]) == 8
+        for f in [manifest["common"]] + manifest["shards"]:
+            assert os.path.exists(os.path.join(d, f))
+        idx2 = snapshot.load(d, comms=comms)
+        v0, i0 = divf.search(idx, Q, 10, n_probes=8)
+        v1, i1 = divf.search(idx2, Q, 10, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_lost_shard_recovers_from_snapshot(self, rng, comms, tmp_path,
+                                               clean_resilience):
+        from raft_tpu.distributed import snapshot
+
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        full = dbf.search(idx, Q, 10)
+        d = str(tmp_path / "snap")
+        snapshot.save(idx, d)
+        resilience.arm_faults("distributed.brute_force.search.shard=fatal:1")
+        degraded = dbf.search(idx, Q, 10)
+        assert degraded.degraded and \
+            resilience.shard_health().lost() == (0,)
+        # the recovery action the shard_lost event advertises
+        idx2, recovered = snapshot.recover(idx, d)
+        assert recovered == (0,)
+        assert resilience.shard_health().state(0) == resilience.HEALTHY
+        healed = dbf.search(idx2, Q, 10)
+        assert healed.coverage == 1.0 and not healed.degraded
+        np.testing.assert_array_equal(np.asarray(healed.indices),
+                                      np.asarray(full.indices))
+
+    def test_wrong_world_rejected(self, comms, tmp_path, clean_resilience):
+        from raft_tpu.distributed import snapshot
+
+        rng = np.random.default_rng(2)
+        X = rng.standard_normal((256, 8)).astype(np.float32)
+        idx = dbf.build(X, comms=comms)
+        d = str(tmp_path / "snap")
+        snapshot.save(idx, d)
+        with pytest.raises(ValueError, match="world"):
+            snapshot.load(d, comms=Comms(local_mesh(4)))

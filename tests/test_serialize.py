@@ -1,0 +1,285 @@
+"""Crash-safe snapshot tests (ISSUE 7): v2 container integrity + the
+save→load→search contract for every index type.
+
+Four layers:
+
+* container — v2 CRC/length meta, truncation and bit-flip detected at load
+  as a classified FATAL NAMING the corrupt array, v1 files still loadable;
+* atomicity — a fatal injected mid-write (``serialize.save.write``) leaves
+  the previous file intact, never a torn one;
+* index round-trips — save→load→search bit parity for all five index
+  types (brute_force, ivf_flat, ivf_pq, cagra, hnsw export);
+* hnsw load validation — wrong-kind / truncated / garbage files fail with
+  a classified ValueError before any parse.
+"""
+
+import io
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from raft_tpu import resilience
+from raft_tpu.core.serialize import (
+    _MAGIC,
+    SnapshotCorruptError,
+    load_arrays,
+    save_arrays,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+@pytest.fixture
+def data(rng):
+    X = rng.standard_normal((600, 24)).astype(np.float32)
+    Q = rng.standard_normal((16, 24)).astype(np.float32)
+    return X, Q
+
+
+def _write_v1(path, meta, arrays):
+    """Hand-rolled VERSION 1 container (no lengths/CRCs) — the compat
+    corpus every pre-ISSUE-7 checkpoint on disk belongs to."""
+    meta = dict(meta)
+    meta["arrays"] = list(arrays.keys())
+    blob = json.dumps(meta).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", 1))
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for name in meta["arrays"]:
+            np.save(f, np.asarray(arrays[name]), allow_pickle=False)
+
+
+# ---------------------------------------------------------------------------
+# container integrity
+# ---------------------------------------------------------------------------
+
+
+class TestContainerV2:
+    def test_roundtrip_carries_crcs(self, tmp_path):
+        path = str(tmp_path / "c.raft")
+        arrays = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+                  "b": np.arange(5, dtype=np.int32)}
+        save_arrays(path, {"kind": "t"}, arrays)
+        meta, got = load_arrays(path)
+        assert meta["kind"] == "t"
+        for name, arr in arrays.items():
+            np.testing.assert_array_equal(got[name], arr)
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            assert meta["array_crc32"][name] == \
+                zlib.crc32(buf.getvalue()) & 0xFFFFFFFF
+            assert meta["array_bytes"][name] == len(buf.getvalue())
+
+    def test_truncation_names_array(self, tmp_path):
+        path = str(tmp_path / "c.raft")
+        save_arrays(path, {}, {"first": np.zeros(8), "second": np.ones(8)})
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:-10])
+        with pytest.raises(SnapshotCorruptError, match="'second'") as ei:
+            load_arrays(path)
+        # classified FATAL: corruption is never retried
+        assert resilience.classify(ei.value) == resilience.FATAL
+
+    def test_bit_flip_names_array(self, tmp_path):
+        path = str(tmp_path / "c.raft")
+        save_arrays(path, {}, {"first": np.zeros(8), "second": np.ones(8)})
+        raw = bytearray(open(path, "rb").read())
+        raw[-4] ^= 0x01  # inside `second`'s payload
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(SnapshotCorruptError, match="'second'") as ei:
+            load_arrays(path)
+        assert "CRC32" in str(ei.value)
+        assert resilience.classify(ei.value) == resilience.FATAL
+
+    def test_v1_still_loads(self, tmp_path):
+        path = str(tmp_path / "v1.raft")
+        arrays = {"x": np.arange(7, dtype=np.int64)}
+        _write_v1(path, {"kind": "legacy", "n": 7}, arrays)
+        meta, got = load_arrays(path)
+        assert meta["kind"] == "legacy" and "array_crc32" not in meta
+        np.testing.assert_array_equal(got["x"], arrays["x"])
+
+    def test_stream_roundtrip(self):
+        buf = io.BytesIO()
+        save_arrays(buf, {"kind": "mem"}, {"a": np.eye(3)})
+        buf.seek(0)
+        meta, got = load_arrays(buf)
+        assert meta["kind"] == "mem"
+        np.testing.assert_array_equal(got["a"], np.eye(3))
+
+    def test_midwrite_fault_leaves_previous_file(self, tmp_path):
+        path = str(tmp_path / "c.raft")
+        save_arrays(path, {"gen": 1}, {"a": np.zeros(4)})
+        resilience.arm_faults("serialize.save.write=fatal:1")
+        with pytest.raises(resilience.FaultInjected):
+            save_arrays(path, {"gen": 2}, {"a": np.ones(4)})
+        # atomic contract: the interrupted save left generation 1 intact
+        # and no .tmp litter
+        meta, got = load_arrays(path)
+        assert meta["gen"] == 1
+        np.testing.assert_array_equal(got["a"], np.zeros(4))
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# index save → load → search bit parity (all five types)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexRoundtrips:
+    def test_brute_force(self, tmp_path, data):
+        from raft_tpu.neighbors import brute_force
+
+        X, Q = data
+        idx = brute_force.build(X)
+        v0, i0 = brute_force.search(idx, Q, 10)
+        path = str(tmp_path / "bf.raft")
+        idx.save(path)
+        idx2 = brute_force.BruteForceIndex.load(path)
+        v1, i1 = brute_force.search(idx2, Q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_ivf_flat(self, tmp_path, data):
+        from raft_tpu.neighbors import ivf_flat
+
+        X, Q = data
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8))
+        v0, i0 = ivf_flat.search(idx, Q, 10, n_probes=8)
+        path = str(tmp_path / "flat.raft")
+        idx.save(path)
+        idx2 = ivf_flat.IvfFlatIndex.load(path)
+        v1, i1 = ivf_flat.search(idx2, Q, 10, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_ivf_pq(self, tmp_path, data):
+        from raft_tpu.neighbors import ivf_pq
+
+        X, Q = data
+        idx = ivf_pq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=12))
+        v0, i0 = ivf_pq.search(idx, Q, 10, n_probes=8)
+        path = str(tmp_path / "pq.raft")
+        idx.save(path)
+        idx2 = ivf_pq.IvfPqIndex.load(path)
+        v1, i1 = ivf_pq.search(idx2, Q, 10, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_cagra(self, tmp_path, data):
+        from raft_tpu.neighbors import cagra
+
+        X, Q = data
+        idx = cagra.build(X, cagra.CagraParams(
+            intermediate_graph_degree=16, graph_degree=8,
+            build_algo="brute"))
+        sp = cagra.CagraSearchParams(itopk_size=32)
+        v0, i0 = cagra.search(idx, Q, 5, sp)
+        path = str(tmp_path / "cagra.raft")
+        idx.save(path)
+        idx2 = cagra.CagraIndex.load(path)
+        v1, i1 = cagra.search(idx2, Q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_hnsw_export(self, tmp_path, data):
+        from raft_tpu.neighbors import cagra, hnsw
+
+        X, Q = data
+        idx = cagra.build(X, cagra.CagraParams(
+            intermediate_graph_degree=16, graph_degree=8,
+            build_algo="brute"))
+        path = str(tmp_path / "idx.hnsw")
+        hnsw.save_to_hnswlib(idx, path)
+        loaded = hnsw.HnswIndex.load(path, dim=X.shape[1])
+        # bit parity with the source index's arrays
+        np.testing.assert_array_equal(loaded.graph,
+                                      np.asarray(idx.graph).astype(np.uint32))
+        np.testing.assert_array_equal(
+            loaded.dataset, np.asarray(idx.dataset, dtype=np.float32))
+        d, labels = loaded.knn(Q[:4], 5)
+        assert labels.shape == (4, 5) and (labels >= 0).all()
+        # atomic export: no tmp litter
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+    def test_index_truncation_is_classified(self, tmp_path, data):
+        """The round-5 wedge class, closed: a half-written index checkpoint
+        fails its reload with a FATAL naming the array — not a cryptic
+        np.load tokenizer error."""
+        from raft_tpu.neighbors import ivf_flat
+
+        X, _ = data
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8))
+        path = str(tmp_path / "flat.raft")
+        idx.save(path)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:len(raw) // 2])
+        with pytest.raises(SnapshotCorruptError) as ei:
+            ivf_flat.IvfFlatIndex.load(path)
+        assert resilience.classify(ei.value) == resilience.FATAL
+        # names one of the index's real arrays
+        assert any(n in str(ei.value) for n in
+                   ("centers", "list_data", "list_ids", "list_norms"))
+
+
+# ---------------------------------------------------------------------------
+# hnsw load validation (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestHnswValidation:
+    def test_wrong_kind_file_is_named(self, tmp_path):
+        from raft_tpu.neighbors import hnsw
+
+        path = str(tmp_path / "notit.hnsw")
+        save_arrays(path, {"kind": "ivf_flat"}, {"a": np.zeros(4)})
+        with pytest.raises(ValueError, match="raft_tpu container"):
+            hnsw.HnswIndex.load(path, dim=4)
+
+    def test_short_file(self, tmp_path):
+        from raft_tpu.neighbors import hnsw
+
+        path = str(tmp_path / "short.hnsw")
+        with open(path, "wb") as f:
+            f.write(b"\x01\x02\x03")
+        with pytest.raises(ValueError, match="shorter than"):
+            hnsw.HnswIndex.load(path, dim=4)
+
+    def test_garbage_header(self, tmp_path, rng):
+        from raft_tpu.neighbors import hnsw
+
+        path = str(tmp_path / "junk.hnsw")
+        with open(path, "wb") as f:
+            f.write(rng.integers(0, 255, 4096, dtype=np.uint8).tobytes())
+        with pytest.raises(ValueError,
+                           match="header invariants|inconsistent"):
+            hnsw.HnswIndex.load(path, dim=4)
+
+    def test_truncated_elements(self, tmp_path, data):
+        from raft_tpu.neighbors import cagra, hnsw
+
+        X, _ = data
+        idx = cagra.build(X, cagra.CagraParams(
+            intermediate_graph_degree=16, graph_degree=8,
+            build_algo="brute"))
+        path = str(tmp_path / "trunc.hnsw")
+        hnsw.save_to_hnswlib(idx, path)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:len(raw) // 2])
+        with pytest.raises(ValueError, match="truncated hnswlib"):
+            hnsw.HnswIndex.load(path, dim=X.shape[1])
