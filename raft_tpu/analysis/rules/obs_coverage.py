@@ -3,11 +3,16 @@
 The ROADMAP open item says bench-affecting hot paths must keep their
 ``raft_tpu.obs`` spans. This rule turns that from review-time lore into a
 tier-1 failure: every PUBLIC build/search/fit-family entry point in
-``neighbors/``, ``cluster/`` and ``distributed/`` must either carry the
-``@traced("…")`` decorator or open an ``obs.record_span`` itself. Removing a
-span from an instrumented entry point — or adding a new entry point without
-one — is a NEW finding and fails the run (the baseline never absorbs it,
-because the identity line is the ``def`` itself).
+``neighbors/``, ``cluster/``, ``distributed/`` and ``serving/`` must either
+carry the ``@traced("…")`` decorator or open an ``obs.record_span`` itself.
+Removing a span from an instrumented entry point — or adding a new entry
+point without one — is a NEW finding and fails the run (the baseline never
+absorbs it, because the identity line is the ``def`` itself).
+
+The serving layer's public surface is method-shaped
+(``PagedListStore.upsert`` / ``.delete`` / ``.compact``,
+``QueryQueue.submit``), so inside ``serving/`` the rule also walks
+class bodies.
 """
 
 from __future__ import annotations
@@ -17,8 +22,9 @@ import ast
 from raft_tpu.analysis.registry import Rule, register
 from raft_tpu.analysis.rules._common import calls_record_span, is_traced_decorated
 
-_SCOPED_DIRS = {"neighbors", "cluster", "distributed"}
-_ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn"}
+_SCOPED_DIRS = {"neighbors", "cluster", "distributed", "serving"}
+_ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn",
+                "upsert", "delete", "submit", "compact"}
 _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 
 
@@ -39,7 +45,13 @@ class ObsCoverageRule(Rule):
         parts = ctx.rel.split("/")[:-1]  # directories only
         if not _SCOPED_DIRS.intersection(parts):
             return
-        for node in ctx.tree.body:  # module level only: the public surface
+        nodes = list(ctx.tree.body)  # module level: the public surface
+        if "serving" in parts:  # ...plus serving's method-shaped entries
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    nodes.extend(n for n in node.body if isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        for node in nodes:
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not _is_entry_name(node.name):

@@ -62,6 +62,8 @@ class SpanNameRule(Rule):
         in_library = parts[0] == "raft_tpu" or ctx.rel == "bench.py"
         in_bench = ctx.rel == "bench.py" or "bench" in parts[:-1]
 
+        in_serving = len(parts) > 1 and parts[0] == "raft_tpu" \
+            and parts[1] == "serving"
         if in_library:
             for node, name in _literal_span_names(ctx.tree):
                 if not _NAME_RE.match(name):
@@ -71,6 +73,14 @@ class SpanNameRule(Rule):
                         f"convention (lower-case dotted segments around one "
                         f"'::') — renamed spans fork their metric series "
                         f"across rounds")
+                elif in_serving and not name.startswith("serving::"):
+                    # the serving layer's span family is its SLO dashboard:
+                    # a span filed under another module's prefix silently
+                    # drops out of every serving-latency query
+                    yield self.finding(
+                        ctx, node,
+                        f"span name {name!r} in raft_tpu/serving/ must use "
+                        f"the serving:: prefix (serving::phase naming)")
 
         if in_bench and not ctx.rel.endswith("/progress.py"):
             for node in ast.walk(ctx.tree):

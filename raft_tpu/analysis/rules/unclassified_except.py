@@ -17,6 +17,10 @@ package (the degraded-mode dispatch gate lives there) and the crash-safe
 write path (``core/serialize.py`` / ``core/fsio.py``) — a broad handler
 that eats a snapshot-corruption error would erase exactly the failure
 class the v2 container exists to classify.
+
+ISSUE 8 added ``raft_tpu/serving/`` — the query-queue dispatch guard is
+the layer's whole failure story (DEADLINE verdicts, OOM batch halving),
+so an unclassified except there would break serving's one contract.
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ def _in_scope(rel: str) -> bool:
     parts = rel.split("/")
     dirs = parts[:-1]
     if parts[-1] == "bench.py" or "distributed" in dirs or \
-            "resilience" in dirs:
+            "resilience" in dirs or "serving" in dirs:
         return True
     return "core" in dirs and parts[-1] in ("serialize.py", "fsio.py")
 

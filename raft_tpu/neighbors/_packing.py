@@ -17,6 +17,16 @@ import jax.numpy as jnp
 # (round 3: one alternative was not enough — see _spill_core)
 _N_ALT = 4
 
+#: shared (re)trace counter for the serving layer's paged scans — each
+#: `_paged_impl` (ivf_flat, ivf_pq, future paged backends) bumps it at
+#: TRACE time only, so a delta across a serving window counts recompiles
+#: (the zero-recompile upsert contract asserted in tier-1/bench/smoke)
+PAGED_TRACES = {"count": 0}
+
+
+def paged_trace_count() -> int:
+    return PAGED_TRACES["count"]
+
 
 def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,
                pow2_chunks: bool = False) -> Tuple:

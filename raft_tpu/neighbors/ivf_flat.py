@@ -606,3 +606,124 @@ def search(
             res.compute_dtype,
         )
     return vals, ids
+
+
+# ---------------------------------------------------------------------------
+# Paged search (serving layer): scan a PagedListStore's vector pages
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "q_tile", "select_algo",
+                     "compute_dtype"),
+)
+def _paged_impl(
+    queries, centers, pages, page_ids, page_aux, table, filter,
+    k, n_probes, metric, q_tile, select_algo, compute_dtype,
+):
+    """Paged-store scan: the gather-backend search (:func:`_search_impl`)
+    re-shaped over (page-table, page) instead of a padded list axis. The
+    per-candidate math is kept IDENTICAL (same coarse gemm, same einsum
+    contraction, same bias/clamp/select sequence) so a fully-compacted
+    store is bit-parity with the packed scan; the ``ids >= 0`` mask covers
+    both fill-count tails and tombstones. All operand shapes derive from
+    CAPACITY (page pool, table width) — appends and tombstones re-dispatch
+    this same program."""
+    _packing.PAGED_TRACES["count"] += 1  # runs at trace time only
+    q, dim = queries.shape
+    select_min = metric != "inner_product"
+    bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
+
+    if metric in ("sqeuclidean", "euclidean"):
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
+        qn = dist_mod.sqnorm(queries)
+    else:
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
+        qn = None
+    _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
+
+    def scan_tile(args):
+        q_blk, qn_blk, probe_blk = args
+        tbl = table[probe_blk]                     # (qt, p, W)
+        safe = jnp.maximum(tbl, 0)
+        cand = pages[safe]                          # (qt, p, W, R, d)
+        ids = jnp.where(tbl[..., None] >= 0, page_ids[safe], -1)
+        ip = jnp.einsum(
+            "qd,qpwrd->qpwr", q_blk, cand, preferred_element_type=jnp.float32
+        )
+        if metric in ("sqeuclidean", "euclidean"):
+            norms = page_aux[safe]
+            d = jnp.maximum(qn_blk[:, None, None, None] + norms - 2.0 * ip, 0.0)
+            if metric == "euclidean":
+                d = jnp.sqrt(d)
+        elif metric == "cosine":
+            d = 1.0 - ip  # inputs are pre-normalized
+        else:
+            d = ip  # inner_product: ranked by max
+        flat_ids = ids.reshape(ids.shape[0], -1)
+        d = d.reshape(flat_ids.shape)
+        valid = flat_ids >= 0
+        if filter is not None:
+            valid = valid & filter.test(flat_ids)
+        d = jnp.where(valid, d, bad)
+        vals, sel = select_k(d, k, select_min=select_min, algo=select_algo)
+        out_ids = jnp.where(vals == bad, -1,
+                            jnp.take_along_axis(flat_ids, sel, axis=1))
+        return vals, out_ids
+
+    if qn is None:
+        qn = jnp.zeros((q,), jnp.float32)  # unused, keeps the signature static
+    return map_row_tiles(scan_tile, (queries, qn, probes), q_tile)
+
+
+@traced("ivf_flat::search_paged")
+def search_paged(
+    store,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """k-NN over a mutable paged vector store
+    (:class:`raft_tpu.serving.PagedListStore`, kind ``"ivf_flat"``): same
+    contract as :func:`search`, but the store keeps serving while rows
+    stream in/out — no repack, and steady-state mutations never recompile
+    this scan (its shapes depend only on store capacity)."""
+    if store.kind != "ivf_flat":
+        raise ValueError(f"expected an ivf_flat store, got {store.kind!r}")
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != store.dim:
+        raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, store.n_lists))
+    # one ATOMIC store snapshot: pool/table read separately could tear
+    # against a concurrent upsert's capacity growth
+    pages, page_ids, page_aux, table = store.scan_state()
+    width = int(table.shape[1])
+    if not 0 < k <= n_probes * width * store.page_rows:
+        raise ValueError(f"k={k} out of range")
+    if store.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    scan_attrs = None
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_flat.search_paged.queries", q_obs)
+        obs.add("ivf_flat.search_paged.probes", q_obs * n_probes)
+        scan_attrs = {"queries": q_obs, "probes": int(n_probes),
+                      "k": int(k), "table_width": width}
+    # the (qt, p, W, R, d) page gather is the big intermediate
+    per_query = max(1, n_probes * width * store.page_rows * (store.dim + 2) * 4)
+    q_tile = int(max(1, min(queries.shape[0],
+                            res.workspace_bytes // per_query)))
+    with obs.record_span("ivf_flat::paged_scan", attrs=scan_attrs):
+        return _paged_impl(
+            queries, store.centers, pages, page_ids, page_aux, table,
+            filter, int(k), n_probes, store.metric,
+            q_tile, select_algo, res.compute_dtype,
+        )

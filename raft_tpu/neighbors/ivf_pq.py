@@ -54,6 +54,7 @@ from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.pq_scan import group_probed_pairs, pq_scan
 from raft_tpu.ops.select_k import select_k
+from raft_tpu.utils.tiling import map_row_tiles
 
 _log = get_logger()
 
@@ -1590,5 +1591,154 @@ def search(
                 index.codebook_kind == "cluster",
             )
     if index.metric == "cosine":
+        vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
+    return vals, ids
+
+
+# ---------------------------------------------------------------------------
+# Paged search (serving layer): scan a PagedListStore's encoded pages
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits"))
+def _row_b_sum(centers, rotation, codebooks, codes, labels, pq_dim, pq_bits):
+    """Per-row list-side LUT half for freshly encoded rows: the SAME
+    B[l, s, c] table and Σ_s reduction as :func:`_compute_b_sum`, gathered
+    by each row's label — paged↔packed parity needs the aux bitwise
+    equal, not merely close. Subspace codebooks only (the serving store's
+    constraint)."""
+    n_lists = centers.shape[0]
+    n_codes = codebooks.shape[1]
+    dsub = codebooks.shape[2]
+    rot_dim = pq_dim * dsub
+    rc = (_pad_rot(centers, rot_dim) @ rotation.T).reshape(n_lists, pq_dim, dsub)
+    B = 2.0 * jnp.einsum("lsd,scd->lsc", rc, codebooks,
+                         preferred_element_type=jnp.float32)
+    B = B + jnp.sum(codebooks * codebooks, axis=2)[None]
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+    flat_idx = _codes_view(codes, pq_dim, pq_bits).astype(jnp.int32) + s_off
+    picked = jnp.take_along_axis(
+        B.reshape(n_lists, -1)[labels], flat_idx, axis=1)
+    return jnp.sum(picked, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "q_tile", "select_algo",
+                     "compute_dtype", "pq_dim", "pq_bits"),
+)
+def _paged_impl(
+    queries, centers, rotation, codebooks, pages, page_ids, page_aux, table,
+    filter, k, n_probes, metric, q_tile, select_algo, compute_dtype,
+    pq_dim, pq_bits,
+):
+    """Paged-store scan: the gather-backend LUT search
+    (:func:`_search_impl_jnp`) re-shaped over (page-table, page) instead of
+    a padded list axis. Every per-candidate op is kept identical so a
+    fully-compacted store is bit-parity with the packed scan; empty page
+    slots self-mask through the +inf aux (the packed padding convention)
+    and the ``ids >= 0`` validity mask covers tombstones. All operand
+    shapes derive from CAPACITY (page pool, table width) — appends and
+    tombstones re-dispatch this same program."""
+    _packing.PAGED_TRACES["count"] += 1  # runs at trace time only
+    q, dim = queries.shape
+    l2 = metric in ("sqeuclidean", "euclidean")
+    if l2:
+        coarse = dist_mod._expanded_distance(
+            queries, centers, "sqeuclidean", compute_dtype, "highest"
+        )
+    else:
+        coarse = -dist_mod.matmul_t(queries, centers, compute_dtype, "highest")
+    coarse_vals, probes = select_k(coarse, n_probes, select_min=True,
+                                   algo=select_algo)
+    n_codes = codebooks.shape[1]
+    luts = _query_luts(queries, rotation, codebooks, metric, jnp.float32)
+    luts = luts.reshape(q, -1)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)
+
+    def scan_tile(args):
+        q_lut, probe_blk, cvals_blk = args  # (qt, ·), (qt, p), (qt, p)
+        tbl = table[probe_blk]                        # (qt, p, W)
+        safe = jnp.maximum(tbl, 0)
+        codes = _codes_view(pages[safe], pq_dim, pq_bits) \
+            .astype(jnp.int32)                        # (qt, p, W, R, s)
+        ids = jnp.where(tbl[..., None] >= 0, page_ids[safe], -1)
+        flat_idx = codes + s_off[None, None, None, None, :]
+        picked = jax.vmap(lambda lut, idx: jnp.take(lut, idx, axis=0))(
+            q_lut, flat_idx)
+        d = jnp.sum(picked, axis=4) + page_aux[safe] \
+            + cvals_blk[:, :, None, None]
+        if l2:
+            d = jnp.maximum(d, 0.0)
+            if metric == "euclidean":
+                d = jnp.sqrt(d)
+        flat_ids = ids.reshape(ids.shape[0], -1)
+        d = d.reshape(flat_ids.shape)
+        valid = flat_ids >= 0
+        if filter is not None:
+            valid = valid & filter.test(flat_ids)
+        d = jnp.where(valid, d, jnp.inf)
+        vals, sel = select_k(d, k, select_min=True, algo=select_algo)
+        out_ids = jnp.where(jnp.isinf(vals), -1,
+                            jnp.take_along_axis(flat_ids, sel, axis=1))
+        return vals, out_ids
+
+    vals, ids = map_row_tiles(scan_tile, (luts, probes, coarse_vals), q_tile)
+    if not l2:
+        vals = -vals  # back to raw inner product (bigger = closer)
+    return vals, ids
+
+
+@traced("ivf_pq::search_paged")
+def search_paged(
+    store,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN over a mutable paged code store
+    (:class:`raft_tpu.serving.PagedListStore`, kind ``"ivf_pq"``): same
+    contract as :func:`search`, but the store keeps serving while rows
+    stream in/out — no repack, and steady-state mutations never recompile
+    this scan (its shapes depend only on store capacity)."""
+    if store.kind != "ivf_pq":
+        raise ValueError(f"expected an ivf_pq store, got {store.kind!r}")
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != store.dim:
+        raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, store.n_lists))
+    # one ATOMIC store snapshot: pool/table read separately could tear
+    # against a concurrent upsert's capacity growth
+    pages, page_ids, page_aux, table = store.scan_state()
+    width = int(table.shape[1])
+    if not 0 < k <= n_probes * width * store.page_rows:
+        raise ValueError(f"k={k} out of range")
+    if store.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    scan_attrs = None
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_pq.search_paged.queries", q_obs)
+        obs.add("ivf_pq.search_paged.probes", q_obs * n_probes)
+        scan_attrs = {"queries": q_obs, "probes": int(n_probes),
+                      "k": int(k), "table_width": width}
+    # the (qt, p, W, R, s) unpacked-code gather dominates the working set
+    per_query = max(1, n_probes * width * store.page_rows
+                    * (store.pq_dim * 5 + 8))
+    q_tile = int(max(1, min(queries.shape[0],
+                            res.workspace_bytes // per_query)))
+    with obs.record_span("ivf_pq::paged_scan", attrs=scan_attrs):
+        vals, ids = _paged_impl(
+            queries, store.centers, store.rotation, store.codebooks,
+            pages, page_ids, page_aux, table, filter,
+            int(k), n_probes, store.metric, q_tile, select_algo,
+            res.compute_dtype, store.pq_dim, store.pq_bits,
+        )
+    if store.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
     return vals, ids

@@ -1,0 +1,83 @@
+"""Serving layer: paged mutable IVF storage + SLO-aware dynamic batching.
+
+The subsystem that turns the repo's build-once/search-once bench shape
+into a system that serves streaming traffic (ROADMAP item 2): a
+:class:`PagedListStore` gives ivf_flat / ivf_pq indexes an online mutable
+storage layout — fixed-size pages per list, appended on
+:meth:`~PagedListStore.upsert`, tombstoned on
+:meth:`~PagedListStore.delete`, scanned without recompile, folded back to
+the packed snapshot layout by :meth:`~PagedListStore.compact` — and a
+:class:`QueryQueue` coalesces one-at-a-time requests with per-request
+deadlines into dynamically sized device batches under a latency SLO.
+
+Usage::
+
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_flat
+
+    index = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(n_lists=1024))
+    store = serving.PagedListStore.from_index(index)
+    store.upsert(new_vectors, new_ids)          # appends to tail pages
+    store.delete(stale_ids)                     # tombstones in place
+    vals, ids = serving.search(store, queries, k=10, n_probes=32)
+
+    queue = serving.QueryQueue(serving.searcher(store, k=10, n_probes=32),
+                               slo_s=0.05)
+    queue.start()
+    handle = queue.submit(one_query, timeout_s=0.2)
+    vals, ids = handle.result()
+    snapshot = store.compact()                  # packed index, v2-serializable
+"""
+
+from raft_tpu import obs
+from raft_tpu.core.trace import traced
+from raft_tpu.neighbors import _packing
+from raft_tpu.neighbors import ivf_flat as _ivf_flat
+from raft_tpu.neighbors import ivf_pq as _ivf_pq
+from raft_tpu.serving.batching import QueryQueue, RequestHandle
+from raft_tpu.serving.store import (
+    PAGE_ROWS_ENV,
+    PagedListStore,
+    default_page_rows,
+)
+
+
+@traced("serving::search")
+def search(store: PagedListStore, queries, k: int, n_probes: int = 20,
+           **kwargs):
+    """Search a paged store through its kind's paged scan path
+    (``ivf_flat.search_paged`` / ``ivf_pq.search_paged``)."""
+    mod = _ivf_flat if store.kind == "ivf_flat" else _ivf_pq
+    if obs.enabled():
+        obs.add("serving.searches")
+    return mod.search_paged(store, queries, k, n_probes=n_probes, **kwargs)
+
+
+def searcher(store: PagedListStore, k: int, n_probes: int = 20, **kwargs):
+    """A ``search_fn`` for :class:`QueryQueue`, closed over one store and
+    one search configuration."""
+
+    def run(queries):
+        return search(store, queries, k, n_probes=n_probes, **kwargs)
+
+    return run
+
+
+def scan_trace_count() -> int:
+    """Total (re)traces of the paged scan programs in this process (one
+    shared counter, `_packing.PAGED_TRACES`, bumped by every paged
+    backend) — the zero-recompile serving contract is asserted on deltas
+    of this counter."""
+    return _packing.paged_trace_count()
+
+
+__all__ = [
+    "PAGE_ROWS_ENV",
+    "PagedListStore",
+    "QueryQueue",
+    "RequestHandle",
+    "default_page_rows",
+    "scan_trace_count",
+    "search",
+    "searcher",
+]

@@ -1,0 +1,380 @@
+"""SLO-aware dynamic query batching: one-at-a-time in, device batches out.
+
+Production queries arrive one at a time; TPU throughput comes from
+batches. The :class:`QueryQueue` bridges the two: single requests with
+per-request deadlines (riding :class:`raft_tpu.resilience.Deadline`) are
+coalesced into device batches whose size is chosen dynamically under a
+latency SLO, dispatched through the existing search entry points, and the
+batched results demultiplexed back per request.
+
+Admission policy — **admit-until-deadline-pressure**: a forming batch
+keeps admitting queued requests while the tightest pending deadline still
+leaves room for one more dispatch (estimated from a per-bucket EWMA of
+measured batch latency). It dispatches as soon as (a) the pool hits the
+current batch cap, (b) the tightest deadline's slack falls below the
+estimated dispatch latency plus margin, or (c) the oldest request has
+waited ``fill_wait_s`` — so light traffic pays at most ``fill_wait_s``
+extra latency and heavy traffic gets full batches.
+
+Batch shapes are drawn from a small power-of-two **bucket ladder**
+(1, 2, 4, …, ``max_batch``) so a lifetime of arbitrary traffic compiles
+O(log max_batch) search programs — the Memory Safe Computations concern
+(PAPERS.md): batch-size changes must not blow HBM or recompile.
+
+Failure semantics (standing gates): the dispatch carries the
+``serving.queue.dispatch`` faultpoint; an expired request is drained with
+a **classified DEADLINE verdict** (never a fleet failure), an
+OOM-classified dispatch **halves the batch cap** and requeues (adaptive
+degradation, ``degrade_on_oom`` style), a TRANSIENT dispatch retries
+once, and a FATAL error is delivered — classified — to exactly the
+requests in that batch while the queue keeps serving.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu import obs, resilience
+from raft_tpu.resilience.deadline import DeadlineExceeded
+from raft_tpu.resilience.retry import record_event
+
+_OK = "ok"
+
+
+class _Request:
+    __slots__ = ("query", "t_arrive", "t_deadline", "event", "vals", "ids",
+                 "verdict", "error", "retries", "_latency_s")
+
+    def __init__(self, query: np.ndarray, t_arrive: float, t_deadline: float):
+        self.query = query
+        self.t_arrive = t_arrive
+        self.t_deadline = t_deadline
+        self.event = threading.Event()
+        self.vals = None
+        self.ids = None
+        self.verdict: Optional[str] = None  # "ok" | resilience kind
+        self.error: Optional[BaseException] = None
+        self.retries = 0
+
+
+class RequestHandle:
+    """Caller-side view of one submitted query."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def done(self) -> bool:
+        return self._req.event.is_set()
+
+    @property
+    def verdict(self) -> Optional[str]:
+        """``"ok"``, a :mod:`raft_tpu.resilience` failure kind, or None
+        while pending."""
+        return self._req.verdict
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return getattr(self._req, "_latency_s", None)
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the per-request ``(distances, indices)`` rows.
+        Raises :class:`~raft_tpu.resilience.DeadlineExceeded` on a
+        DEADLINE verdict and the classified original error otherwise."""
+        if not self._req.event.wait(timeout):
+            raise TimeoutError("request still pending")
+        if self._req.verdict == _OK:
+            return self._req.vals, self._req.ids
+        if self._req.verdict == resilience.DEADLINE:
+            raise self._req.error or DeadlineExceeded(
+                "DEADLINE_EXCEEDED: request expired in queue")
+        raise self._req.error
+
+
+def _buckets(max_batch: int) -> List[int]:
+    out = [1]
+    while out[-1] < max_batch:
+        out.append(min(out[-1] * 2, max_batch))
+    return out
+
+
+class QueryQueue:
+    """Host-side request queue + dynamic batcher over one search callable.
+
+    ``search_fn(queries_2d) -> (distances, indices)`` is any existing
+    search entry point closed over its index/store and parameters —
+    :func:`raft_tpu.serving.searcher` builds the paged-store one.
+
+    Drive it either with the background worker (:meth:`start` /
+    :meth:`stop`) or synchronously (:meth:`pump` in a caller loop — what
+    the bench's arrival simulator and the deterministic tier-1 tests do).
+    """
+
+    def __init__(self, search_fn: Callable, *,
+                 slo_s: float = 0.05,
+                 max_batch: int = 64,
+                 fill_wait_s: Optional[float] = None,
+                 default_timeout_s: Optional[float] = None,
+                 pressure_margin_s: float = 0.002):
+        self._search_fn = search_fn
+        self.slo_s = float(slo_s)
+        self.max_batch = int(max_batch)
+        self.buckets = _buckets(self.max_batch)
+        self.fill_wait_s = (float(fill_wait_s) if fill_wait_s is not None
+                            else self.slo_s / 2.0)
+        self.default_timeout_s = default_timeout_s
+        self.pressure_margin_s = float(pressure_margin_s)
+        self._pending: deque = deque()
+        self._cv = threading.Condition()
+        self._lat_ewma: Dict[int, float] = {}  # bucket -> s
+        self._batch_cap = self.max_batch  # halved on OOM
+        self._worker: Optional[threading.Thread] = None
+        self._stopping = False
+        self.batches = 0
+        self.multi_batches = 0
+
+    # -- intake -------------------------------------------------------------
+    def submit(self, query, timeout_s: Optional[float] = None) -> RequestHandle:
+        """Enqueue one query; returns immediately with a handle. The
+        request's deadline is ``now + timeout_s`` (or the queue default;
+        no deadline when both are None)."""
+        q = np.asarray(query, np.float32).reshape(-1)
+        now = time.monotonic()
+        t = timeout_s if timeout_s is not None else self.default_timeout_s
+        req = _Request(q, now, now + t if t is not None else math.inf)
+        with obs.record_span("serving::submit"):
+            with self._cv:
+                self._pending.append(req)
+                depth = len(self._pending)
+                self._cv.notify()
+        if obs.enabled():
+            obs.add("serving.queue.submits")
+            obs.observe("serving.queue.depth", depth)
+        return RequestHandle(req)
+
+    # -- policy -------------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _est_latency(self, bucket: int) -> Optional[float]:
+        if bucket in self._lat_ewma:
+            return self._lat_ewma[bucket]
+        known = [v for b, v in self._lat_ewma.items() if b <= bucket]
+        return max(known) if known else None
+
+    def _expire_locked(self, now: float) -> List[_Request]:
+        """Pop requests that are already past deadline (partial drain)."""
+        expired = []
+        keep = deque()
+        for req in self._pending:
+            (expired if req.t_deadline <= now else keep).append(req)
+        self._pending = keep
+        return expired
+
+    def _ready_locked(self, now: float) -> bool:
+        depth = len(self._pending)
+        if depth == 0:
+            return False
+        cap = max(1, self._batch_cap)
+        if depth >= cap:
+            return True
+        oldest = min(r.t_arrive for r in self._pending)
+        if now - oldest >= self.fill_wait_s:
+            return True
+        est = self._est_latency(self._bucket_for(min(depth, cap)))
+        if est is None:
+            # nothing measured yet: assume a dispatch costs a fraction of
+            # the SLO (eagerly dispatching instead would burn the warmup
+            # window on batch-1 programs)
+            est = self.slo_s / 4.0
+        tightest = min(r.t_deadline for r in self._pending)
+        if tightest - now <= est + self.pressure_margin_s:
+            return True  # deadline pressure: admit no further, go now
+        return False
+
+    # -- dispatch -----------------------------------------------------------
+    def pump(self, now: Optional[float] = None) -> bool:
+        """One scheduler step: drain expired requests, and dispatch one
+        batch if the admission policy says go. Returns True when it did
+        either (the caller loop's idle signal)."""
+        now = time.monotonic() if now is None else now
+        with self._cv:
+            expired = self._expire_locked(now)
+            batch: List[_Request] = []
+            if self._ready_locked(now):
+                cap = max(1, self._batch_cap)
+                while self._pending and len(batch) < cap:
+                    batch.append(self._pending.popleft())
+        for req in expired:
+            self._finish_deadline(req, "expired in queue")
+        if batch:
+            self._dispatch(batch)
+        return bool(expired or batch)
+
+    def _finish_deadline(self, req: _Request, why: str) -> None:
+        req.verdict = resilience.DEADLINE
+        req.error = DeadlineExceeded(f"DEADLINE_EXCEEDED: request {why}")
+        req._latency_s = time.monotonic() - req.t_arrive
+        obs.add("serving.requests.deadline")
+        req.event.set()
+
+    def _finish_error(self, req: _Request, kind: str, err: BaseException) -> None:
+        req.verdict = kind
+        req.error = err
+        req._latency_s = time.monotonic() - req.t_arrive
+        obs.add(f"serving.requests.{kind.lower()}")
+        req.event.set()
+
+    def _requeue_front(self, reqs: List[_Request]) -> None:
+        with self._cv:
+            for req in reversed(reqs):
+                self._pending.appendleft(req)
+            self._cv.notify()
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        n = len(batch)
+        bucket = self._bucket_for(n)
+        qarr = np.stack([r.query for r in batch])
+        if bucket != n:
+            # pad with copies of row 0: a real vector (not zeros) so the
+            # padded rows cannot produce NaN/inf surprises in the scan
+            qarr = np.concatenate(
+                [qarr, np.repeat(qarr[:1], bucket - n, axis=0)])
+        now = time.monotonic()
+        budget = min(r.t_deadline for r in batch) - now
+        attrs = None
+        if obs.enabled():
+            attrs = {"batch": n, "bucket": bucket,
+                     "cap": self._batch_cap}
+        try:
+            with obs.record_span("serving::dispatch", attrs=attrs):
+                resilience.faultpoint("serving.queue.dispatch")
+                with resilience.Deadline(max(budget, 0.0),
+                                         label="serving.dispatch"):
+                    vals, ids = self._search_fn(qarr)
+                    # force completion INSIDE the deadline scope: a result
+                    # is only served once it is actually materialized
+                    vals = np.asarray(vals)
+                    ids = np.asarray(ids)
+        except Exception as e:
+            self._on_dispatch_error(batch, e, resilience.classify(e))
+            return
+        dt = time.monotonic() - now
+        prev = self._lat_ewma.get(bucket)
+        self._lat_ewma[bucket] = dt if prev is None else 0.7 * prev + 0.3 * dt
+        self.batches += 1
+        if n > 1:
+            self.multi_batches += 1
+        if obs.enabled():
+            obs.observe("serving.batch_latency_s", dt)
+            obs.observe("serving.batch.size", n)
+            obs.add("serving.batches")
+            if n > 1:
+                obs.add("serving.batches.multi")
+        done = time.monotonic()
+        for i, req in enumerate(batch):
+            req.vals = vals[i]
+            req.ids = ids[i]
+            req.verdict = _OK
+            req._latency_s = done - req.t_arrive
+            if obs.enabled():
+                obs.observe("serving.request_latency_s", req._latency_s)
+            req.event.set()
+        if obs.enabled():
+            obs.add("serving.requests.ok", n)
+
+    def _on_dispatch_error(self, batch: List[_Request], e: Exception,
+                           kind: str) -> None:
+        obs.add(f"serving.dispatch.{kind.lower()}")
+        record_event("serving_dispatch_error", kind=kind, batch=len(batch),
+                     error=repr(e)[:200])
+        now = time.monotonic()
+        if kind == resilience.OOM and self._batch_cap > 1:
+            # adaptive degradation: halve the cap and requeue — the next
+            # pumps re-dispatch the same requests in smaller batches
+            self._batch_cap = max(1, self._batch_cap // 2)
+            obs.add("serving.dispatch.oom_halved")
+            record_event("serving_batch_halved", cap=self._batch_cap)
+            self._requeue_front(batch)
+            return
+        if kind in (resilience.DEADLINE, resilience.TRANSIENT):
+            # partial drain: requests already past deadline get their
+            # DEADLINE verdict; survivors retry once, then fail classified
+            retry = []
+            for req in batch:
+                if req.t_deadline <= now or (kind == resilience.DEADLINE
+                                             and req.retries >= 1):
+                    self._finish_deadline(req, "deadline during dispatch")
+                elif req.retries >= 1:
+                    self._finish_error(req, kind, e)
+                else:
+                    req.retries += 1
+                    retry.append(req)
+            if retry:
+                self._requeue_front(retry)
+            return
+        for req in batch:  # OOM-at-cap-1 and FATAL: deliver classified
+            self._finish_error(req, kind, e)
+
+    # -- worker -------------------------------------------------------------
+    def start(self) -> None:
+        """Run the scheduler on a daemon worker thread."""
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stopping = False
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="raft-tpu-serving", daemon=True)
+        self._worker.start()
+
+    def _serve_loop(self) -> None:
+        while not self._stopping:
+            if self.pump():
+                continue
+            with self._cv:
+                if self._stopping:
+                    break
+                # wake on submit, or poll at a fraction of the fill wait
+                self._cv.wait(timeout=max(self.fill_wait_s / 4, 1e-3))
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the worker; by default first drains queued requests."""
+        if drain:
+            self.drain(timeout=timeout)
+        self._stopping = True
+        with self._cv:
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+            self._worker = None
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Serve until the queue is empty (worker running or not)."""
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            with self._cv:
+                empty = not self._pending
+            if empty:
+                return
+            if self._worker is None or not self._worker.is_alive():
+                self.pump()
+            else:
+                time.sleep(1e-3)
+        raise TimeoutError(f"queue did not drain within {timeout}s")
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    @property
+    def batch_cap(self) -> int:
+        """Current adaptive batch-size cap (halved by OOM dispatches)."""
+        return self._batch_cap

@@ -1,0 +1,637 @@
+"""Paged mutable IVF storage: fixed-size pages, append-only growth.
+
+The build-once packed layout (neighbors/_packing.py) is immutable by
+design — ``extend()`` repacks the whole index, and any change to
+``max_list_size`` reshapes every scan operand and recompiles every search
+program. Production serving needs the opposite: streaming upserts and
+deletes against an index that keeps answering queries, with no repacking
+and no recompiles on the mutation path.
+
+The storage pattern is the Ragged Paged Attention TPU kernel's
+(PAPERS.md): each ragged sequence — here, each IVF list — owns a chain of
+**fixed-size pages** referenced through a page table. Growth appends to
+the list's tail page (allocating a fresh page from a free list when the
+tail fills); deletion tombstones the row in place (``page_ids == -1``);
+the scan walks the page table with masked fill-count tails. Because every
+device operand — the page pool ``(capacity_pages, page_rows, ·)``, the
+page-id/aux pools, and the ``(n_lists, table_width)`` page table — has a
+shape that depends only on *capacity*, not on *fill*, steady-state
+upserts/deletes/searches re-dispatch the same compiled programs. Only
+capacity growth (page pool doubling, table-width doubling — both
+geometric, so O(log n) events over a store's lifetime) retraces. The
+Memory Safe Computations line (PAPERS.md) is honored the same way: the
+paged scan's working set is bounded by the static ``(n_probes ×
+table_width × page_rows)`` gather, sized against the Resources workspace
+budget exactly like the packed gather scan.
+
+Two page payloads, one mechanism:
+
+* ``kind="ivf_flat"`` — pages hold raw vectors (same dtype as the
+  template index's ``list_data``); per-row aux is the cached L2 norm.
+* ``kind="ivf_pq"`` — pages hold packed PQ codes encoded with the
+  template index's frozen quantizers (centers/rotation/codebooks); per-row
+  aux is the list-side LUT half (``b_sum``), bit-identical to the packed
+  build's (the same ``_compute_b_sum`` formula, gathered per row).
+
+``compact()`` folds the live rows back into the packed representation
+(an :class:`~raft_tpu.neighbors.ivf_flat.IvfFlatIndex` /
+:class:`~raft_tpu.neighbors.ivf_pq.IvfPqIndex`), which serializes through
+the crash-safe v2 snapshot container — the paged store itself is a
+serving-time structure and never hits disk directly.
+
+Parity contract (tier-1 enforced): on a store holding exactly the packed
+index's rows, ``search_paged`` returns bit-identical top-k ids to the
+packed gather scan, and any interleaving of upsert/delete/compact matches
+a from-scratch packed build over the surviving rows.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs, resilience
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
+from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
+from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+from raft_tpu.neighbors._packing import pack_lists
+from raft_tpu.ops import distance as dist_mod
+
+PAGE_ROWS_ENV = "RAFT_TPU_SERVING_PAGE_ROWS"
+_DEFAULT_PAGE_ROWS = 128
+
+
+def default_page_rows() -> int:
+    """Page height: env-tunable (``RAFT_TPU_SERVING_PAGE_ROWS``), default
+    128 — small enough that a near-empty list wastes one page, large
+    enough that the per-page gather rides full VPU lanes."""
+    return max(8, int(os.environ.get(PAGE_ROWS_ENV, _DEFAULT_PAGE_ROWS)))
+
+
+def _pow2_at_least(v: int) -> int:
+    return 1 << max(0, int(v - 1).bit_length())
+
+
+@jax.jit
+def _tombstone(page_ids, pp, rr):
+    """Scatter -1 into (pp, rr) slots; sentinel coords >= capacity drop."""
+    return page_ids.at[pp, rr].set(-1, mode="drop")
+
+
+def _scatter_rows(pages, page_ids, page_aux, payload, ids, aux, pp, rr):
+    """Append scatter: one dispatch per (bucketed) chunk. Padded entries
+    carry ``pp == capacity`` which ``mode="drop"`` discards. jit'd below —
+    kept un-donated: on a failed dispatch the caller's arrays must stay
+    valid (upsert commits host metadata only after the scatter lands)."""
+    pages = pages.at[pp, rr].set(payload, mode="drop")
+    page_ids = page_ids.at[pp, rr].set(ids, mode="drop")
+    page_aux = page_aux.at[pp, rr].set(aux, mode="drop")
+    return pages, page_ids, page_aux
+
+
+_scatter_rows = jax.jit(_scatter_rows)
+
+
+@jax.jit
+def _flat_row_aux(rows):
+    """Per-row L2 norms, the same reduction the packed build applies to
+    ``list_data`` (`sqnorm(..., axis=2)` is row-wise too) — parity needs
+    the aux bitwise equal, not just close."""
+    return dist_mod.sqnorm(rows)
+
+
+class PagedListStore:
+    """Mutable paged IVF storage over a frozen coarse quantizer.
+
+    Created from a built packed index (:meth:`from_index`), which donates
+    its centers — and for PQ its rotation/codebooks — as the frozen
+    quantizers. Rows then stream in through :meth:`upsert` and out through
+    :meth:`delete`; :func:`search_paged` (ivf_flat / ivf_pq) scans the
+    pages; :meth:`compact` folds back to the packed layout.
+
+    Thread safety: mutations and the table snapshot take ``_lock``; the
+    device scan reads immutable array snapshots, so searches may overlap
+    mutations (a search sees the store as of its table snapshot).
+    """
+
+    def __init__(self, kind: str, centers, metric: str, *,
+                 page_rows: Optional[int] = None,
+                 payload_width: int, payload_dtype,
+                 rotation=None, codebooks=None, pq_bits: int = 8,
+                 pq_dim: int = 0, codebook_kind: str = "subspace",
+                 initial_pages: int = 0,
+                 res: Optional[Resources] = None):
+        if kind not in ("ivf_flat", "ivf_pq"):
+            raise ValueError(f"unknown store kind {kind!r}")
+        if kind == "ivf_pq" and codebook_kind != "subspace":
+            raise ValueError(
+                "paged ivf_pq serving supports codebook_kind='subspace' "
+                "only (the per-cluster LUT scan has no paged path yet)")
+        self.kind = kind
+        self.metric = metric
+        self.centers = jnp.asarray(centers)
+        self.rotation = None if rotation is None else jnp.asarray(rotation)
+        self.codebooks = None if codebooks is None else jnp.asarray(codebooks)
+        self.pq_bits = int(pq_bits)
+        self.pq_dim = int(pq_dim)
+        self.codebook_kind = codebook_kind
+        self.page_rows = int(page_rows or default_page_rows())
+        self._res = res or current_resources()
+        self._lock = threading.RLock()
+
+        n_lists = int(self.centers.shape[0])
+        cap = max(8, _pow2_at_least(initial_pages or n_lists))
+        R = self.page_rows
+        self.pages = jnp.zeros((cap, R, payload_width), payload_dtype)
+        self.page_ids = jnp.full((cap, R), -1, jnp.int32)
+        # aux init +inf: matches the packed b_sum's +inf-at-padding
+        # convention (the flat scan masks on ids, so +inf is inert there)
+        self.page_aux = jnp.full((cap, R), jnp.inf, jnp.float32)
+
+        self._table = np.full((n_lists, 4), -1, np.int32)
+        self._list_pages = np.zeros(n_lists, np.int32)  # chain length
+        self._fill = np.zeros(cap, np.int32)  # rows ever appended per page
+        self._page_list = np.full(cap, -1, np.int32)  # owning list, -1 free
+        self._free: List[int] = list(range(cap))
+        self._id_loc: Dict[int, Tuple[int, int]] = {}
+        self._tombstones = 0
+        self._dev_table = None  # device mirror, invalidated on table change
+        self._growths = 0
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_index(cls, index, *, page_rows: Optional[int] = None,
+                   include_rows: bool = True,
+                   res: Optional[Resources] = None) -> "PagedListStore":
+        """Wrap a built packed index: its quantizers become the store's
+        frozen quantizers, and (by default) its live rows are paged in —
+        in packed list order, so a freshly wrapped store is scan-parity
+        with the index it came from."""
+        res = res or current_resources()
+        if isinstance(index, ivf_flat_mod.IvfFlatIndex):
+            store = cls(
+                "ivf_flat", index.centers, index.metric, page_rows=page_rows,
+                payload_width=int(index.list_data.shape[2]),
+                payload_dtype=index.list_data.dtype, res=res)
+        elif isinstance(index, ivf_pq_mod.IvfPqIndex):
+            store = cls(
+                "ivf_pq", index.centers, index.metric, page_rows=page_rows,
+                payload_width=int(index.list_codes.shape[2]),
+                payload_dtype=index.list_codes.dtype,
+                rotation=index.rotation, codebooks=index.codebooks,
+                pq_bits=index.pq_bits, pq_dim=index.pq_dim,
+                codebook_kind=index.codebook_kind, res=res)
+        else:
+            raise TypeError(f"unsupported index type {type(index).__name__}")
+        if include_rows:
+            store._ingest_packed(index)
+        return store
+
+    def _ingest_packed(self, index) -> None:
+        """Bulk-append the packed index's live rows, per-list in slot
+        order (the arrival order a from-scratch upsert stream would have
+        produced). Payloads and aux are copied, not recomputed: the packed
+        build's values ARE the parity reference."""
+        if self.kind == "ivf_flat":
+            payload3, ids2 = index.list_data, index.list_ids
+            aux2 = index.list_norms
+            if aux2 is None:
+                aux2 = jnp.zeros_like(ids2, jnp.float32)
+        else:
+            payload3, ids2, aux2 = index.list_codes, index.list_ids, index.b_sum
+        ids_np = np.asarray(ids2)
+        n_lists, max_size = ids_np.shape
+        flat_valid = ids_np.reshape(-1) >= 0
+        labels_np = np.repeat(np.arange(n_lists, dtype=np.int32), max_size)
+        sel = np.nonzero(flat_valid)[0]
+        payload = jnp.reshape(payload3, (-1,) + payload3.shape[2:])[sel]
+        aux = jnp.reshape(aux2, (-1,))[sel]
+        self._append(payload, ids_np.reshape(-1)[sel], aux, labels_np[sel])
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def n_lists(self) -> int:
+        return int(self.centers.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centers.shape[1])
+
+    @property
+    def capacity_pages(self) -> int:
+        return int(self.pages.shape[0])
+
+    @property
+    def size(self) -> int:
+        """Live (non-tombstoned) rows."""
+        return len(self._id_loc)
+
+    @property
+    def tombstones(self) -> int:
+        return self._tombstones
+
+    @property
+    def pages_used(self) -> int:
+        return self.capacity_pages - len(self._free)
+
+    @property
+    def table_width(self) -> int:
+        return int(self._table.shape[1])
+
+    @property
+    def growth_events(self) -> int:
+        """Capacity growths (page pool or table width) since creation —
+        each one retraces the scan; steady-state serving should hold at 0."""
+        return self._growths
+
+    def stats(self) -> dict:
+        with self._lock:
+            used = self.pages_used
+            return {
+                "kind": self.kind, "rows": self.size,
+                "tombstones": self._tombstones, "pages_used": used,
+                "capacity_pages": self.capacity_pages,
+                "page_rows": self.page_rows,
+                "table_width": self.table_width,
+                "fill_fraction": (self.size / max(1, used * self.page_rows)),
+                "growth_events": self._growths,
+            }
+
+    def device_table(self):
+        """Device mirror of the page table (rebuilt only after a table
+        mutation — searches between mutations reuse the same array, so
+        the scan's operand identity is stable)."""
+        with self._lock:
+            if self._dev_table is None:
+                self._dev_table = jnp.asarray(self._table)
+            return self._dev_table
+
+    def scan_state(self):
+        """One ATOMIC ``(pages, page_ids, page_aux, table)`` snapshot for
+        the paged scans. Mutators reassign these arrays under the lock;
+        reading them as separate unlocked attribute accesses could pair a
+        post-growth table with a pre-growth page pool (a torn snapshot
+        that scores candidates against the wrong payload), so searches
+        must come through here."""
+        with self._lock:
+            if self._dev_table is None:
+                self._dev_table = jnp.asarray(self._table)
+            return self.pages, self.page_ids, self.page_aux, self._dev_table
+
+    # -- capacity -----------------------------------------------------------
+    def _grow_pages(self, min_pages: int) -> None:
+        old = self.capacity_pages
+        new = old
+        while new < min_pages:
+            new *= 2
+        if new == old:
+            return
+        pad = new - old
+        self.pages = jnp.concatenate(
+            [self.pages, jnp.zeros((pad,) + self.pages.shape[1:],
+                                   self.pages.dtype)])
+        self.page_ids = jnp.concatenate(
+            [self.page_ids, jnp.full((pad, self.page_rows), -1, jnp.int32)])
+        self.page_aux = jnp.concatenate(
+            [self.page_aux, jnp.full((pad, self.page_rows), jnp.inf,
+                                     jnp.float32)])
+        self._fill = np.concatenate([self._fill, np.zeros(pad, np.int32)])
+        self._page_list = np.concatenate(
+            [self._page_list, np.full(pad, -1, np.int32)])
+        self._free.extend(range(old, new))
+        self._growths += 1
+        obs.add("serving.store.capacity_growth")
+        resilience.record_event("serving_capacity_growth",
+                                pages_from=old, pages_to=new)
+
+    def _grow_table(self, min_width: int) -> None:
+        old_w = self.table_width
+        new_w = _pow2_at_least(max(min_width, old_w + 1))
+        grown = np.full((self.n_lists, new_w), -1, np.int32)
+        grown[:, :old_w] = self._table
+        self._table = grown
+        self._dev_table = None
+        self._growths += 1
+        obs.add("serving.store.table_growth")
+
+    def reserve(self, n_rows: int, skew_factor: int = 4) -> None:
+        """Pre-size capacity for ``n_rows`` additional rows, so a serving
+        window of known load pays its growth retraces up front, not
+        mid-traffic: the page pool for the worst case (every list's tail
+        page full), and the page-table width for a ``skew_factor``×-mean
+        per-list load (the packed layout's auto-list-cap allowance). A
+        stream more skewed than that still grows — and retraces — later."""
+        with self._lock:
+            need = -(-int(n_rows) // self.page_rows) + self.n_lists
+            self._grow_pages(self.pages_used + need)
+            total = self.size + int(n_rows)
+            mean_rows = -(-total // self.n_lists)
+            per_list = -(-mean_rows * skew_factor // self.page_rows) + 1
+            # a list already at the current width would widen — and
+            # retrace — on its very next page: budget the longest existing
+            # chain plus this reservation's worst single-list share
+            longest = int(self._list_pages.max()) if self.n_lists else 0
+            per_list = max(per_list,
+                           longest + -(-int(n_rows) //
+                                       (self.n_lists * self.page_rows)) + 1)
+            if per_list > self.table_width:
+                self._grow_table(per_list)
+
+    # -- allocation (host) --------------------------------------------------
+    def _alloc_slots(self, labels_np: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Assign a (page, row) slot to each new row: the owning list's
+        tail page while it has room, then fresh pages from the free list.
+        Pure host bookkeeping — the device scatter consumes the coords.
+
+        Vectorized per (list, page) rather than per row (a 10M-row ingest
+        would otherwise spend minutes in an interpreted loop): rows are
+        grouped by label with one stable sort — batch order within each
+        list is preserved, so slot assignment is identical to a row-at-a-
+        time walk — and each group is carved into contiguous page runs."""
+        labels_np = np.asarray(labels_np)
+        n = labels_np.shape[0]
+        pp = np.empty(n, np.int64)
+        rr = np.empty(n, np.int64)
+        order = np.argsort(labels_np, kind="stable")
+        uniq, starts = np.unique(labels_np[order], return_index=True)
+        bounds = np.append(starts[1:], n)
+        page_rows = self.page_rows
+        for lab, s, e in zip(uniq.tolist(), starts.tolist(), bounds.tolist()):
+            idxs = order[s:e]
+            cnt = e - s
+            pos = 0
+            while pos < cnt:
+                count = int(self._list_pages[lab])
+                tail = int(self._table[lab, count - 1]) if count else -1
+                if tail < 0 or self._fill[tail] >= page_rows:
+                    if not self._free:
+                        self._grow_pages(self.capacity_pages + 1)
+                    tail = self._free.pop()
+                    if count >= self.table_width:
+                        self._grow_table(count + 1)
+                    self._table[lab, count] = tail
+                    self._list_pages[lab] = count + 1
+                    self._page_list[tail] = lab
+                    self._dev_table = None
+                take = min(cnt - pos, page_rows - int(self._fill[tail]))
+                sel = idxs[pos:pos + take]
+                pp[sel] = tail
+                rr[sel] = int(self._fill[tail]) + np.arange(take)
+                self._fill[tail] += take
+                pos += take
+        return pp, rr
+
+    # -- mutation -----------------------------------------------------------
+    def _assign_labels(self, work) -> np.ndarray:
+        km_metric = ("inner_product"
+                     if self.metric in ("cosine", "inner_product")
+                     else "sqeuclidean")
+        labels = kmeans_balanced.predict(
+            work, self.centers,
+            kmeans_balanced.KMeansBalancedParams(metric=km_metric),
+            res=self._res)
+        return np.asarray(labels)
+
+    def _prepare_payload(self, work, labels_np):
+        """(payload, aux) rows for the store's page dtype — the same math
+        the packed build applies, so compact()/parity hold bitwise."""
+        if self.kind == "ivf_flat":
+            if jnp.issubdtype(self.pages.dtype, jnp.integer):
+                info = jnp.iinfo(self.pages.dtype)
+                payload = jnp.clip(jnp.round(work), info.min, info.max) \
+                    .astype(self.pages.dtype)
+            else:
+                payload = work.astype(self.pages.dtype)
+            if self.metric in ("sqeuclidean", "euclidean"):
+                aux = _flat_row_aux(payload)
+            else:
+                aux = jnp.zeros((work.shape[0],), jnp.float32)
+            return payload, aux
+        labels = jnp.asarray(labels_np)
+        resid = ivf_pq_mod._pad_rot(work - self.centers[labels],
+                                    self.rotation.shape[0]) @ self.rotation.T
+        dsub = self.codebooks.shape[2]
+        resid3 = resid.reshape(work.shape[0], self.pq_dim, dsub)
+        codes = ivf_pq_mod._encode(resid3, self.codebooks)
+        payload = ivf_pq_mod.pack_codes(codes, self.pq_bits)
+        if self.metric in ("sqeuclidean", "euclidean"):
+            aux = ivf_pq_mod._row_b_sum(
+                self.centers, self.rotation, self.codebooks, payload, labels,
+                self.pq_dim, self.pq_bits)
+        else:
+            # inner-product metrics carry no list-side term (the packed
+            # b_sum is zeros at valid entries)
+            aux = jnp.zeros((work.shape[0],), jnp.float32)
+        return payload, aux
+
+    @traced("serving::upsert")
+    def upsert(self, vectors, ids=None) -> dict:
+        """Insert (or replace, by id) rows: assign each to its nearest
+        centroid and append to that list's tail page. No repacking — the
+        page pool/table shapes are untouched unless capacity itself grows.
+
+        Returns ``{"upserts": n, "replaced": r, "growths": g}``.
+        """
+        vectors = jnp.asarray(vectors)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dim:
+            raise ValueError(
+                f"vectors must be (n, {self.dim}), got {vectors.shape}")
+        n = int(vectors.shape[0])
+        if n == 0:
+            return {"upserts": 0, "replaced": 0, "growths": 0}
+        work = vectors.astype(jnp.float32)
+        if self.metric == "cosine":
+            work = work / jnp.maximum(
+                jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+        if ids is None:
+            start = (max(self._id_loc) + 1) if self._id_loc else 0
+            ids_np = np.arange(start, start + n, dtype=np.int64)
+        else:
+            ids_np = np.asarray(ids, np.int64)
+            if ids_np.shape != (n,):
+                raise ValueError(f"ids must be ({n},), got {ids_np.shape}")
+            if len(set(ids_np.tolist())) != n:
+                raise ValueError("duplicate ids within one upsert batch")
+        if n and (ids_np.min() < 0 or ids_np.max() >= 2**31 - 1):
+            raise ValueError("ids must fit int32 and be >= 0")
+
+        labels_np = self._assign_labels(work)
+        payload, aux = self._prepare_payload(work, labels_np)
+
+        with self._lock:
+            # replaced ids: capture the OLD slots now, tombstone them only
+            # AFTER the append lands — tombstoning first would turn a
+            # failed append (FATAL fault, dispatch error) into silent data
+            # loss of the previous versions. The append overwrites the id
+            # map, so a search between commit points sees the new rows.
+            old_locs = [self._id_loc[int(i)] for i in ids_np
+                        if int(i) in self._id_loc]
+            replaced = len(old_locs)
+            g0 = self._growths
+            done = [0]  # survives degrade retries: landed chunks stay landed
+
+            def append_chunk(chunk_rows: int):
+                while done[0] < n:
+                    resilience.faultpoint("serving.store.upsert")
+                    s = done[0]
+                    e = min(n, s + chunk_rows)
+                    self._append(payload[s:e], ids_np[s:e], aux[s:e],
+                                 labels_np[s:e])
+                    done[0] = e
+                return n
+
+            # OOM-degraded append: a too-large scatter chunk halves down
+            # to a page at a time (standing gate: every failure-prone
+            # dispatch path recovers or classifies)
+            resilience.degrade_on_oom(
+                append_chunk, max(n, 1), floor=min(n, self.page_rows) or 1,
+                site="serving.store.upsert")
+            if old_locs:
+                self._tombstone_slots(old_locs)
+            growths = self._growths - g0
+        if obs.enabled():
+            obs.add("serving.store.upserts", n)
+            if replaced:
+                obs.add("serving.store.replaced", replaced)
+        return {"upserts": n, "replaced": replaced, "growths": growths}
+
+    def _append(self, payload, ids_np, aux, labels_np) -> None:
+        """Allocate slots and scatter one chunk (lock held). The scatter
+        is padded to a power-of-two row count so a lifetime of arbitrary
+        upsert batch sizes compiles O(log max_batch) programs, not one
+        per distinct size."""
+        m = int(payload.shape[0])
+        if m == 0:
+            return
+        ids_np = np.asarray(ids_np, np.int64)
+        pp, rr = self._alloc_slots(np.asarray(labels_np))
+        ids_dev = jnp.asarray(ids_np)
+        bucket = _pow2_at_least(m)
+        if bucket != m:
+            pad = bucket - m
+            # sentinel page == capacity: out of bounds, mode="drop"
+            pp = np.concatenate([pp, np.full(pad, self.capacity_pages)])
+            rr = np.concatenate([rr, np.zeros(pad, np.int64)])
+            payload = jnp.concatenate([payload, jnp.zeros(
+                (pad,) + payload.shape[1:], payload.dtype)])
+            ids_dev = jnp.concatenate(
+                [ids_dev, jnp.zeros((pad,), ids_dev.dtype)])
+            aux = jnp.concatenate([aux, jnp.zeros((pad,), aux.dtype)])
+        pages, page_ids, page_aux = _scatter_rows(
+            self.pages, self.page_ids, self.page_aux,
+            payload, ids_dev.astype(jnp.int32), aux.astype(jnp.float32),
+            jnp.asarray(pp), jnp.asarray(rr))
+        # commit device state first, host map second: a raise above leaves
+        # the store exactly as it was (slots burned in _fill are padding)
+        self.pages, self.page_ids, self.page_aux = pages, page_ids, page_aux
+        for i in range(m):
+            self._id_loc[int(ids_np[i])] = (int(pp[i]), int(rr[i]))
+
+    def _tombstone_slots(self, locs: List[Tuple[int, int]]) -> None:
+        """Mark (page, row) slots dead in place (lock held): ``page_ids``
+        -1 there. Slots are never reused — compact() reclaims them."""
+        pp = np.array([p for p, _ in locs], np.int64)
+        rr = np.array([r for _, r in locs], np.int64)
+        bucket = _pow2_at_least(len(locs))
+        if bucket != len(locs):
+            pad = bucket - len(locs)
+            pp = np.concatenate([pp, np.full(pad, self.capacity_pages)])
+            rr = np.concatenate([rr, np.zeros(pad, np.int64)])
+        self.page_ids = _tombstone(self.page_ids, jnp.asarray(pp),
+                                   jnp.asarray(rr))
+        self._tombstones += len(locs)
+
+    def _tombstone_ids(self, present: List[int]) -> int:
+        """Tombstone rows by id and drop them from the id map (lock held)."""
+        if not present:
+            return 0
+        self._tombstone_slots([self._id_loc[i] for i in present])
+        for i in present:
+            del self._id_loc[i]
+        return len(present)
+
+    @traced("serving::delete")
+    def delete(self, ids) -> int:
+        """Tombstone rows by id; unknown ids are ignored. Returns the
+        number of rows actually removed."""
+        ids_np = np.asarray(ids).reshape(-1)
+        with self._lock:
+            removed = self._tombstone_ids(
+                [int(i) for i in ids_np if int(i) in self._id_loc])
+        if obs.enabled() and removed:
+            obs.add("serving.store.deletes", removed)
+        return removed
+
+    # -- compaction ---------------------------------------------------------
+    def _live_rows(self):
+        """(payload, aux, ids, labels) of live rows in per-list chain
+        order — the arrival order, which is what a from-scratch pack over
+        the same rows produces (pack_lists' label argsort is stable)."""
+        perm = []
+        for lab in range(self.n_lists):
+            for p in self._table[lab, :self._list_pages[lab]]:
+                base = int(p) * self.page_rows
+                perm.extend(range(base, base + int(self._fill[p])))
+        perm = np.asarray(perm, np.int64)
+        ids_flat = np.asarray(self.page_ids).reshape(-1)
+        labels_flat = np.repeat(self._page_list, self.page_rows)
+        if perm.size:
+            ids_sel = ids_flat[perm]
+            live = ids_sel >= 0
+            perm = perm[live]
+            ids_sel = ids_sel[live]
+            labels_sel = labels_flat[perm]
+        else:
+            ids_sel = np.empty(0, np.int32)
+            labels_sel = np.empty(0, np.int32)
+        payload_flat = jnp.reshape(self.pages, (-1,) + self.pages.shape[2:])
+        payload = jnp.take(payload_flat, jnp.asarray(perm), axis=0)
+        aux = jnp.take(jnp.reshape(self.page_aux, (-1,)),
+                       jnp.asarray(perm), axis=0)
+        return payload, aux, ids_sel.astype(np.int32), labels_sel.astype(np.int32)
+
+    @traced("serving::compact")
+    def compact(self):
+        """Fold the live rows back into the packed representation: an
+        ``IvfFlatIndex`` / ``IvfPqIndex`` over exactly the surviving rows,
+        with the store's frozen quantizers. The result serializes through
+        the v2 snapshot container (``index.save``) — that is the paged
+        store's durable form. The per-row aux (norms / b_sum) is CARRIED,
+        not recomputed: recomputing over the packed shape can flip low
+        mantissa bits (different reduction tiling) and break the
+        compacted-scan ↔ paged-scan value parity the tier-1 tests pin."""
+        with self._lock:
+            payload, aux, ids_np, labels_np = self._live_rows()
+            group = 64 if self.kind == "ivf_flat" else 128
+            ids_dev = jnp.asarray(ids_np)
+            labels_dev = jnp.asarray(labels_np)
+            list_payload, list_ids = pack_lists(
+                payload, ids_dev, labels_dev, self.n_lists, group)
+            # same stable label-argsort permutation as the payload pack
+            aux_packed, _ = pack_lists(aux, ids_dev, labels_dev,
+                                       self.n_lists, group)
+            if self.kind == "ivf_flat":
+                norms = None
+                if self.metric in ("sqeuclidean", "euclidean"):
+                    norms = aux_packed
+                out = ivf_flat_mod.IvfFlatIndex(
+                    self.centers, list_payload, list_ids, norms,
+                    self.metric, group)
+            else:
+                # packed convention: +inf at padding so the scan self-masks
+                b_sum = jnp.where(list_ids >= 0, aux_packed, jnp.inf)
+                out = ivf_pq_mod.IvfPqIndex(
+                    self.centers, self.rotation, self.codebooks,
+                    list_payload, list_ids, b_sum, None, self.metric,
+                    self.pq_bits, group, codebook_kind=self.codebook_kind,
+                    pq_dim_hint=self.pq_dim)
+        if obs.enabled():
+            obs.add("serving.store.compactions")
+        return out

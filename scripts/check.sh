@@ -100,6 +100,14 @@ print("shard-loss smoke: OK")
 EOF
 
 echo
+echo "== serving smoke (paged store + SLO-aware dynamic batching, ISSUE 8) =="
+# Tiny paged store, 64 streamed queries with mixed deadlines, upserts
+# mid-traffic: asserts >=1 multi-request batch, zero unclassified request
+# verdicts, ZERO search recompiles across upserts, dynamic batching >=5x
+# batch-size-1 QPS at equal p99, metrics routed through bench/progress.py.
+JAX_PLATFORMS=cpu python scripts/serving_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

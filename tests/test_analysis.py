@@ -299,6 +299,56 @@ def f(x: "typing.Optional[int]"):
     return os.getpid()
 """,
     ),
+    # ISSUE 8 extension: serving's public surface is METHOD-shaped
+    # (PagedListStore.upsert / QueryQueue.submit), so obs-coverage walks
+    # class bodies inside raft_tpu/serving/
+    (
+        "obs-coverage",
+        "raft_tpu/serving/mod.py",
+        """
+class Store:
+    def upsert(self, vectors, ids):
+        return len(ids)
+""",
+        # near-miss: @traced method + a record_span method + private helper
+        """
+from raft_tpu import obs
+from raft_tpu.core.trace import traced
+
+class Store:
+    @traced("serving::upsert")
+    def upsert(self, vectors, ids):
+        return self._append(vectors, ids)
+
+    def submit(self, query):
+        with obs.record_span("serving::submit"):
+            return query
+
+    def _append(self, vectors, ids):
+        return len(ids)
+""",
+    ),
+    # ISSUE 8 extension: spans in raft_tpu/serving/ must file under the
+    # serving:: prefix — a well-formed name under another module's prefix
+    # drops out of every serving-latency query
+    (
+        "span-name",
+        "raft_tpu/serving/mod.py",
+        """
+from raft_tpu import obs
+
+def dispatch(batch):
+    with obs.record_span("ivf_flat::dispatch"):
+        return batch
+""",
+        """
+from raft_tpu import obs
+
+def dispatch(batch):
+    with obs.record_span("serving::dispatch"):
+        return batch
+""",
+    ),
 ]
 
 

@@ -1,0 +1,499 @@
+"""Serving layer: paged mutable IVF storage + SLO-aware dynamic batching.
+
+Tier-1 contracts (ISSUE 8):
+
+* paged↔packed parity — a store holding exactly a packed index's rows
+  scans bit-identically to the packed gather backend, and ANY interleaving
+  of upsert/delete/compact matches a from-scratch packed build over the
+  surviving rows (ivf_flat and ivf_pq);
+* zero recompiles on the mutation path — upserts/deletes within capacity
+  never retrace the paged scan;
+* the QueryQueue coalesces single requests into multi-request batches,
+  honors per-request deadlines (classified DEADLINE verdicts, partial
+  drain), and degrades batch size on OOM (standing-gate recovery tests,
+  armed via RAFT_TPU_FAULTS / arm_faults).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import obs, resilience, serving
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors._packing import pack_lists
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.resilience.deadline import DeadlineExceeded
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+@pytest.fixture
+def flat_setup(rng):
+    X = rng.standard_normal((1500, 24)).astype(np.float32)
+    Q = rng.standard_normal((12, 24)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=12,
+                                                   list_size_cap=0))
+    return X, Q, idx
+
+
+@pytest.fixture
+def pq_setup(rng):
+    X = rng.standard_normal((1500, 24)).astype(np.float32)
+    Q = rng.standard_normal((12, 24)).astype(np.float32)
+    idx = ivf_pq.build(X, ivf_pq.IvfPqParams(n_lists=12, pq_dim=12,
+                                             list_size_cap=0))
+    return X, Q, idx
+
+
+def _ids(x):
+    return np.asarray(x[1])
+
+
+def _vals(x):
+    return np.asarray(x[0])
+
+
+# ---------------------------------------------------------------------------
+# Store mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestPagedStore:
+    def test_from_index_stats(self, flat_setup):
+        X, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        st = store.stats()
+        assert st["rows"] == X.shape[0]
+        assert st["tombstones"] == 0
+        assert st["page_rows"] == 64
+        assert st["pages_used"] * 64 >= X.shape[0]
+
+    def test_upsert_append_and_replace(self, flat_setup, rng):
+        _, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        n0 = store.size
+        Y = rng.standard_normal((40, 24)).astype(np.float32)
+        out = store.upsert(Y, np.arange(10_000, 10_040))
+        assert out == {"upserts": 40, "replaced": 0, "growths": out["growths"]}
+        assert store.size == n0 + 40
+        # upsert same ids again: replace, not duplicate
+        out = store.upsert(Y + 1.0, np.arange(10_000, 10_040))
+        assert out["replaced"] == 40
+        assert store.size == n0 + 40
+        assert store.tombstones == 40
+
+    def test_delete_tombstones(self, flat_setup):
+        X, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        removed = store.delete(np.arange(100))
+        assert removed == 100 and store.size == X.shape[0] - 100
+        assert store.delete(np.arange(100)) == 0  # idempotent
+        ids = _ids(serving.search(store, Q, 20, n_probes=12))
+        live = ids[ids >= 0]
+        assert live.size and (live >= 100).all()
+
+    def test_duplicate_ids_in_batch_rejected(self, flat_setup):
+        _, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        with pytest.raises(ValueError, match="duplicate"):
+            store.upsert(np.zeros((2, 24), np.float32), [7, 7])
+
+    def test_page_rows_env_default(self, monkeypatch):
+        monkeypatch.setenv(serving.PAGE_ROWS_ENV, "32")
+        assert serving.default_page_rows() == 32
+
+    def test_capacity_growth_and_reserve(self, flat_setup, rng):
+        _, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        store.reserve(50_000)
+        g0 = store.growth_events
+        # within reserved capacity: appends never grow
+        for s in range(0, 2000, 250):
+            store.upsert(rng.standard_normal((250, 24)).astype(np.float32),
+                         np.arange(20_000 + s, 20_250 + s))
+        assert store.growth_events == g0
+
+    def test_compact_save_load_roundtrip(self, flat_setup, tmp_path, rng):
+        _, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        store.delete(np.arange(200))
+        store.upsert(rng.standard_normal((100, 24)).astype(np.float32),
+                     np.arange(30_000, 30_100))
+        comp = store.compact()
+        path = tmp_path / "serving.raft"
+        comp.save(path)  # v2 crash-safe container
+        loaded = ivf_flat.IvfFlatIndex.load(path)
+        v1, i1 = ivf_flat.search(comp, Q, 10, n_probes=12, backend="gather")
+        v2, i2 = ivf_flat.search(loaded, Q, 10, n_probes=12, backend="gather")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_pq_cluster_codebooks_unsupported(self, rng):
+        X = rng.standard_normal((600, 16)).astype(np.float32)
+        idx = ivf_pq.build(X, ivf_pq.IvfPqParams(
+            n_lists=8, pq_dim=8, codebook_kind="cluster", list_size_cap=0))
+        with pytest.raises(ValueError, match="subspace"):
+            serving.PagedListStore.from_index(idx)
+
+
+# ---------------------------------------------------------------------------
+# Paged ↔ packed parity
+# ---------------------------------------------------------------------------
+
+
+class TestPagedParity:
+    @pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product",
+                                        "cosine"])
+    def test_flat_fresh_store_bit_parity(self, rng, metric):
+        X = rng.standard_normal((1200, 24)).astype(np.float32)
+        Q = rng.standard_normal((10, 24)).astype(np.float32)
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(
+            n_lists=10, metric=metric, list_size_cap=0))
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        pv, pi = ivf_flat.search(idx, Q, 10, n_probes=10, backend="gather")
+        sv, si = serving.search(store, Q, 10, n_probes=10)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(pv), np.asarray(sv))
+
+    @pytest.mark.parametrize("pq_bits", [8, 4])
+    def test_pq_fresh_store_bit_parity(self, rng, pq_bits):
+        X = rng.standard_normal((1200, 24)).astype(np.float32)
+        Q = rng.standard_normal((10, 24)).astype(np.float32)
+        idx = ivf_pq.build(X, ivf_pq.IvfPqParams(
+            n_lists=12, pq_dim=12, pq_bits=pq_bits, list_size_cap=0))
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        pv, pi = ivf_pq.search(idx, Q, 10, n_probes=12, backend="gather")
+        sv, si = serving.search(store, Q, 10, n_probes=12)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(pv), np.asarray(sv))
+        # mutated bit-packed store still matches its own compaction
+        store.delete(np.arange(0, 300))
+        store.upsert(rng.standard_normal((80, 24)).astype(np.float32),
+                     np.arange(80_000, 80_080))
+        sv2, si2 = serving.search(store, Q, 10, n_probes=12)
+        cv, ci = ivf_pq.search(store.compact(), Q, 10, n_probes=12,
+                               backend="gather")
+        np.testing.assert_array_equal(np.asarray(ci), np.asarray(si2))
+
+    def test_flat_compact_bit_parity_after_mutations(self, flat_setup, rng):
+        _, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        store.delete(np.arange(0, 400))
+        store.upsert(rng.standard_normal((250, 24)).astype(np.float32),
+                     np.arange(40_000, 40_250))
+        sv, si = serving.search(store, Q, 10, n_probes=12)
+        comp = store.compact()
+        cv, ci = ivf_flat.search(comp, Q, 10, n_probes=12, backend="gather")
+        np.testing.assert_array_equal(np.asarray(ci), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(cv), np.asarray(sv))
+
+    def _flat_reference(self, idx, rows, ids):
+        """From-scratch packed build over exactly ``rows``: the store's
+        frozen centers, per-row nearest-center labels, pack_lists — the
+        independent oracle the interleaving property is pinned to."""
+        rows_d = jnp.asarray(rows)
+        labels = kmeans_balanced.predict(
+            rows_d, idx.centers,
+            kmeans_balanced.KMeansBalancedParams(metric="sqeuclidean"))
+        list_data, list_ids = pack_lists(
+            rows_d, jnp.asarray(ids, jnp.int32), labels,
+            idx.centers.shape[0], 64)
+        norms = dist_mod.sqnorm(list_data, axis=2)
+        return ivf_flat.IvfFlatIndex(idx.centers, list_data, list_ids,
+                                     norms, "sqeuclidean", 64)
+
+    @pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq"])
+    def test_interleaving_property(self, rng, kind):
+        """Any interleaving of upsert/delete/compact yields bit-identical
+        top-k ids vs a from-scratch packed build on the surviving rows."""
+        X = rng.standard_normal((1000, 24)).astype(np.float32)
+        Q = rng.standard_normal((8, 24)).astype(np.float32)
+        if kind == "ivf_flat":
+            idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(
+                n_lists=8, list_size_cap=0))
+            packed_search = lambda i: ivf_flat.search(  # noqa: E731
+                i, Q, 10, n_probes=8, backend="gather")
+        else:
+            idx = ivf_pq.build(X, ivf_pq.IvfPqParams(
+                n_lists=8, pq_dim=12, list_size_cap=0))
+            packed_search = lambda i: ivf_pq.search(  # noqa: E731
+                i, Q, 10, n_probes=8, backend="gather")
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        shadow = {i: X[i] for i in range(X.shape[0])}
+        next_id = 100_000
+        for step in range(12):
+            op = rng.integers(0, 10)
+            if op < 5:  # upsert: new rows + some replacements
+                n_new = int(rng.integers(1, 60))
+                vecs = rng.standard_normal((n_new, 24)).astype(np.float32)
+                ids = []
+                for j in range(n_new):
+                    if shadow and rng.random() < 0.3:
+                        ids.append(int(rng.choice(list(shadow))))
+                    else:
+                        ids.append(next_id)
+                        next_id += 1
+                # batch ids must be unique: drop dup replacements
+                uniq = {}
+                for j, i in enumerate(ids):
+                    uniq[i] = vecs[j]
+                ids = np.fromiter(uniq, np.int64)
+                vecs = np.stack(list(uniq.values()))
+                store.upsert(vecs, ids)
+                for i, v in uniq.items():
+                    shadow[int(i)] = v
+            elif op < 8 and shadow:  # delete
+                n_del = int(rng.integers(1, min(50, len(shadow)) + 1))
+                victims = rng.choice(list(shadow), size=n_del, replace=False)
+                store.delete(victims)
+                for i in victims:
+                    del shadow[int(i)]
+            else:  # compact: fold to packed, re-page, keep going
+                store = serving.PagedListStore.from_index(
+                    store.compact(), page_rows=32)
+        sv, si = serving.search(store, Q, 10, n_probes=8)
+        # oracle 1: the store's own compaction, searched packed
+        cv, ci = packed_search(store.compact())
+        np.testing.assert_array_equal(np.asarray(ci), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(cv), np.asarray(sv))
+        # oracle 2: a one-shot from-scratch store over the surviving rows
+        # (no mutation history at all)
+        surv_ids = np.fromiter(shadow, np.int64)
+        surv = np.stack([shadow[int(i)] for i in surv_ids])
+        fresh = serving.PagedListStore.from_index(idx, include_rows=False,
+                                                  page_rows=32)
+        fresh.upsert(surv, surv_ids)
+        fv, fi = serving.search(fresh, Q, 10, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(fi), np.asarray(si))
+        if kind == "ivf_flat":
+            # oracle 3 (flat): fully manual pack, no store code at all
+            rv, ri = packed_search(
+                self._flat_reference(idx, surv, surv_ids))
+            np.testing.assert_array_equal(np.asarray(ri), np.asarray(si))
+
+    def test_filter_parity(self, flat_setup):
+        from raft_tpu.core.bitset import Bitset
+
+        X, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        mask = np.ones(X.shape[0], bool)
+        mask[0:700:2] = False
+        filt = Bitset.from_mask(mask)
+        pv, pi = ivf_flat.search(idx, Q, 10, n_probes=12, filter=filt,
+                                 backend="gather")
+        sv, si = serving.search(store, Q, 10, n_probes=12, filter=filt)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(si))
+
+
+# ---------------------------------------------------------------------------
+# Zero-recompile serving contract
+# ---------------------------------------------------------------------------
+
+
+class TestNoRecompile:
+    @pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq"])
+    def test_mutations_never_retrace_scan(self, rng, kind):
+        X = rng.standard_normal((1200, 24)).astype(np.float32)
+        Q = rng.standard_normal((8, 24)).astype(np.float32)
+        if kind == "ivf_flat":
+            idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(
+                n_lists=8, list_size_cap=0))
+        else:
+            idx = ivf_pq.build(X, ivf_pq.IvfPqParams(
+                n_lists=8, pq_dim=12, list_size_cap=0))
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        store.reserve(4000)  # growth paid up front
+        serving.search(store, Q, 10, n_probes=8)  # warm the scan
+        t0 = serving.scan_trace_count()
+        for s in range(0, 1500, 300):
+            store.upsert(rng.standard_normal((300, 24)).astype(np.float32),
+                         np.arange(50_000 + s, 50_300 + s))
+            store.delete(np.arange(50_000 + s, 50_000 + s + 50))
+            serving.search(store, Q, 10, n_probes=8)
+        assert serving.scan_trace_count() == t0, \
+            "steady-state upsert/delete/search retraced the paged scan"
+
+
+# ---------------------------------------------------------------------------
+# QueryQueue: dynamic batching under SLO
+# ---------------------------------------------------------------------------
+
+
+def _drain_sync(q, timeout=30.0):
+    t_end = time.monotonic() + timeout
+    while q.depth and time.monotonic() < t_end:
+        q.pump()
+    assert not q.depth, "queue failed to drain"
+
+
+class TestQueryQueue:
+    @pytest.fixture
+    def served_store(self, flat_setup):
+        _, _, idx = flat_setup
+        return serving.PagedListStore.from_index(idx, page_rows=64)
+
+    def test_coalesces_into_multi_batches(self, served_store, rng):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(24)]
+        _drain_sync(q)
+        assert all(h.verdict == "ok" for h in hs)
+        assert q.multi_batches >= 1
+        vals, ids = hs[0].result()
+        assert vals.shape == (5,) and ids.shape == (5,)
+
+    def test_results_match_direct_search(self, served_store, rng):
+        qs = rng.standard_normal((16, 24)).astype(np.float32)
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=12),
+            slo_s=0.05, max_batch=16)
+        hs = [q.submit(qs[i], timeout_s=10.0) for i in range(16)]
+        _drain_sync(q)
+        direct_v, direct_i = serving.search(served_store, qs, 5, n_probes=12)
+        got_i = np.stack([h.result()[1] for h in hs])
+        np.testing.assert_array_equal(np.asarray(direct_i), got_i)
+
+    def test_expired_request_gets_deadline_verdict(self, served_store, rng):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8), slo_s=0.05)
+        h = q.submit(rng.standard_normal(24), timeout_s=0.0)
+        time.sleep(0.01)
+        q.pump()
+        assert h.verdict == resilience.DEADLINE
+        with pytest.raises(DeadlineExceeded):
+            h.result()
+
+    def test_deadline_partial_drain_on_hang(self, served_store, rng):
+        """Standing gate: a hang at the dispatch faultpoint burns the
+        batch's deadline — expired requests drain with classified
+        DEADLINE verdicts, survivors are served after."""
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        resilience.arm_faults("serving.queue.dispatch=hang:1:10")
+        short = [q.submit(rng.standard_normal(24), timeout_s=0.15)
+                 for _ in range(3)]
+        longer = [q.submit(rng.standard_normal(24), timeout_s=30.0)
+                  for _ in range(3)]
+        _drain_sync(q, timeout=20.0)
+        assert [h.verdict for h in short] == [resilience.DEADLINE] * 3
+        assert [h.verdict for h in longer] == ["ok"] * 3
+
+    def test_oom_halves_batch_size(self, served_store, rng):
+        """Standing gate: an OOM-classified dispatch halves the adaptive
+        batch cap and re-serves the same requests in smaller batches."""
+        obs.enable()
+        try:
+            obs.reset()
+            q = serving.QueryQueue(
+                serving.searcher(served_store, k=5, n_probes=8),
+                slo_s=0.05, max_batch=8)
+            resilience.arm_faults("serving.queue.dispatch=oom:1")
+            hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+                  for _ in range(8)]
+            _drain_sync(q)
+            assert all(h.verdict == "ok" for h in hs)
+            assert q.batch_cap == 4
+            counters = obs.snapshot()["counters"]
+            assert counters.get("serving.dispatch.oom_halved") == 1
+        finally:
+            obs.disable()
+
+    def test_fatal_dispatch_is_classified_not_wedged(self, served_store, rng):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=4)
+        resilience.arm_faults("serving.queue.dispatch=fatal:1")
+        bad = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+               for _ in range(2)]
+        _drain_sync(q)
+        assert all(h.verdict == resilience.FATAL for h in bad)
+        # the queue keeps serving after a fatal batch
+        ok = q.submit(rng.standard_normal(24), timeout_s=10.0)
+        _drain_sync(q)
+        assert ok.verdict == "ok"
+
+    def test_transient_dispatch_retries_once(self, served_store, rng):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=4)
+        resilience.arm_faults("serving.queue.dispatch=transient:1")
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(4)]
+        _drain_sync(q)
+        assert all(h.verdict == "ok" for h in hs)
+
+    def test_worker_thread_mode(self, served_store, rng):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.02, max_batch=16)
+        q.start()
+        try:
+            hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+                  for _ in range(40)]
+            for h in hs:
+                h.result(timeout=15.0)
+            assert all(h.verdict == "ok" for h in hs)
+        finally:
+            q.stop()
+
+
+# ---------------------------------------------------------------------------
+# Store faultpoint recovery (standing gate)
+# ---------------------------------------------------------------------------
+
+
+class TestStoreFaults:
+    def test_upsert_oom_degrades_chunk_and_lands(self, flat_setup, rng):
+        _, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        resilience.arm_faults("serving.store.upsert=oom:1")
+        out = store.upsert(
+            rng.standard_normal((200, 24)).astype(np.float32),
+            np.arange(60_000, 60_200))
+        assert out["upserts"] == 200
+        assert store.size == 1500 + 200
+        # every row actually searchable (no partial/duplicate append)
+        ids = _ids(serving.search(store, Q, 20, n_probes=12))
+        assert store.compact().size == 1500 + 200
+        assert ids.max() < 60_200
+
+    def test_replace_upsert_fatal_keeps_old_rows(self, flat_setup, rng):
+        """A FATAL mid-replace must not lose the previous versions: the
+        old slots are tombstoned only AFTER the append lands."""
+        X, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        resilience.arm_faults("serving.store.upsert=fatal:1")
+        with pytest.raises(Exception):
+            store.upsert(rng.standard_normal((20, 24)).astype(np.float32),
+                         np.arange(0, 20))  # ids 0..19 already exist
+        assert store.size == 1500 and store.tombstones == 0
+        sv, si = serving.search(store, X[:4], 5, n_probes=12)
+        np.testing.assert_array_equal(  # old versions still served
+            np.asarray(si)[:, 0], np.arange(4))
+
+    def test_upsert_fatal_classifies_and_leaves_store_intact(
+            self, flat_setup, rng):
+        _, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=64)
+        resilience.arm_faults("serving.store.upsert=fatal:1")
+        with pytest.raises(Exception) as ei:
+            store.upsert(rng.standard_normal((50, 24)).astype(np.float32),
+                         np.arange(70_000, 70_050))
+        assert resilience.classify(ei.value) == resilience.FATAL
+        assert store.size == 1500  # no partial id-map commit
+        resilience.clear_faults()
+        out = store.upsert(
+            rng.standard_normal((50, 24)).astype(np.float32),
+            np.arange(70_000, 70_050))
+        assert out["upserts"] == 50 and store.size == 1550
