@@ -216,7 +216,8 @@ def run_suite():
     from raft_tpu import stats
     from raft_tpu.bench import progress as prog
     from raft_tpu.bench.datasets import sift_like
-    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, refine
+    from raft_tpu.neighbors import (brute_force, cagra, ivf_bq, ivf_flat,
+                                    ivf_pq, refine)
 
     # telemetry ON for the whole measured child (round-8): the bench window
     # exists to answer where the time went, so spans/counters/latency
@@ -498,6 +499,83 @@ def run_suite():
             extras["ivf_pq"] = section_error(e)
         hb.section("ivf_pq", extras["ivf_pq"])
 
+    # --- IVF-BQ: RaBitQ-style 1-bit codes + exact refine (ROADMAP item 3) --
+    # The scan reads rot_dim/8 bytes per probed entry (32× under fp32, 4×
+    # under the r04 IVF-PQ configuration's 64 B codes); the recall gate is
+    # held by nprobe THEN k_fetch escalation through the exact re-rank.
+    # The per-chip capacity rung (after deep10m, where the 1M arrays are
+    # freed) replaces the r04 extrapolated SIFT-1B-class number with a
+    # MEASURED per_chip_capacity_rows / per_chip_qps pair.
+    bq = None
+    if section_on("ivf_bq"):
+        hb.set_section("ivf_bq")
+        try:
+            def build_bq():
+                idx = ivf_bq.build(dataset, ivf_bq.IvfBqParams(
+                    n_lists=NLIST, kmeans_trainset_fraction=0.2))
+                _force(idx.list_scale)
+                return idx
+
+            bq_name = f"ivf_bq_nl{NLIST}"
+            bq_index = cache_load(bq_name, ivf_bq.IvfBqIndex.load)
+            bq_cache = "hit"
+            if bq_index is None:
+                bq_index, cold_s, warm_s = timed_build(build_bq)
+                bq_cache = cache_store(bq_name, bq_index)
+            else:
+                cold_s = warm_s = 0.0
+            def bq_pair(nprobe, kf):
+                _, cand = ivf_bq.search(bq_index, queries, kf,
+                                        n_probes=nprobe)
+                return refine.refine(dataset, queries, cand, K)
+
+            bq = _bq_gate_escalate(
+                bq_pair,
+                lambda vals, ids: float(stats.neighborhood_recall(
+                    ids, gt_ids, vals, gt_vals)),
+                K, (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
+                    NPROBE0 * 16))
+
+            def bq_timed(qs):
+                _, cand = ivf_bq.search(bq_index, qs, bq["k_fetch"],
+                                        n_probes=bq["nprobe"])
+                return refine.refine(dataset, qs, cand, K)
+
+            bq_timed(queries)  # warm: the one legal trace
+            traces0 = ivf_bq.scan_trace_count()
+            bq["qps"] = round(_time_qps(
+                bq_timed, queries, REPS,
+                hist="bench.ivf_bq.batch_latency_s"), 1)
+            # steady-state contract: the timed repeats re-dispatch ONE
+            # compiled program (check.sh smoke gates this at zero)
+            bq["recompiles_during_search"] = \
+                ivf_bq.scan_trace_count() - traces0
+            bq.update(latency_percentiles("bench.ivf_bq.batch_latency_s"))
+            bq["build_s"] = cold_s
+            bq["build_warm_s"] = warm_s
+            if bq_cache:
+                bq["index_cache"] = bq_cache
+            # resident-bytes accounting: code bytes are the headline (the
+            # aux scalars ride along at 8 B/row, reported separately)
+            nb = bq_index.code_bytes_per_row
+            bq["code_bytes_per_row"] = nb
+            bq["aux_bytes_per_row"] = 8
+            bq["pq_code_bytes_per_row"] = DIM // 2  # r04 config: pq_dim=D/2 ×8b
+            bq["code_compression_x"] = round((DIM // 2) / nb, 2)
+            # CPU preview seeds the per-chip pair from this section; the
+            # TPU capacity rung below overwrites it with the large-scale
+            # measurement (round-6 CPU-preview precedent)
+            bq["per_chip_capacity_rows"] = N
+            bq["per_chip_qps"] = bq["qps"]
+            bq["per_chip_recall"] = bq["recall"]
+            bq["per_chip_measured"] = True
+            extras["ivf_bq"] = bq
+            del bq_index
+        except Exception as e:
+            bq = None
+            extras["ivf_bq"] = section_error(e)
+        hb.section("ivf_bq", extras["ivf_bq"])
+
     # --- Serving: streaming traffic against the paged mutable store --------
     # (ISSUE 8): Poisson arrivals into the SLO-aware QueryQueue over a
     # PagedListStore, with upserts interleaved mid-traffic. Reports QPS +
@@ -753,6 +831,46 @@ def run_suite():
             extras["deep10m"] = {"error": "skipped: time budget"}
         hb.section("deep10m", extras["deep10m"])
 
+    # --- IVF-BQ per-chip capacity rung (ROADMAP item 3's headline): hold
+    # the SIFT-1B per-chip row share (1B / 64 chips = 15.6M rows) RESIDENT
+    # as 1-bit codes and MEASURE recall-gated QPS at that scale — the
+    # number that replaces r04's sift1b_per_chip_qps_extrapolated. Runs
+    # after deep10m so the 1M-section arrays are already freed; OOM
+    # retries once at half scale, stamped degraded (ISSUE 3 precedent).
+    if not on_cpu and section_on("ivf_bq") and isinstance(bq, dict):
+        if elapsed() < 1800:
+            hb.set_section("ivf_bq_capacity")
+            try:
+                rung = _ivf_bq_capacity(REPS, 15_625_000, DIM, K)
+            except Exception as e:
+                err = section_error(e)
+                rung = None
+                if err["kind"] == resilience.OOM:
+                    try:
+                        rung = _ivf_bq_capacity(REPS, 15_625_000 // 2, DIM, K)
+                        rung["degraded"] = True
+                        rung["first_attempt_error"] = err
+                    except Exception as e2:
+                        # keep the first attempt's OOM stamp: it is WHY the
+                        # rung degraded, and the retry's failure rides along
+                        err = {**section_error(e2),
+                               "first_attempt_error": err}
+                if rung is None:
+                    bq["capacity_rung_error"] = err
+            if rung is not None:
+                bq["scale_sweep"] = [
+                    {"n": N, "recall": bq["recall"], "qps": bq["qps"]}, rung]
+                if rung.get("recall", 0.0) >= 0.95:
+                    bq["per_chip_capacity_rows"] = rung["n"]
+                    bq["per_chip_qps"] = rung["qps"]
+                    bq["per_chip_recall"] = rung["recall"]
+        else:
+            # heartbeat the skip too (deep10m convention): a watcher must
+            # be able to tell "skipped" from "crashed before the section"
+            hb.set_section("ivf_bq_capacity")
+            bq["capacity_rung_error"] = {"error": "skipped: time budget"}
+        hb.section("ivf_bq_capacity", bq)
+
     # --- DEEP-100M (BASELINE row): measured offline by scripts/deep100m.py
     # (streamed build + truncated-cache search takes ~20+ min — too long
     # for the driver's bench run). When its committed artifact exists it is
@@ -986,6 +1104,93 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
         obs.add("bench.serving.requests", (1 + len(mults)) * n_req)
     out["store_after"] = store.stats()
     out["_store"] = store  # the section owner compacts + caches this
+    return out
+
+
+def _bq_gate_escalate(run_pair, recall_of, k: int, probe_ladder) -> dict:
+    """The ONE copy of the IVF-BQ recall-gate protocol (1M section and
+    capacity rung both ride it — two copies would silently drift into
+    measuring different configurations): escalate nprobe at a 4·k
+    over-fetch first, then widen the over-fetch at the best nprobe until
+    the exact re-rank holds the 0.95 gate, capped at the strip engine's
+    k=512. ``run_pair(nprobe, k_fetch) -> (vals, ids)`` runs scan+refine;
+    ``recall_of(vals, ids) -> float`` scores against ground truth."""
+    best = None
+    for nprobe in probe_ladder:
+        kf = min(4 * k, 512)
+        rec = recall_of(*run_pair(nprobe, kf))
+        if best is None or rec > best["recall"]:
+            best = {"nprobe": int(nprobe), "recall": round(rec, 4),
+                    "k_fetch": kf}
+        if rec >= 0.95:
+            break
+    if best["recall"] < 0.95:
+        for kf in (8 * k, 16 * k, 32 * k):
+            kf = min(kf, 512)
+            rec = recall_of(*run_pair(best["nprobe"], kf))
+            if rec > best["recall"]:
+                best.update(recall=round(rec, 4), k_fetch=kf)
+            if rec >= 0.95:
+                break
+    return best
+
+
+def _ivf_bq_capacity(reps: int, n_rows: int, dim: int, k: int) -> dict:
+    """One memory-resident IVF-BQ rung at ``n_rows``: build (1-bit codes +
+    correction scalars resident, dataset uint8-resident for the exact
+    re-rank), chunked-scan exact ground truth, nprobe/k_fetch escalation to
+    the 0.95 gate, then measured QPS. The per-chip capacity MEASUREMENT —
+    scan work and residency both real at this row count, no extrapolation."""
+    import jax.numpy as jnp
+
+    from raft_tpu import stats
+    from raft_tpu.bench.datasets import sift_like
+    from raft_tpu.neighbors import batch_knn, ivf_bq, refine
+
+    Q = 10_000
+    # n_lists scales with rows (√n-ish, the deep10m regime note: pairs per
+    # probed list ≈ the strip width keeps the engine in its design regime)
+    nlist = 4096 if n_rows >= 4_000_000 else 1024
+    data_u8, queries_u8 = sift_like(n_rows, dim, Q, seed=2)
+    dataset = jnp.asarray(data_u8)               # uint8-resident rerank source
+    queries = jnp.asarray(queries_u8, jnp.float32)
+    out = {"n": n_rows, "dim": dim, "q": Q, "n_lists": nlist,
+           "dataset": f"siftlike-{n_rows // 1_000_000}m-{dim}-uint8"}
+
+    gt_vals, gt_ids = batch_knn.search_device_chunked(
+        dataset, queries, k, chunk_rows=32768)
+    _force(gt_vals)
+
+    t0 = time.perf_counter()
+    idx = ivf_bq.build(dataset, ivf_bq.IvfBqParams(
+        n_lists=nlist, kmeans_trainset_fraction=0.1, list_size_cap=4096))
+    _force(idx.list_scale)
+    out["build_s"] = round(time.perf_counter() - t0, 1)
+    out["code_bytes_per_row"] = idx.code_bytes_per_row
+
+    def run_pair(nprobe, kf):
+        _, cand = ivf_bq.search(idx, queries, kf, n_probes=nprobe)
+        return refine.refine(dataset, queries, cand, k)
+
+    best = _bq_gate_escalate(
+        run_pair,
+        lambda vals, ids: float(stats.neighborhood_recall(
+            ids, gt_ids, vals, gt_vals)),
+        k, (32, 64, 128))
+
+    def run(qs):
+        _, cand = ivf_bq.search(idx, qs, best["k_fetch"],
+                                n_probes=best["nprobe"])
+        return refine.refine(dataset, qs, cand, k)
+
+    v, _ = run(queries)
+    _force(v)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        v, _ = run(queries)
+    _force(v)
+    best["qps"] = round(Q / ((time.perf_counter() - t0) / reps), 1)
+    out.update(best)
     return out
 
 

@@ -181,12 +181,14 @@ def load_arrays(path_or_stream) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
             else:
                 arrays = {name: deserialize_array(stream)
                           for name in meta["arrays"]}
-        except ValueError:
+        except SnapshotCorruptError:
             raise
         except Exception as e:
-            # np.load's header parser leaks tokenize/struct/unicode errors
-            # on garbage bytes past a valid magic — surface one stable
-            # exception type for corrupt files
+            # np.load's header parser and the meta json decode leak
+            # tokenize/struct/unicode errors on garbage bytes past a valid
+            # magic (UnicodeDecodeError/JSONDecodeError are ValueError
+            # subclasses — a bare `except ValueError: raise` let them
+            # escape unclassified) — surface one stable exception type
             raise SnapshotCorruptError(
                 f"corrupt raft_tpu container: {e!r}") from e
         return meta, arrays

@@ -10,9 +10,10 @@ data-sharded k-means, and multi-device IVF-Flat (global quantizer + local
 per-device indexes, the raft-dask one-model-per-worker architecture).
 """
 
-from raft_tpu.distributed import brute_force, cagra, ivf_flat, ivf_pq, kmeans
+from raft_tpu.distributed import (brute_force, cagra, ivf_bq, ivf_flat,
+                                  ivf_pq, kmeans)
 from raft_tpu.distributed import snapshot
 from raft_tpu.distributed._sharding import SearchResult, ShardReport, probe_shards
 
-__all__ = ["SearchResult", "ShardReport", "brute_force", "cagra", "ivf_flat",
-           "ivf_pq", "kmeans", "probe_shards", "snapshot"]
+__all__ = ["SearchResult", "ShardReport", "brute_force", "cagra", "ivf_bq",
+           "ivf_flat", "ivf_pq", "kmeans", "probe_shards", "snapshot"]

@@ -291,19 +291,35 @@ def merge_shards(vals, ids, k: int, axis: str, world: int = 0,
 
 @functools.lru_cache(maxsize=64)
 def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
-                 world=0):
+                 world=0, scan="strip"):
     """shard_map'd search tile shared by the distributed IVF indexes: local
-    scan (strip kernel, or dense gather for sub-512 lists) on the shard's
-    (data, ids, bias) triple + butterfly merge. Bias carries +inf at
-    padding (precomputed at build). ``ok`` is the (world, 1) serving mask
-    (shard_ok_device): a dead shard's candidates are blanked to (+inf, -1)
-    BEFORE the merge, so the partial merge is exact over the survivors."""
+    scan on the shard's (data, ids, bias[, scale]) operands + butterfly
+    merge. ``scan`` picks the engine: "strip" (fp/int8 B operand — strip
+    kernel, or dense gather for sub-512 lists) or "bq" (packed 1-bit codes
+    with the per-entry correction scale, ops/bq_scan). Bias carries +inf
+    at padding (precomputed at build). ``ok`` is the (world, 1) serving
+    mask (shard_ok_device): a dead shard's candidates are blanked to
+    (+inf, -1) BEFORE the merge, so the partial merge is exact over the
+    survivors."""
     from raft_tpu.ops.strip_scan import _strip_tile_body
 
     def body(queries, probes, pair_const, qids, strip_list, pair_strip,
-             pair_slot, data, ids_arr, bias, ok):
+             pair_slot, data, ids_arr, bias, scale, ok):
         ld, li, b = data[0], ids_arr[0], bias[0]
-        if dense:
+        if scan == "bq":
+            from raft_tpu.ops import bq_scan
+
+            sc = scale[0]
+            if dense:
+                vals, ids = bq_scan.bq_dense_scan(
+                    queries, probes, ld, sc, b, li, k, alpha, pair_const)
+            else:
+                vals, ids = bq_scan._bq_tile_body(
+                    queries, qids, strip_list, pair_strip, pair_slot,
+                    ld, sc, b, li, class_layout, k, kf, alpha, interpret,
+                    pair_const, approx_ok=True,
+                )
+        elif dense:
             vals, ids = dense_local_scan(queries, probes, ld, b, li, k,
                                          alpha, pair_const)
         else:
@@ -321,7 +337,7 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P(), P(),
                   P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None), P(axis, None)),
+                  P(axis, None, None), P(axis, None, None), P(axis, None)),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -330,8 +346,12 @@ def make_tile_fn(mesh, axis, class_layout, k, kf, dense, interpret, alpha,
 
 def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                  alpha, dense, interpret, data, ids_arr, bias,
-                 pair_const=None, algo="ivf", n_total=0, health=None):
+                 pair_const=None, algo="ivf", n_total=0, health=None,
+                 scale=None, scan="strip"):
     """Query-tiled SPMD search loop shared by the distributed IVF indexes.
+    ``scale`` is the optional (world, n_lists, mls) per-entry multiplicative
+    operand (the BQ correction scalar) and ``scan`` the engine selector —
+    see :func:`make_tile_fn`.
 
     Plans are built ON DEVICE (ops/strip_scan._plan_device, replicated —
     every shard runs the identical grid from the per-list MAX fill) and the
@@ -354,6 +374,11 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                          "for coverage accounting")
     report = probe_shards(algo, comms.size, n_total, health=health)
     ok_dev = shard_ok_device(report.ok, comms)
+    if scale is None:
+        # strip/dense scans ignore the operand: a (world, 1, 1) placeholder
+        # keeps the shard_map signature static across engines
+        scale = jax.device_put(jnp.zeros((comms.size, 1, 1), jnp.float32),
+                               comms.sharding(comms.axis, None, None))
     kf = min(int(k), 512)
     q = queries_mat.shape[0]
     probes = jnp.asarray(probes)
@@ -399,13 +424,14 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
                         plan_tile(probes, start, qt, cls_ord, classes,
                                   n_lists)
                 fn = make_tile_fn(comms.mesh, comms.axis, layout, int(k),
-                                  kf, dense, interpret, alpha, comms.size)
+                                  kf, dense, interpret, alpha, comms.size,
+                                  scan)
                 v, i = fn(queries_mat[start:start + qt],
                           jax.lax.slice_in_dim(probes, start, start + qt,
                                                axis=0),
                           pair_const[start:start + qt],
                           qids, strip_list, pair_strip, pair_slot,
-                          data, ids_arr, bias, ok_dev)
+                          data, ids_arr, bias, scale, ok_dev)
             out_v.append(v)
             out_i.append(i)
             start += qt

@@ -1,0 +1,220 @@
+"""Multi-device IVF-BQ: globally trained quantizers, row-sharded 1-bit
+code lists, one ``shard_map`` search riding the shard-health gate.
+
+The raft-dask MNMG division of labor (see distributed/ivf_flat.py):
+
+  * **Global, replicated**: coarse centers (data-sharded k-means, psum over
+    shards) and the random rotation — BQ has no codebooks, so the entire
+    replicated quantizer state is one (rot_dim, rot_dim) matrix.
+  * **Per shard**: its rows' packed sign codes, ids, and the two
+    correction-scalar planes (scale f, additive bias) — encoded in ONE
+    SPMD pass through the same ``_encode_chunk`` the single-host build
+    uses, so the estimator cannot drift between flows.
+  * **Search**: identical scan plan on every shard (per-list MAX fill),
+    local packed scan (``scan="bq"`` through the shared tiled_search —
+    strip kernel on TPU, probe-tiled dense unpack off-TPU), butterfly
+    candidate merge, and the degraded-mode dispatch gate: a LOST shard
+    costs coverage, never the query (``SearchResult.coverage``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, make_comms
+from raft_tpu.core.compat import shard_map
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.trace import traced
+from raft_tpu.neighbors import _packing
+from raft_tpu.neighbors import ivf_bq as sl
+from raft_tpu.neighbors.ivf_bq import IvfBqParams
+from raft_tpu.ops import distance as dist_mod
+
+
+@dataclass
+class ShardedIvfBqIndex:
+    """Row-sharded IVF-BQ: replicated centers + rotation, per-shard packed
+    code lists and correction planes stacked on a leading (world,) mesh
+    dimension."""
+
+    centers: jax.Array     # (n_lists, dim) replicated
+    rotation: jax.Array    # (rot_dim, rot_dim) replicated
+    list_codes: jax.Array  # (world, n_lists, mls, rot_dim/8) uint8, P(axis)
+    list_ids: jax.Array    # (world, n_lists, mls) int32, GLOBAL row ids
+    list_scale: jax.Array  # (world, n_lists, mls) fp32, P(axis)
+    bias: jax.Array        # (world, n_lists, mls) fp32, +inf padding
+    metric: str
+    n_total: int
+    comms: Comms
+    lens_max: np.ndarray   # host (n_lists,) max per-list fill across shards
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.list_codes.shape[2]
+
+
+@traced("distributed.ivf_bq::build")
+def build(
+    dataset,
+    params: IvfBqParams = IvfBqParams(),
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+) -> ShardedIvfBqIndex:
+    """Global centers (distributed k-means) + replicated rotation, then two
+    SPMD phases: assign + spill per shard, sign-encode + pack per shard at
+    a common padded list size."""
+    res = res or current_resources()
+    comms = comms or make_comms()
+    world = comms.size
+    axis = comms.axis
+    dataset = jnp.asarray(dataset).astype(jnp.float32)
+    n, dim = dataset.shape
+    if params.n_lists * world > n:
+        raise ValueError(f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
+    rot_dim = sl.auto_rot_dim(dim)
+    nb = rot_dim // 8
+
+    work = dataset
+    if params.metric == "cosine":
+        work = work / jnp.maximum(jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+    km_metric = ("inner_product" if params.metric in ("cosine", "inner_product")
+                 else "sqeuclidean")
+
+    # --- global coarse quantizer: data-sharded k-means (psum over shards) --
+    from raft_tpu.cluster.kmeans import KMeansParams
+    from raft_tpu.distributed import kmeans as dkm
+
+    out, _ = dkm.fit(
+        work, KMeansParams(n_clusters=params.n_lists,
+                           max_iter=params.kmeans_n_iters, seed=params.seed),
+        comms=comms,
+    )
+    centers = out.centroids
+    if params.metric in ("cosine", "inner_product"):
+        centers = centers / jnp.maximum(
+            jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
+    # replicated rotation: every shard derives the identical matrix from
+    # the shared seed — no collective
+    key = jax.random.key(params.seed)
+    _, k_rot = jax.random.split(key)
+    rotation = sl.make_rotation_matrix(k_rot, rot_dim)
+
+    # --- shard rows + SPMD assign/spill phase (shared helpers) -------------
+    from raft_tpu.distributed._sharding import (assign_phase, round_mls,
+                                                scatter_pack, shard_rows)
+
+    work_sh, gids_sh, rows_per = shard_rows(work, comms)
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(rows_per, params.n_lists, sl._GROUP)
+    n_lists = params.n_lists
+    labels_sh, counts_np = assign_phase(
+        work_sh, gids_sh, centers, km_metric, cap, n_lists, comms)
+    mls = round_mls(int(counts_np.max()), sl._GROUP)
+
+    # --- phase 2 (SPMD): sign-encode + pack at the common padded size ------
+    l2 = params.metric in ("sqeuclidean", "euclidean")
+    rc = sl._pad_rot(centers, rot_dim) @ rotation.T
+    c2 = dist_mod.sqnorm(centers)
+
+    def pack_body(rows, ids, labels):
+        rows, ids, labels = rows[0], ids[0], labels[0]
+        safe = jnp.minimum(labels, n_lists - 1)
+        codes, scale, row_bias = sl._encode_chunk(
+            rows, safe, centers, rotation, rc, c2, l2)
+        lc, li, lscale, lbias = scatter_pack(
+            labels,
+            [(jnp.zeros((n_lists, mls, nb), jnp.uint8), codes),
+             (jnp.full((n_lists, mls), -1, jnp.int32), ids),
+             (jnp.zeros((n_lists, mls), jnp.float32), scale),
+             (jnp.zeros((n_lists, mls), jnp.float32), row_bias)],
+            n_lists, mls)
+        lbias = jnp.where(li >= 0, lbias, jnp.inf)
+        return lc[None], li[None], lscale[None], lbias[None]
+
+    pack_fn = jax.jit(shard_map(
+        pack_body, mesh=comms.mesh,
+        in_specs=(P(axis, None, None), P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None, None, None), P(axis, None, None),
+                   P(axis, None, None), P(axis, None, None)),
+        check_vma=False,
+    ))
+    list_codes, list_ids, list_scale, bias = pack_fn(work_sh, gids_sh,
+                                                     labels_sh)
+    return ShardedIvfBqIndex(
+        centers, rotation, list_codes, list_ids, list_scale, bias,
+        params.metric, n, comms, counts_np.max(axis=0).astype(np.int32),
+    )
+
+
+@traced("distributed.ivf_bq::search")
+def search(
+    index: ShardedIvfBqIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    res: Optional[Resources] = None,
+    health=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD IVF-BQ search over the sharded 1-bit code lists. Returns
+    ESTIMATED (distances (q, k), global row ids (q, k)) as a
+    :class:`~raft_tpu.distributed._sharding.SearchResult` (replicated;
+    carries ``coverage``/``degraded`` when shards were dropped) — re-rank
+    with neighbors/refine for the recall-gated configuration."""
+    from raft_tpu.distributed._sharding import SearchResult, tiled_search
+    from raft_tpu.ops.strip_scan import strip_eligible
+
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.shape[1] != index.dim:
+        raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    if index.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    n_probes = int(min(n_probes, index.n_lists))
+    l2 = index.metric in ("sqeuclidean", "euclidean")
+
+    probes, qr, _, pair_const = sl._bq_search_prep(
+        queries, index.centers, index.rotation,
+        jnp.zeros((1, 1), jnp.float32), jnp.full((1, 1), -1, jnp.int32),
+        None, n_probes, index.metric, "exact", res.compute_dtype, l2,
+    )
+    # dense packed scan off-TPU: the interpreted kernel serializes
+    # virtual-mesh shards (see distributed/ivf_flat.py)
+    interpret = jax.default_backend() != "tpu"
+    vals, ids, report = tiled_search(
+        qr, probes, index.lens_max, index.n_lists, int(k), index.comms,
+        -2.0 if l2 else -1.0,
+        dense=interpret or not strip_eligible(index.max_list_size),
+        interpret=interpret,
+        data=index.list_codes, ids_arr=index.list_ids, bias=index.bias,
+        pair_const=pair_const, algo="ivf_bq", n_total=index.n_total,
+        health=health, scale=index.list_scale, scan="bq",
+    )
+    # the same finalize protocol the single-host fused path uses — one
+    # shared copy, so distance conventions cannot drift between the
+    # single-host and distributed BQ estimates
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    vals, ids = _finalize_ragged(vals, ids, queries, index.metric)
+    return SearchResult(vals, ids, coverage=report.coverage,
+                        degraded=report.degraded,
+                        lost_shards=report.dropped)

@@ -8,6 +8,8 @@ Re-designed TPU-first:
     of the CUDA interleaved-group layout (ivf_flat_types.hpp:47).
   * `ivf_pq` — PQ codebooks + LUT scan (the flagship kernel), bf16/int8 LUT
     compression as the fp8 analog (detail/ivf_pq_fp_8bit.cuh).
+  * `ivf_bq` — RaBitQ-style 1-bit sign codes + unbiased correction scalars,
+    scanned as ±1 MXU contractions (ops/bq_scan.py), exact refine on top.
   * `cagra` — fixed-degree graph + fixed-iteration best-first search with
     sort-based dedup instead of device hashmaps (detail/cagra/hashmap.hpp).
   * `refine` — exact re-ranking of candidate lists (refine-inl.cuh:70).
@@ -20,6 +22,7 @@ from raft_tpu.neighbors import (
     brute_force,
     cagra,
     epsilon_neighborhood,
+    ivf_bq,
     ivf_flat,
     ivf_pq,
     nn_descent,
@@ -29,5 +32,5 @@ from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 
 __all__ = [
     "ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-    "eps_neighbors", "ivf_flat", "ivf_pq", "nn_descent", "refine",
+    "eps_neighbors", "ivf_bq", "ivf_flat", "ivf_pq", "nn_descent", "refine",
 ]

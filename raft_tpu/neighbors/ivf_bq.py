@@ -1,0 +1,554 @@
+"""IVF-BQ: inverted-file index with RaBitQ-style 1-bit quantized vectors.
+
+Reference surface: IVF-RaBitQ (PAPERS.md, "GPU-Native Approximate Nearest
+Neighbor Search with IVF-RaBitQ") — a random rotation, per-residual sign
+codes (1 bit/dim), and an UNBIASED distance estimator built from two
+per-row correction scalars, beating PQ on both build time and search
+throughput. The build has no codebook training at all: encode is one
+rotation matmul + a sign, which is why BQ builds are a kmeans-only cost.
+
+Estimator. Let c_l be the coarse center of list l, r = x − c_l the
+residual, u = R·r̃ its random rotation (R orthogonal ⇒ ‖u‖ = ‖r‖), and
+b = sign(u) ∈ {−1, +1}^D the stored code. RaBitQ's quotient estimator for
+an inner product against any query-side vector v is
+
+    ⟨u, v⟩  ≈  ⟨b, v⟩ · f,      f = ‖u‖² / ‖u‖₁        (unbiased over R;
+                                                         property-tested in
+                                                         tests/test_ivf_bq.py)
+
+(f·b is u's least-squares projection onto b; the random rotation makes the
+orthogonal error mean-free against any fixed v). From it, expanded L2:
+
+    d̂²(q, x) = ‖q‖²                                   (finalize, shared)
+             − 2⟨q, c_l⟩                               (pair_const, exact)
+             + ‖c_l‖² + ‖u‖² + 2·f·⟨b, R·c̃_l⟩         (bias: baked per row)
+             − 2·f·⟨b, R·q̃⟩                           (the scan matmul)
+
+so search-time work is the coarse gemm (which also yields the exact
+−2⟨q, c_l⟩ term) plus ONE ±1 contraction per probed strip — the
+ops/bq_scan.py engine. Per-row storage: rot_dim/8 code bytes + 8 bytes of
+correction scalars (f and the bias) — 32× compression on the code bytes
+against fp32, 4× against the r04 IVF-PQ configuration (pq_dim = dim/2 at
+8 bits). The estimate ranks candidates; callers hold the recall gate by
+over-fetching and exact re-ranking through neighbors/refine
+(:func:`search_refined`), exactly like the IVF-PQ headline path.
+
+Storage is the shared padded-list layout (_packing.pack_lists) at a FIXED
+512 granule / pow2 chunks: code rows are rot_dim/8 bytes, so strip-aligned
+padding costs almost nothing and every IVF-BQ index is strip-eligible —
+the packed kernel and the pure-jnp reference are the only two scan paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu import obs
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.trace import traced
+from raft_tpu.core.resources import Resources, current_resources
+from raft_tpu.core.serialize import load_arrays, save_arrays
+from raft_tpu.neighbors import _packing
+from raft_tpu.neighbors.ivf_pq import _pad_rot, make_rotation_matrix
+from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops.bq_scan import pack_sign_bits
+from raft_tpu.ops.select_k import select_k
+
+SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
+
+#: trace-time (re)compile counter for the fused scan — a delta of zero
+#: across repeated searches is the steady-state zero-recompile contract
+#: asserted by the bench section and the check.sh smoke (the serving
+#: layer's PAGED_TRACES pattern)
+_BQ_TRACES = {"count": 0}
+
+
+def scan_trace_count() -> int:
+    return _BQ_TRACES["count"]
+
+
+@dataclass(frozen=True)
+class IvfBqParams:
+    """Build params (IvfFlatParams shape — BQ has no codebook knobs; the
+    one new degree of freedom is the rotation width, fixed at
+    ceil(dim/8)·8 so codes pack to whole bytes)."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    # per-list occupancy cap: -1 = auto (4× mean, group-aligned), 0 = off
+    list_size_cap: int = -1
+    seed: int = 0
+
+    def __post_init__(self):
+        m = dist_mod.canonical_metric(self.metric)
+        if m not in SUPPORTED_METRICS:
+            raise ValueError(f"ivf_bq supports {SUPPORTED_METRICS}, got {self.metric!r}")
+        object.__setattr__(self, "metric", m)
+
+
+#: fixed list granule: code rows are tiny (rot_dim/8 bytes), so the strip
+#: backend's 512/pow2 alignment is near-free and every index stays
+#: strip-eligible (no gather fallback path to carry)
+_GROUP = 512
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class IvfBqIndex:
+    """Coarse centers + rotation + packed sign codes + correction scalars.
+
+    ``list_codes[l, j]`` holds row j's rot_dim sign bits (bit-plane-major,
+    ops/bq_scan.pack_sign_bits). ``list_scale`` is the per-row unbiasing
+    factor f = ‖u‖²/‖u‖₁ (0 at padding); ``list_bias`` the per-row additive
+    term of the estimator (module docstring; +inf at padding so the scan
+    self-masks). ``list_ids[l, j] == -1`` marks padding."""
+
+    centers: jax.Array     # (n_lists, dim) fp32 — for stage 1, unrotated
+    rotation: jax.Array    # (rot_dim, rot_dim) orthogonal
+    list_codes: jax.Array  # (n_lists, max_list_size, rot_dim/8) uint8
+    list_ids: jax.Array    # (n_lists, max_list_size) int32, -1 = padding
+    list_scale: jax.Array  # (n_lists, max_list_size) fp32
+    list_bias: jax.Array   # (n_lists, max_list_size) fp32, +inf at padding
+    metric: str
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.list_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_ids >= 0))
+
+    @property
+    def code_bytes_per_row(self) -> int:
+        return int(self.list_codes.shape[-1])
+
+    def list_sizes(self) -> jax.Array:
+        return jnp.sum(self.list_ids >= 0, axis=1).astype(jnp.int32)
+
+    def tree_flatten(self):
+        return (self.centers, self.rotation, self.list_codes, self.list_ids,
+                self.list_scale, self.list_bias), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    # -- persistence (v2 crash-safe container, core/serialize) -------------
+    def save(self, path) -> None:
+        save_arrays(
+            path,
+            {"kind": "ivf_bq", "metric": self.metric},
+            {
+                "centers": self.centers,
+                "rotation": self.rotation,
+                "list_codes": self.list_codes,
+                "list_ids": self.list_ids,
+                "list_scale": self.list_scale,
+                "list_bias": self.list_bias,
+            },
+        )
+
+    @classmethod
+    def load(cls, path) -> "IvfBqIndex":
+        meta, arrays = load_arrays(path)
+        if meta.get("kind") != "ivf_bq":
+            raise ValueError(f"not an ivf_bq index: {meta.get('kind')}")
+        return cls(
+            jnp.asarray(arrays["centers"]),
+            jnp.asarray(arrays["rotation"]),
+            jnp.asarray(arrays["list_codes"]),
+            jnp.asarray(arrays["list_ids"]),
+            jnp.asarray(arrays["list_scale"]),
+            jnp.asarray(arrays["list_bias"]),
+            meta["metric"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Build
+# ---------------------------------------------------------------------------
+
+
+def auto_rot_dim(dim: int) -> int:
+    """Rotation width: dim rounded up to whole code bytes."""
+    return -(-dim // 8) * 8
+
+
+@functools.partial(jax.jit, static_argnames=("l2",))
+def _encode_chunk(rows, labels, centers, rotation, rc, c2, l2: bool):
+    """Encode one row chunk: rotate the residual, take signs, bake the two
+    correction scalars. Returns (packed codes (m, nb) uint8, scale (m,)
+    fp32, bias (m,) fp32). The one definition of the estimator's build
+    side — extend() and the distributed build reuse it so the scalars
+    cannot drift between flows."""
+    rot_dim = rotation.shape[0]
+    u = _pad_rot(rows - centers[labels], rot_dim) @ rotation.T
+    signs = jnp.where(u >= 0, jnp.int8(1), jnp.int8(-1))
+    packed = pack_sign_bits(signs)
+    norm2 = jnp.einsum("md,md->m", u, u, preferred_element_type=jnp.float32)
+    norm1 = jnp.sum(jnp.abs(u), axis=1)
+    # f = ‖u‖²/‖u‖₁ — the RaBitQ unbiasing quotient; a zero residual
+    # (row == its center) gets f = 0, which makes the estimate exact
+    scale = norm2 / jnp.maximum(norm1, 1e-30)
+    if l2:
+        # 2·f·⟨b, R·c̃_l⟩ completes the −2⟨q−c, r⟩ cross term exactly at
+        # the per-row level; ‖c‖² + ‖u‖² are the expanded-L2 constants
+        g = jnp.einsum("md,md->m", signs.astype(jnp.float32), rc[labels],
+                       preferred_element_type=jnp.float32)
+        bias = c2[labels] + norm2 + 2.0 * scale * g
+    else:
+        bias = jnp.zeros_like(scale)
+    return packed, scale, bias
+
+
+def _encode_rows(work, labels, centers, rotation, metric,
+                 chunk: int = 262_144):
+    """Chunked encode over all rows (the 15M-row resident build must never
+    hold an (n, rot_dim) fp32 residual array — the ivf_pq enc_chunk
+    lesson)."""
+    n = work.shape[0]
+    l2 = metric in ("sqeuclidean", "euclidean")
+    rot_dim = rotation.shape[0]
+    rc = _pad_rot(centers, rot_dim) @ rotation.T
+    c2 = dist_mod.sqnorm(centers)
+    parts = []
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        parts.append(_encode_chunk(
+            lax.slice_in_dim(work, s, e, axis=0),
+            lax.slice_in_dim(labels, s, e, axis=0),
+            centers, rotation, rc, c2, l2))
+    if len(parts) == 1:
+        return parts[0]
+    return tuple(jnp.concatenate([p[i] for p in parts]) for i in range(3))
+
+
+@traced("ivf_bq::build")
+def build(
+    dataset,
+    params: IvfBqParams = IvfBqParams(),
+    res: Optional[Resources] = None,
+) -> IvfBqIndex:
+    """Train the coarse quantizer, rotate, sign-encode and pack the lists.
+
+    The whole build beyond kmeans is one rotation matmul + sign + two
+    reductions per row — no codebook training (the IVF-RaBitQ build-time
+    headline)."""
+    res = res or current_resources()
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+    rot_dim = auto_rot_dim(dim)
+
+    work = dataset.astype(jnp.float32)
+    if params.metric == "cosine":
+        work = work / jnp.maximum(jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
+
+    km_metric = ("inner_product" if params.metric in ("cosine", "inner_product")
+                 else "sqeuclidean")
+    km = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=km_metric, seed=params.seed)
+    key = jax.random.key(params.seed)
+    k_train, k_rot = jax.random.split(key)
+    n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
+    with obs.record_span("ivf_bq::coarse_train"):
+        if n_train < n:
+            # with-replacement sampling (see ivf_flat.build: duplicates are
+            # noise for k-means, and choice(replace=False) compiles an
+            # O(n log n) permutation program)
+            train_rows = jax.random.randint(k_train, (n_train,), 0, n)
+            centers = kmeans_balanced.fit(work[train_rows], params.n_lists, km, res=res)
+            labels = kmeans_balanced.predict(work, centers, km, res=res)
+        else:
+            centers, labels = kmeans_balanced.fit_predict(work, params.n_lists, km, res=res)
+
+    if obs.enabled():
+        obs.add("ivf_bq.build.rows", n)
+        obs.add("ivf_bq.build.lists", params.n_lists)
+
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(n, params.n_lists, _GROUP)
+    if cap:
+        labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
+
+    rotation = make_rotation_matrix(k_rot, rot_dim)
+    enc_attrs = {"rows": int(n)} if obs.enabled() else None
+    with obs.record_span("ivf_bq::encode", attrs=enc_attrs):
+        codes, scale, bias = _encode_rows(work, labels, centers, rotation,
+                                          params.metric)
+    with obs.record_span("ivf_bq::pack"):
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+        list_codes, list_ids = _packing.pack_lists(
+            codes, row_ids, labels, params.n_lists, _GROUP, pow2_chunks=True)
+        aux, _ = _packing.pack_lists(
+            jnp.stack([scale, bias], axis=1), row_ids, labels,
+            params.n_lists, _GROUP, pow2_chunks=True)
+        list_scale = aux[:, :, 0]
+        list_bias = jnp.where(list_ids >= 0, aux[:, :, 1], jnp.inf)
+    return IvfBqIndex(centers, rotation, list_codes, list_ids, list_scale,
+                      list_bias, params.metric)
+
+
+@traced("ivf_bq::extend")
+def extend(index: IvfBqIndex, new_vectors, new_ids=None,
+           res: Optional[Resources] = None) -> IvfBqIndex:
+    """Encode new vectors with the existing quantizers and repack. The old
+    rows' codes and correction scalars are carried as-is (codes cannot
+    reconstruct vectors, so extension is a repack of payloads, never a
+    re-encode)."""
+    res = res or current_resources()
+    new_vectors = jnp.asarray(new_vectors).astype(jnp.float32)
+    if new_vectors.shape[1] != index.dim:
+        raise ValueError(f"dim mismatch: {new_vectors.shape[1]} != {index.dim}")
+    if index.metric == "cosine":
+        new_vectors = new_vectors / jnp.maximum(
+            jnp.linalg.norm(new_vectors, axis=1, keepdims=True), 1e-30)
+    km_metric = ("inner_product" if index.metric in ("cosine", "inner_product")
+                 else "sqeuclidean")
+    labels = kmeans_balanced.predict(
+        new_vectors, index.centers,
+        kmeans_balanced.KMeansBalancedParams(metric=km_metric), res=res)
+    total = index.size + int(new_vectors.shape[0])
+    cap = _packing.auto_list_cap(total, index.n_lists, _GROUP)
+    labels = _packing.spill_to_cap(
+        new_vectors, index.centers, labels, km_metric, cap,
+        base_counts=index.list_sizes(),
+    )
+    new_codes, new_scale, new_bias = _encode_rows(
+        new_vectors, labels, index.centers, index.rotation, index.metric)
+
+    old_codes, old_ids, old_labels = _packing.unpack_lists(
+        index.list_codes, index.list_ids)
+    old_aux, _, _ = _packing.unpack_lists(
+        jnp.stack([index.list_scale,
+                   jnp.where(index.list_ids >= 0, index.list_bias, 0.0)],
+                  axis=2),
+        index.list_ids)
+    if new_ids is None:
+        start = int(jnp.max(old_ids) + 1) if old_ids.size else 0
+        new_ids = jnp.arange(start, start + new_vectors.shape[0], dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+
+    all_codes = jnp.concatenate([old_codes, new_codes])
+    all_aux = jnp.concatenate(
+        [old_aux, jnp.stack([new_scale, new_bias], axis=1)])
+    all_ids = jnp.concatenate([old_ids, new_ids])
+    all_labels = jnp.concatenate([old_labels, labels])
+    list_codes, list_ids = _packing.pack_lists(
+        all_codes, all_ids, all_labels, index.n_lists, _GROUP,
+        pow2_chunks=True)
+    aux, _ = _packing.pack_lists(all_aux, all_ids, all_labels,
+                                 index.n_lists, _GROUP, pow2_chunks=True)
+    return IvfBqIndex(
+        index.centers, index.rotation, list_codes, list_ids, aux[:, :, 0],
+        jnp.where(list_ids >= 0, aux[:, :, 1], jnp.inf), index.metric)
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "metric", "select_algo", "compute_dtype",
+                     "l2"),
+)
+def _bq_search_prep(queries, centers, rotation, list_bias, list_ids, filter,
+                    n_probes, metric, select_algo, compute_dtype, l2):
+    """Stage 1 + operand prep: ONE coarse gemm feeds both the probe ranking
+    and the exact per-pair center term (the ivf_pq._pq_search_prep
+    protocol); the rotated query is the scan's A operand."""
+    ip_c = dist_mod.matmul_t(queries, centers, None, "highest")
+    if l2:
+        coarse = (dist_mod.sqnorm(queries)[:, None]
+                  + dist_mod.sqnorm(centers)[None, :] - 2.0 * ip_c)
+    else:
+        coarse = -ip_c
+    _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
+    rot_dim = rotation.shape[0]
+    qr = _pad_rot(queries, rot_dim) @ rotation.T
+    bias = list_bias
+    if filter is not None:
+        bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
+    alpha = -2.0 if l2 else -1.0
+    pair_const = alpha * jnp.take_along_axis(ip_c, probes, axis=1)
+    return probes, qr, bias, pair_const
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "classes", "class_counts", "q_tile",
+                     "interpret", "impl"),
+)
+def _bq_fused(queries, centers, rotation, list_codes, list_scale, list_bias,
+              list_ids, filter, cls_ord, k, n_probes, metric, select_algo,
+              compute_dtype, classes, class_counts, q_tile, interpret, impl):
+    """The ENTIRE BQ search — coarse gemm, device strip planning, packed
+    scan, merge, finalize — as one jit: one runtime dispatch, zero host
+    syncs (the round-4 _ragged_fused shape). The in-kernel tournament
+    top-k is allowed (approx_ok=True): this path over-fetches and
+    exact-re-ranks via neighbors/refine, which absorbs its ~1e-4/row
+    bin-collision loss (the IVF-PQ precedent)."""
+    from raft_tpu.ops.bq_scan import bq_strip_search_traced
+
+    _BQ_TRACES["count"] += 1  # runs at trace time only
+    l2 = metric in ("sqeuclidean", "euclidean")
+    # packed coarse select only while its perturbation bound stays tight
+    # (2^-(23-ceil(log2 n_lists)) ≤ 5e-4 at 4096 lists — see
+    # ivf_flat._ragged_fused)
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
+    probes, qr, bias, pair_const = _bq_search_prep(
+        queries, centers, rotation, list_bias, list_ids, filter,
+        n_probes, metric, sa, compute_dtype, l2,
+    )
+    vals, ids = bq_strip_search_traced(
+        qr, probes, list_codes, list_scale, bias, list_ids, cls_ord,
+        classes, class_counts, int(k), int(k), -2.0 if l2 else -1.0,
+        q_tile, interpret, pair_const=pair_const, approx_ok=True, impl=impl,
+    )
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    # shared fused finalizer: ‖Rq̃‖² == ‖q‖² (orthogonal rotation,
+    # zero-padding adds nothing), same alpha conventions as the fp scans
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
+@traced("ivf_bq::search")
+def search(
+    index: IvfBqIndex,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    backend: str = "auto",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN over the 1-bit compressed lists. Returns
+    (distances, indices); distances are UNBIASED estimates, not exact —
+    pipe through :mod:`raft_tpu.neighbors.refine` (or call
+    :func:`search_refined`) for the recall-gated configuration.
+
+    ``backend``: "packed" (the bq_scan Pallas kernel — the TPU path,
+    interpret-mode elsewhere), "reference" (the pure-jnp scan, bit-identical
+    to "packed" — the CPU default and parity oracle), or "auto".
+
+    Recovery contract (round-7 invariant): the scan dispatch carries the
+    ``ivf_bq.search.scan`` faultpoint; an OOM-classified failure retries at
+    half the query tile (down to a floor) with ``ivf_bq.search.degraded_tile``
+    counting the degradation, DEADLINE/FATAL classes propagate classified.
+    """
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, index.n_lists))
+    if not 0 < k <= min(n_probes * index.max_list_size, 512):
+        raise ValueError(
+            f"k={k} out of range (1..min(n_probes·max_list_size, 512)) for "
+            f"n_probes={n_probes} x max_list_size={index.max_list_size}")
+    if index.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+
+    if backend == "auto":
+        backend = "packed" if jax.default_backend() == "tpu" else "reference"
+    if backend not in ("packed", "reference"):
+        raise ValueError(f"unknown backend {backend!r}")
+    impl = "pallas" if backend == "packed" else "jnp"
+    scan_attrs = None
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_bq.search.queries", q_obs)
+        obs.add("ivf_bq.search.probes", q_obs * n_probes)
+        obs.add(f"ivf_bq.search.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k)}
+    from raft_tpu import resilience
+    from raft_tpu.neighbors.ivf_flat import _ragged_plan_static
+
+    # plan with the scan's REAL row width (the bf16 unpacked block the
+    # kernel holds in VMEM is rot_dim wide)
+    classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
+        index, n_probes, k, res, index.rot_dim)
+    q_tile = min(q_tile, queries.shape[0])
+    interpret = jax.default_backend() != "tpu"
+    while True:
+        try:
+            resilience.faultpoint("ivf_bq.search.scan")
+            with obs.record_span("ivf_bq::scan", attrs=scan_attrs):
+                return _bq_fused(
+                    queries, index.centers, index.rotation, index.list_codes,
+                    index.list_scale, index.list_bias, index.list_ids,
+                    filter, cls_ord, int(k), n_probes, index.metric,
+                    select_algo, res.compute_dtype, classes, class_counts,
+                    q_tile, interpret, impl,
+                )
+        except Exception as e:
+            kind = resilience.classify(e)
+            if kind == resilience.OOM and q_tile > 64:
+                # degraded-tile retry: half the query tile halves the
+                # per-dispatch working set; the result is identical, only
+                # the dispatch count grows
+                q_tile = max(64, q_tile // 2)
+                obs.add("ivf_bq.search.degraded_tile")
+                resilience.record_event("degraded_tile",
+                                        site="ivf_bq.search.scan",
+                                        q_tile=q_tile)
+                continue
+            raise
+
+
+@traced("ivf_bq::search_refined")
+def search_refined(
+    index: IvfBqIndex,
+    dataset,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    refine_ratio: int = 4,
+    filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """The recall-gated configuration: over-fetch ``k·refine_ratio``
+    estimated candidates, then exact re-rank against ``dataset`` through
+    neighbors/refine (refine-inl.cuh:70 analog — the same pipe the IVF-PQ
+    headline uses). ``dataset`` is the caller-held original row matrix; the
+    index itself stores only 1-bit codes."""
+    from raft_tpu.neighbors import refine as refine_mod
+
+    if refine_ratio < 1:
+        raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
+    k_fetch = min(int(k) * int(refine_ratio), 512)
+    _, cand = search(index, queries, k_fetch, n_probes=n_probes,
+                     filter=filter, res=res)
+    return refine_mod.refine(dataset, queries, cand, int(k),
+                             metric=index.metric, res=res)

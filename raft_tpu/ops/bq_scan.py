@@ -1,0 +1,356 @@
+"""Packed 1-bit (RaBitQ-style) list scan — the IVF-BQ search engine.
+
+Reference analog: the RaBitQ scan (IVF-RaBitQ, PAPERS.md) evaluates
+binary-code distance estimates per probed list entry; on GPU that is an
+XOR/popcount loop over packed sign words. On TPU there is no popcount
+datapath worth feeding — but the identity
+
+    popcount-form  ⟨q, b⟩  ≡  matmul-form  q · (2·bits − 1)ᵀ
+
+turns the binarized scan into a dense ±1 contraction, which is exactly the
+TPU-KNN peak-FLOP/s formulation (PAPERS.md): saturate the MXU with a
+(queries × codes) matmul instead of emulating bit tricks on the VPU.
+
+Two implementations of the same scan, bit-identical by construction:
+
+  * ``impl="pallas"`` — a strip kernel riding ops/strip_scan's ragged-strip
+    planning (work ∝ probed entries, per-pair top-kf fused in-kernel): the
+    stored codes stay 1 bit/dim in HBM, each grid step DMAs one packed
+    (w, rot_dim/8) uint8 block into VMEM, unpacks it to ±1 int8 there
+    (8 shift-and-mask VPU ops), upcasts to bf16 and runs ONE MXU matmul
+    against the (C, rot_dim) query block. HBM traffic per probed entry is
+    rot_dim/8 bytes — 32× under fp32, 8× under the IVF-PQ int8 cache.
+  * ``impl="jnp"`` — the pure-jnp reference path: the SAME per-strip
+    compute (:func:`_score_topk`, shared code) driven by ``lax.map``
+    instead of ``pl.pallas_call``. CPU default, and the bit-parity oracle
+    the interpret-mode kernel is tested against.
+
+Scores are ``alpha · ⟨q_rot, ±1⟩ · scale + bias``: the per-entry ``scale``
+operand carries the RaBitQ correction scalar (‖u‖²/‖u‖₁ — what makes the
+1-bit estimator unbiased, see neighbors/ivf_bq.py) and is the one structural
+addition over the fp strip kernel; everything downstream (tournament top-kf,
+sub-block revisits, the two-gather merge) is shared with ops/strip_scan.
+
+Bit layout: rotated dimension ``d`` lives at bit ``d // nb`` of byte
+``d % nb`` (``nb = rot_dim // 8``) — bit-PLANE-major, so the in-kernel
+unpack is eight full-width 2-D shift-and-mask ops plus one lane-axis
+concatenate, never a (w, nb, 8) relayout. :func:`pack_sign_bits` /
+:func:`unpack_sign_bits` are the only functions that know this layout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops import strip_scan as ss
+from raft_tpu.ops.strip_scan import C, MC
+
+
+def packed_width(rot_dim: int) -> int:
+    """Bytes per 1-bit-encoded row (rot_dim must be a multiple of 8)."""
+    if rot_dim % 8:
+        raise ValueError(f"rot_dim must be a multiple of 8, got {rot_dim}")
+    return rot_dim // 8
+
+
+def pack_sign_bits(signs) -> jax.Array:
+    """(…, rot_dim) sign vectors (> 0 ⇒ bit 1) → (…, rot_dim/8) uint8 in
+    the bit-plane-major layout (module docstring)."""
+    rot_dim = signs.shape[-1]
+    nb = packed_width(rot_dim)
+    bits = (signs > 0).astype(jnp.uint32)
+    planes = bits.reshape(signs.shape[:-1] + (8, nb))
+    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))[:, None]
+    return jnp.sum(planes * weights, axis=-2).astype(jnp.uint8)
+
+
+def unpack_sign_bits(packed, rot_dim: int) -> jax.Array:
+    """Inverse of :func:`pack_sign_bits` → (…, rot_dim) int8 in {-1, +1}."""
+    nb = packed_width(rot_dim)
+    if packed.shape[-1] != nb:
+        raise ValueError(f"expected {nb} packed bytes, got {packed.shape[-1]}")
+    return _unpack_pm1(packed)
+
+
+def _unpack_pm1(packed):
+    """(…, nb) packed bytes → (…, 8·nb) ±1 int8. 2-D-friendly: eight
+    shift-and-masks + one minor-axis concat (each a full-width vector op in
+    Mosaic — no (…, nb, 8) relayout, see module docstring)."""
+    w = packed.astype(jnp.int32)
+    planes = [((w >> j) & 1) for j in range(8)]
+    bits = jnp.concatenate(planes, axis=-1)
+    return (2 * bits - 1).astype(jnp.int8)
+
+
+def _score_topk(a, b_packed, scale_row, bias_row, alpha: float, kf: int,
+                w: int, approx_ok: bool):
+    """One strip's scores + fused top-kf — THE shared compute of both
+    implementations (kernel refs and jnp gathers feed the same ops, which
+    is what makes the two paths bit-identical).
+
+    a: (C, rot_dim) bf16 query block; b_packed: (w, nb) uint8 codes;
+    scale_row / bias_row: (1, w) fp32. Scores = alpha·(A@(±1)ᵀ)·scale +
+    bias, smaller is better; bias carries +inf at padding."""
+    b = _unpack_pm1(b_packed).astype(jnp.bfloat16)
+    s = lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = alpha * s * scale_row + bias_row
+    return ss._topk_block(s, kf, w, approx_ok)
+
+
+def _bq_strip_kernel(sl_ref, a_ref, b_ref, scale_ref, bias_ref, outv_ref,
+                     oute_ref, *, alpha, kf, w, n_sub, approx_ok):
+    """One strip (× one sub-block when n_sub > 1): in-VMEM unpack + MXU
+    matmul + fused top-kf. Mirrors strip_scan._strip_kernel with the packed
+    B operand and the per-entry scale; padding strips (strip_list == -1)
+    skip the body via ``pl.when`` exactly like the fp kernel."""
+    slv = sl_ref[pl.program_id(0)]
+    j = pl.program_id(1) if n_sub > 1 else 0
+
+    @pl.when(slv >= 0)
+    def _compute():
+        nv, ne = _score_topk(a_ref[0], b_ref[0], scale_ref[0], bias_ref[0],
+                             alpha, kf, w, approx_ok)
+
+        if n_sub == 1:
+            outv_ref[0] = nv
+            oute_ref[0] = ne
+            return
+
+        ne = ne + j * w
+
+        @pl.when(j == 0)
+        def _():
+            outv_ref[0] = nv
+            oute_ref[0] = ne
+
+        @pl.when(j > 0)
+        def _():
+            cv = jnp.concatenate([outv_ref[0], nv], axis=1)   # (C, 2kf)
+            ce = jnp.concatenate([oute_ref[0], ne], axis=1)
+            mv, me = ss._extract_topk(cv, ce, kf)
+            outv_ref[0] = mv
+            oute_ref[0] = me
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("w_blocks", "n_sub", "alpha", "kf", "interpret",
+                     "approx_ok"),
+)
+def _bq_class_call(strip_list, a_grouped, list_codes, scale3, bias3,
+                   w_blocks: int, n_sub: int, alpha: float, kf: int,
+                   interpret: bool, approx_ok: bool = False):
+    """Run one length-class through the Pallas kernel: grid (S,) or
+    (S, n_sub) over (C, W) strips (strip_scan._strip_class_call shape, with
+    the packed B block and the scale operand)."""
+    s_pad, c, rot_dim = a_grouped.shape
+    w = w_blocks * MC
+    nb = list_codes.shape[-1]
+
+    # padding strips: block maps collapse to constants (no refetch), outputs
+    # route to the trash row — the fp kernel's exact convention
+    if n_sub > 1:
+        grid = (s_pad, n_sub)
+        pad_ = lambda i, sl: sl[i] < 0
+        a_map = lambda i, j, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, j, sl: (jnp.maximum(sl[i], 0),
+                                  jnp.where(pad_(i, sl), 0, j), 0)
+        sb_map = lambda i, j, sl: (jnp.maximum(sl[i], 0), 0,
+                                   jnp.where(pad_(i, sl), 0, j))
+        o_map = lambda i, j, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+    else:
+        grid = (s_pad,)
+        pad_ = lambda i, sl: sl[i] < 0
+        a_map = lambda i, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
+        sb_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
+        o_map = lambda i, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, c, rot_dim), a_map),
+            pl.BlockSpec((1, w, nb), b_map),
+            pl.BlockSpec((1, 1, w), sb_map),
+            pl.BlockSpec((1, 1, w), sb_map),
+        ],
+        out_specs=[pl.BlockSpec((1, c, kf), o_map)] * 2,
+    )
+    ov, oe = pl.pallas_call(
+        functools.partial(_bq_strip_kernel, alpha=alpha, kf=kf, w=w,
+                          n_sub=n_sub, approx_ok=approx_ok),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.float32),
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
+        ),
+        interpret=interpret,
+    )(strip_list, a_grouped, list_codes, scale3, bias3)
+    return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
+            lax.slice_in_dim(oe, 0, s_pad, axis=0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("w_blocks", "n_sub", "alpha", "kf", "approx_ok"),
+)
+def _bq_class_jnp(strip_list, a_grouped, list_codes, scale3, bias3,
+                  w_blocks: int, n_sub: int, alpha: float, kf: int,
+                  approx_ok: bool = False):
+    """Pure-jnp reference for one length-class: the SAME per-(strip,
+    sub-block) op sequence as the kernel (shared :func:`_score_topk`, same
+    ``_extract_topk`` sub-block merge), driven by a sequential ``lax.map``
+    over strips so memory stays bounded. Padding strips (sl < 0) compute
+    against list 0 — their outputs, like the kernel's unwritten garbage,
+    are never read by the merge."""
+    w = w_blocks * MC
+
+    def one_strip(args):
+        sl, a = args
+        l = jnp.maximum(sl, 0)
+
+        def sub(j, carry):
+            ov, oe = carry
+            blk = lax.dynamic_slice_in_dim(list_codes[l], j * w, w, axis=0)
+            sc = lax.dynamic_slice_in_dim(scale3[l, 0], j * w, w)[None, :]
+            bi = lax.dynamic_slice_in_dim(bias3[l, 0], j * w, w)[None, :]
+            nv, ne = _score_topk(a, blk, sc, bi, alpha, kf, w, approx_ok)
+            ne = ne + j * w
+            if n_sub == 1:
+                return nv, ne
+            cv = jnp.concatenate([ov, nv], axis=1)
+            ce = jnp.concatenate([oe, ne], axis=1)
+            mv, me = ss._extract_topk(cv, ce, kf)
+            # j == 0 initializes exactly like the kernel's first write —
+            # never through the merge (bit parity of the merged offsets)
+            return (jnp.where(j == 0, nv, mv), jnp.where(j == 0, ne, me))
+
+        init = (jnp.full((C, kf), jnp.inf, jnp.float32),
+                jnp.zeros((C, kf), jnp.int32))
+        return lax.fori_loop(0, n_sub, sub, init)
+
+    return lax.map(one_strip, (strip_list, a_grouped))
+
+
+def _bq_tile_body(queries_rot, qids, strip_list, pair_strip, pair_slot,
+                  list_codes, scale, bias, list_ids, class_layout,
+                  k: int, kf: int, alpha: float, interpret: bool,
+                  pair_const=None, approx_ok: bool = False,
+                  impl: str = "pallas"):
+    """One query tile of the packed scan: group the query side per strip,
+    run every length class through the chosen implementation, then the
+    shared two-gather merge (strip_scan.merge_strip_candidates). Plain
+    traceable function so SPMD callers can run it inside shard_map
+    (distributed/ivf_bq)."""
+    n_lists, m = list_codes.shape[0], list_codes.shape[1]
+    a_grouped = jnp.where(
+        (qids >= 0)[:, :, None],
+        queries_rot[jnp.clip(qids, 0), :],
+        0,
+    ).astype(jnp.bfloat16)                           # (S_pad, C, rot_dim)
+    bias3 = bias.reshape(n_lists, 1, m)
+    scale3 = scale.reshape(n_lists, 1, m)
+
+    outs_v, outs_e = [], []
+    for (w_blocks, n_sub, start, count) in class_layout:
+        sl = lax.slice_in_dim(strip_list, start, start + count, axis=0)
+        ag = lax.slice_in_dim(a_grouped, start, start + count, axis=0)
+        if impl == "pallas":
+            ov, oe = _bq_class_call(sl, ag, list_codes, scale3, bias3,
+                                    w_blocks, n_sub, alpha, kf, interpret,
+                                    approx_ok)
+        else:
+            ov, oe = _bq_class_jnp(sl, ag, list_codes, scale3, bias3,
+                                   w_blocks, n_sub, alpha, kf, approx_ok)
+        outs_v.append(ov)
+        outs_e.append(oe)
+    out_v = jnp.concatenate(outs_v, axis=0) if len(outs_v) > 1 else outs_v[0]
+    out_e = jnp.concatenate(outs_e, axis=0) if len(outs_e) > 1 else outs_e[0]
+    return ss.merge_strip_candidates(out_v, out_e, strip_list, pair_strip,
+                                     pair_slot, list_ids, class_layout, k,
+                                     kf, interpret, pair_const)
+
+
+def bq_strip_search_traced(queries_rot, probes, list_codes, scale, bias,
+                           list_ids, cls_ord, classes, class_counts,
+                           k: int, kf: int, alpha: float, q_tile: int,
+                           interpret: bool, pair_const=None,
+                           approx_ok: bool = False, impl: str = "pallas"):
+    """Sync-free packed strip search — fully traceable so callers fuse
+    coarse quantizer + device planning + scan + finalize into ONE dispatch
+    (the strip_scan.strip_search_traced protocol, packed-B edition).
+
+    queries_rot: (q, rot_dim) ROTATED queries. list_codes: (n_lists, m,
+    rot_dim/8) packed sign codes. scale / bias: (n_lists, m) per-entry
+    fp32 correction scalar and additive term (+inf bias at padding).
+    ``impl`` picks the Pallas kernel or the pure-jnp reference — identical
+    results either way (tests/test_bq_scan.py asserts bit parity)."""
+    q, p = probes.shape
+    n_lists = list_codes.shape[0]
+    out_v, out_i = [], []
+    for start in range(0, q, q_tile):
+        qt = min(q_tile, q - start)
+        region_starts, s_tot, layout = ss.static_layout(
+            classes, class_counts, qt, p)
+        qids, strip_list, pair_strip, pair_slot, _ = ss._plan_device(
+            lax.slice_in_dim(probes, start, start + qt, axis=0),
+            cls_ord, n_lists, region_starts, s_tot,
+        )
+        v, i = _bq_tile_body(
+            lax.slice_in_dim(queries_rot, start, start + qt, axis=0),
+            qids, strip_list, pair_strip, pair_slot, list_codes, scale,
+            bias, list_ids, layout, int(k), kf, float(alpha),
+            bool(interpret),
+            None if pair_const is None
+            else lax.slice_in_dim(pair_const, start, start + qt, axis=0),
+            approx_ok, impl,
+        )
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
+
+
+def bq_dense_scan(queries_rot, probes, list_codes, scale, bias, list_ids,
+                  k: int, alpha: float, pair_const=None):
+    """Jittable dense packed scan — the distributed layer's off-TPU / small-
+    shard path (the bq analog of _sharding.dense_local_scan): probe-tiled
+    ``lax.map`` so one probe's (q, mls, rot_dim) unpacked block is the peak
+    intermediate, fp32 accumulation."""
+    q = queries_rot.shape[0]
+    qf = queries_rot.astype(jnp.float32)
+
+    def one_probe(j):
+        lids = probes[:, j]                              # (q,)
+        cand = _unpack_pm1(list_codes[lids]).astype(jnp.float32)
+        ip = jnp.einsum("qd,qmd->qm", qf, cand,
+                        preferred_element_type=jnp.float32)
+        d = alpha * ip * scale[lids] + bias[lids]
+        if pair_const is not None:
+            d = d + pair_const[:, j, None]
+        return d, list_ids[lids]
+
+    p = probes.shape[1]
+    d_all, ids_all = lax.map(one_probe, jnp.arange(p))   # (p, q, mls)
+    d = jnp.transpose(d_all, (1, 0, 2)).reshape(q, -1)
+    flat_ids = jnp.transpose(ids_all, (1, 0, 2)).reshape(q, -1)
+    from raft_tpu.ops.select_k import select_k
+
+    vals, sel = select_k(d, min(k, d.shape[1]), select_min=True)
+    ids = jnp.where(jnp.isinf(vals), -1,
+                    jnp.take_along_axis(flat_ids, sel, axis=1))
+    if ids.shape[1] < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - ids.shape[1])),
+                       constant_values=jnp.inf)
+        ids = jnp.pad(ids, ((0, 0), (0, k - ids.shape[1])),
+                      constant_values=-1)
+    return vals, ids

@@ -478,13 +478,26 @@ def _strip_tile_body(queries_mat, qids, strip_list, pair_strip, pair_slot,
     out_v = jnp.concatenate(outs_v, axis=0) if len(outs_v) > 1 else outs_v[0]
     out_e = jnp.concatenate(outs_e, axis=0) if len(outs_e) > 1 else outs_e[0]
 
-    # pair_strip uses the PLAN's strip numbering (device plans leave gaps
-    # between class regions); the class outputs above are concatenated
-    # densely — remap by the static per-class delta (identity for gap-free
-    # host plans). Without this the merge reads the wrong rows whenever a
-    # class's padded count is below its region size (round-3 on-chip bug:
-    # recall collapsed to 0.16 while every small CPU test's buckets happened
-    # to equal the region size).
+    return merge_strip_candidates(out_v, out_e, strip_list, pair_strip,
+                                  pair_slot, list_ids, class_layout, k, kf,
+                                  interpret, pair_const)
+
+
+def merge_strip_candidates(out_v, out_e, strip_list, pair_strip, pair_slot,
+                           list_ids, class_layout, k: int, kf: int,
+                           interpret: bool, pair_const=None):
+    """The two-gather candidate merge shared by every strip-shaped engine
+    (the fp B-operand kernel here and the packed 1-bit kernel in
+    ops/bq_scan.py — one copy, so the remap/select/translate protocol
+    cannot drift between them).
+
+    pair_strip uses the PLAN's strip numbering (device plans leave gaps
+    between class regions); the class outputs are concatenated densely —
+    remap by the static per-class delta (identity for gap-free host
+    plans). Without this the merge reads the wrong rows whenever a
+    class's padded count is below its region size (round-3 on-chip bug:
+    recall collapsed to 0.16 while every small CPU test's buckets happened
+    to equal the region size)."""
     q, p = pair_strip.shape
     if len(class_layout) > 1:
         concat_starts = np.cumsum([0] + [cnt for (_, _, _, cnt)

@@ -149,10 +149,32 @@ def direction(metric: str) -> str:
         return "info"
     if tail.endswith("_ub") or tail.endswith("_s") or "latency" in tail:
         return "down"
+    # capacity/compression metrics (bench.ivf_bq.*): resident-bytes and
+    # recompile counts shrink toward good; capacity rows and compression
+    # ratios grow toward good — without these a 2× code-bytes regression
+    # would render as informational
+    if tail.endswith("bytes_per_row") or "recompiles" in tail:
+        return "down"
+    if tail.endswith("capacity_rows") or tail.endswith("compression_x"):
+        return "up"
     if "qps" in tail or tail in ("value", "vs_baseline", "recall",
-                                 "recall_gate_met", "ann_beats_brute"):
+                                 "recall_gate_met", "ann_beats_brute",
+                                 "per_chip_measured", "per_chip_recall"):
         return "up"
     return "info"
+
+
+#: per-metric defaults (overridable via --metric-threshold): the ivf_bq
+#: capacity/compression numbers are step functions of the configuration —
+#: ANY shrink is a regression worth a row, so their threshold is 0
+_DEFAULT_METRIC_THRESHOLDS = {
+    "ivf_bq.per_chip_capacity_rows": 0.0,
+    "ivf_bq.code_compression_x": 0.0,
+    "ivf_bq.code_bytes_per_row": 0.0,
+    "ivf_bq.recompiles_during_search": 0.0,
+    "ivf_bq.recall": 0.01,
+    "ivf_bq.per_chip_recall": 0.01,
+}
 
 
 def compare(a: dict, b: dict, threshold: float, per_metric: dict):
@@ -248,7 +270,7 @@ def main(argv=None) -> int:
                     help="exit 1 when any regression verdict exists")
     args = ap.parse_args(argv)
 
-    per_metric = {}
+    per_metric = dict(_DEFAULT_METRIC_THRESHOLDS)
     for spec in args.metric_threshold:
         metric, _, frac = spec.partition("=")
         try:

@@ -61,14 +61,14 @@ echo "== degraded-mode shard-loss smoke (ISSUE 7) =="
 # must return PARTIAL results stamped degraded with coverage < 1 — a lost
 # shard costs coverage, never the query. Non-zero exit on full failure.
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-RAFT_TPU_FAULTS="distributed.brute_force.search.shard=fatal:1,distributed.ivf_flat.search.shard=fatal:1,distributed.ivf_pq.search.shard=fatal:1,distributed.cagra.search.shard=fatal:1" \
+RAFT_TPU_FAULTS="distributed.brute_force.search.shard=fatal:1,distributed.ivf_flat.search.shard=fatal:1,distributed.ivf_pq.search.shard=fatal:1,distributed.ivf_bq.search.shard=fatal:1,distributed.cagra.search.shard=fatal:1" \
 python - <<'EOF' || fail=1
 import numpy as np
 from raft_tpu import resilience
 from raft_tpu.comms import Comms, local_mesh
 from raft_tpu.distributed import brute_force as dbf, cagra as dcagra, \
-    ivf_flat as divf, ivf_pq as dpq
-from raft_tpu.neighbors import cagra as slcagra, ivf_pq
+    ivf_bq as dbq, ivf_flat as divf, ivf_pq as dpq
+from raft_tpu.neighbors import cagra as slcagra, ivf_bq, ivf_pq
 
 rng = np.random.default_rng(0)
 X = rng.standard_normal((1024, 16)).astype(np.float32)
@@ -81,6 +81,9 @@ runs = {
         Q, 5, n_probes=8),
     "ivf_pq": lambda: dpq.search(
         dpq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8), comms=comms),
+        Q, 5, n_probes=8),
+    "ivf_bq": lambda: dbq.search(
+        dbq.build(X, ivf_bq.IvfBqParams(n_lists=8), comms=comms),
         Q, 5, n_probes=8),
     "cagra": lambda: dcagra.search(
         dcagra.build(X, slcagra.CagraParams(
@@ -123,6 +126,30 @@ assert cag.get("traversal") == "fused", cag
 assert cag.get("hops_per_batch", 0) > 0, cag
 print("tiny fused smoke: OK (qps=%s recall=%s hops/batch=%s)"
       % (cag["qps"], cag["recall"], cag["hops_per_batch"]))
+EOF
+
+echo
+echo "== bench tiny smoke (IVF-BQ 1-bit scan + refine) =="
+# Tiny-bench IVF-BQ rung: the recall gate must hold AFTER the exact
+# re-rank (>=0.9 at smoke scale) and the timed repeated searches must
+# re-dispatch one compiled program (zero scan retraces — the steady-state
+# zero-recompile contract).
+RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=ivf_bq \
+RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb_bq.jsonl python - <<'EOF' || fail=1
+import json, subprocess, sys
+proc = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                      text=True, timeout=600)
+assert proc.returncode == 0, proc.stderr[-2000:]
+line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+bq = json.loads(line)["extras"]["ivf_bq"]
+assert "error" not in bq, bq
+assert bq["recall"] >= 0.9, bq
+assert bq.get("recompiles_during_search", 99) == 0, bq
+assert bq.get("per_chip_measured"), bq
+print("tiny ivf_bq smoke: OK (qps=%s recall=%s code_bytes/row=%s "
+      "compression=%sx)" % (bq["qps"], bq["recall"],
+                            bq["code_bytes_per_row"],
+                            bq["code_compression_x"]))
 EOF
 
 echo

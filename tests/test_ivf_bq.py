@@ -1,0 +1,260 @@
+"""IVF-BQ tests — recall oracle vs exact brute force (the ann_ivf_* test
+methodology), estimator unbiasedness property, backend bit-parity, and the
+zero-recompile steady-state contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_bq
+
+
+def _recall(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    k = want.shape[1]
+    return np.mean([len(set(got[r]) & set(want[r])) / k for r in range(want.shape[0])])
+
+
+@pytest.fixture(scope="module")
+def data():
+    """The bench generator's clustered uint8 data (the IVF regime:
+    residuals small against centers — white gaussian is the 1-bit
+    estimator's worst case and tests nothing but noise floor)."""
+    from raft_tpu.bench.datasets import sift_like
+
+    data_u8, queries_u8 = sift_like(20_000, 64, 200)
+    return (np.asarray(data_u8, np.float32),
+            np.asarray(queries_u8, np.float32))
+
+
+class TestIvfBq:
+    def test_refined_recall_l2(self, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(n_lists=64, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_bq.search_refined(idx, ds, qs, 10, n_probes=16,
+                                       refine_ratio=8)
+        assert _recall(got, exact) >= 0.95
+
+    def test_raw_estimates_rank(self, data):
+        """Unrefined estimates must already rank usefully (well above the
+        random-candidate floor) and improve with probes."""
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(n_lists=64, seed=0))
+        _, exact = brute_force.knn(qs, ds, 10)
+        r_lo = _recall(ivf_bq.search(idx, qs, 10, n_probes=2)[1], exact)
+        r_hi = _recall(ivf_bq.search(idx, qs, 10, n_probes=32)[1], exact)
+        assert r_hi >= r_lo
+        assert r_hi >= 0.5
+
+    def test_inner_product(self, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(n_lists=64,
+                                                  metric="inner_product"))
+        _, exact = brute_force.knn(qs, ds, 10, metric="inner_product")
+        _, got = ivf_bq.search_refined(idx, ds, qs, 10, n_probes=32,
+                                       refine_ratio=8)
+        assert _recall(got, exact) >= 0.85
+
+    def test_cosine(self, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(n_lists=64, metric="cosine"))
+        _, exact = brute_force.knn(qs, ds, 10, metric="cosine")
+        # cosine needs the widest over-fetch: angular gaps between near
+        # neighbors are the smallest signal the 1-bit estimate must rank
+        vals, got = ivf_bq.search_refined(idx, ds, qs, 10, n_probes=32,
+                                          refine_ratio=16)
+        assert _recall(got, exact) >= 0.85
+        v = np.asarray(vals)
+        assert np.all(v >= -1e-4) and np.all(v <= 2.0001), "cosine range"
+
+    def test_backend_bit_parity(self, data):
+        """packed (interpret-mode kernel) vs reference (pure jnp): ids AND
+        distances bit-identical — the acceptance-criteria contract at the
+        index level."""
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(n_lists=32, seed=1))
+        v1, i1 = ivf_bq.search(idx, qs, 10, n_probes=8, backend="packed")
+        v2, i2 = ivf_bq.search(idx, qs, 10, n_probes=8, backend="reference")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_extend(self, data):
+        ds, qs = data
+        half = ds.shape[0] // 2
+        idx = ivf_bq.build(ds[:half], ivf_bq.IvfBqParams(n_lists=64, seed=0))
+        idx = ivf_bq.extend(idx, ds[half:])
+        assert idx.size == ds.shape[0]
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_bq.search_refined(idx, ds, qs, 10, n_probes=16,
+                                       refine_ratio=8)
+        assert _recall(got, exact) >= 0.9
+
+    def test_extend_preserves_old_rows_bitwise(self, data):
+        """Old rows' codes and correction scalars ride extension as
+        payloads — a re-encode would be impossible (codes cannot
+        reconstruct vectors) so any drift is a bug."""
+        ds, _ = data
+        idx = ivf_bq.build(ds[:4000], ivf_bq.IvfBqParams(n_lists=16, seed=0))
+        before = {}
+        ids0 = np.asarray(idx.list_ids)
+        codes0 = np.asarray(idx.list_codes)
+        scale0 = np.asarray(idx.list_scale)
+        for l in range(idx.n_lists):
+            for j in range(int((ids0[l] >= 0).sum())):
+                before[ids0[l, j]] = (codes0[l, j].copy(), scale0[l, j])
+        idx2 = ivf_bq.extend(idx, ds[4000:5000])
+        ids1 = np.asarray(idx2.list_ids)
+        codes1 = np.asarray(idx2.list_codes)
+        scale1 = np.asarray(idx2.list_scale)
+        checked = 0
+        for l in range(idx2.n_lists):
+            for j in range(int((ids1[l] >= 0).sum())):
+                rid = ids1[l, j]
+                if rid in before:
+                    want_c, want_s = before[rid]
+                    np.testing.assert_array_equal(codes1[l, j], want_c)
+                    assert scale1[l, j] == want_s
+                    checked += 1
+        assert checked == 4000
+
+    def test_filter(self, data):
+        ds, qs = data
+        n = 5000
+        idx = ivf_bq.build(ds[:n], ivf_bq.IvfBqParams(n_lists=32, seed=0))
+        keep = Bitset.from_mask(np.arange(n) < n // 2)
+        _, got = ivf_bq.search_refined(idx, ds[:n], qs, 10, n_probes=32,
+                                       refine_ratio=8, filter=keep)
+        got = np.asarray(got)
+        assert got.max() < n // 2
+
+    def test_serialize_roundtrip_bit_parity(self, tmp_path, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds[:5000], ivf_bq.IvfBqParams(n_lists=32, seed=0))
+        p = tmp_path / "bq.raft"
+        idx.save(p)
+        idx2 = ivf_bq.IvfBqIndex.load(p)
+        v1, i1 = ivf_bq.search(idx, qs, 5, n_probes=8)
+        v2, i2 = ivf_bq.search(idx2, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_zero_recompiles_steady_state(self, data):
+        """Repeated searches after warmup re-dispatch ONE compiled program
+        (the bench/check.sh contract, counted at trace time)."""
+        ds, qs = data
+        idx = ivf_bq.build(ds[:4000], ivf_bq.IvfBqParams(n_lists=16, seed=0))
+        ivf_bq.search(idx, qs, 10, n_probes=8)  # warm
+        t0 = ivf_bq.scan_trace_count()
+        for _ in range(3):
+            ivf_bq.search(idx, qs, 10, n_probes=8)
+        assert ivf_bq.scan_trace_count() - t0 == 0
+
+    def test_compression(self, data):
+        ds, _ = data
+        idx = ivf_bq.build(ds[:2000], ivf_bq.IvfBqParams(n_lists=16))
+        # 64 dims → 64 bits → 8 bytes/row: 32× under the fp32 row
+        assert idx.code_bytes_per_row == 8
+        assert idx.rot_dim == 64
+
+    def test_validation(self, data):
+        ds, qs = data
+        with pytest.raises(ValueError):
+            ivf_bq.IvfBqParams(metric="l1")
+        with pytest.raises(ValueError):
+            ivf_bq.build(ds[:10], ivf_bq.IvfBqParams(n_lists=100))
+        idx = ivf_bq.build(ds[:2000], ivf_bq.IvfBqParams(n_lists=16))
+        with pytest.raises(ValueError):
+            ivf_bq.search(idx, qs[:, :16], 5)
+        with pytest.raises(ValueError):
+            ivf_bq.search(idx, qs, 0)
+        with pytest.raises(ValueError):
+            ivf_bq.search(idx, qs, 5, backend="nope")
+        with pytest.raises(ValueError):
+            ivf_bq.search_refined(idx, ds[:2000], qs, 5, refine_ratio=0)
+
+
+class TestEstimatorUnbiased:
+    def test_mean_signed_error_vanishes_over_rotations(self):
+        """The RaBitQ property the whole index rests on: pooled over random
+        rotations, the signed error of f·⟨b, Rv⟩ against ⟨u, Rv⟩ = ⟨x, v⟩
+        cancels (|mean| ≪ mean |error|), i.e. the estimator is unbiased —
+        a systematically scaled or shifted estimator fails this gate."""
+        from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+
+        rng = np.random.default_rng(3)
+        D, n, S = 64, 256, 16
+        X = rng.standard_normal((n, D)).astype(np.float32)
+        v = rng.standard_normal(D).astype(np.float32)
+        true = X @ v
+        errs = []
+        for s in range(S):
+            R = np.asarray(make_rotation_matrix(jax.random.key(s), D))
+            U = X @ R.T
+            B = np.where(U >= 0, 1.0, -1.0).astype(np.float32)
+            f = (U * U).sum(1) / np.abs(U).sum(1)
+            est = f * (B @ (R @ v))
+            errs.append(est - true)
+        errs = np.concatenate(errs)
+        mean_abs = np.abs(errs).mean()
+        assert mean_abs > 0  # the estimate is not degenerate
+        assert abs(errs.mean()) < 0.05 * mean_abs, (errs.mean(), mean_abs)
+
+    def test_biased_scalar_fails_the_same_gate(self):
+        """Negative control: the naive projection scalar ‖u‖₁/D (biased
+        low by cos²(u, b) ≈ 2/π) must NOT pass the unbiasedness gate —
+        proving the gate has teeth. The rows carry a common component
+        along v so the per-row biases cannot cancel across ± true
+        values (a −36% multiplicative bias is invisible when
+        E[⟨x, v⟩] = 0)."""
+        from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+
+        rng = np.random.default_rng(3)
+        D, n, S = 64, 256, 16
+        v = rng.standard_normal(D).astype(np.float32)
+        X = (rng.standard_normal((n, D)) + 0.5 * v).astype(np.float32)
+        true = X @ v
+        errs = []
+        for s in range(S):
+            R = np.asarray(make_rotation_matrix(jax.random.key(s), D))
+            U = X @ R.T
+            B = np.where(U >= 0, 1.0, -1.0).astype(np.float32)
+            f_bad = np.abs(U).sum(1) / D          # projection scalar
+            errs.append(f_bad * (B @ (R @ v)) - true)
+        errs = np.concatenate(errs)
+        assert abs(errs.mean()) > 0.05 * np.abs(errs).mean()
+
+    def test_build_scalars_match_definition(self, data):
+        """The packed index's per-row scalars equal the estimator
+        definition recomputed from the raw rows (f = ‖u‖²/‖u‖₁, bias =
+        ‖c‖² + ‖u‖² + 2f⟨b, Rc̃⟩)."""
+        ds, _ = data
+        n = 1000
+        idx = ivf_bq.build(ds[:n], ivf_bq.IvfBqParams(n_lists=8, seed=0))
+        R = np.asarray(idx.rotation)
+        centers = np.asarray(idx.centers)
+        ids = np.asarray(idx.list_ids)
+        scale = np.asarray(idx.list_scale)
+        bias = np.asarray(idx.list_bias)
+        from raft_tpu.ops.bq_scan import unpack_sign_bits
+
+        codes = np.asarray(unpack_sign_bits(jnp.asarray(idx.list_codes),
+                                            idx.rot_dim))
+        pad = idx.rot_dim - ds.shape[1]
+        checked = 0
+        for l in range(idx.n_lists):
+            for j in range(min(int((ids[l] >= 0).sum()), 20)):
+                x = ds[ids[l, j]]
+                u = R @ np.pad(x - centers[l], (0, pad))
+                f = (u @ u) / np.abs(u).sum()
+                np.testing.assert_allclose(scale[l, j], f, rtol=2e-4)
+                b = np.where(u >= 0, 1.0, -1.0)
+                g = float(b @ (R @ np.pad(centers[l], (0, pad))))
+                want_bias = (centers[l] @ centers[l]) + (u @ u) + 2 * f * g
+                np.testing.assert_allclose(bias[l, j], want_bias,
+                                           rtol=2e-3, atol=2e-2)
+                checked += 1
+        assert checked >= 100

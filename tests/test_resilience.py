@@ -325,6 +325,49 @@ def test_cagra_fused_hop_deadline_reraises(rng):
                 if e["event"] == "fused_fallback"]
 
 
+def test_ivf_bq_scan_oom_recovers_degraded_tile(rng):
+    """Round-7 invariant for the 1-bit scan (ISSUE 9): an OOM-classified
+    failure at the ``ivf_bq.search.scan`` dispatch site retries at half
+    the query tile with identical results, counting
+    ``ivf_bq.search.degraded_tile`` and recording the event."""
+    from raft_tpu.neighbors import ivf_bq
+
+    X = np.asarray(rng.normal(size=(3000, 16)), np.float32)
+    Q = np.asarray(rng.normal(size=(200, 16)), np.float32)
+    idx = ivf_bq.build(X, ivf_bq.IvfBqParams(n_lists=8, seed=0))
+    gt_v, gt_i = ivf_bq.search(idx, Q, 5, n_probes=8)
+    resilience.arm_faults("ivf_bq.search.scan=oom:1")
+    obs.enable()
+    v, i = ivf_bq.search(idx, Q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(gt_i))
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(gt_v))
+    assert obs.snapshot()["counters"].get("ivf_bq.search.degraded_tile", 0) >= 1
+    ev = [e for e in resilience.recent_events()
+          if e["event"] == "degraded_tile"]
+    assert ev and ev[-1]["site"] == "ivf_bq.search.scan"
+
+
+def test_ivf_bq_scan_hang_verdict_is_classified_deadline(rng):
+    """A hang at the scan site under a hard deadline produces a classified
+    DEADLINE verdict in ~the budget (never a degraded-tile retry — expired
+    scopes are not retryable), the round-7 bounded-verdict contract."""
+    from raft_tpu.neighbors import ivf_bq
+
+    X = np.asarray(rng.normal(size=(2000, 16)), np.float32)
+    Q = np.asarray(rng.normal(size=(50, 16)), np.float32)
+    idx = ivf_bq.build(X, ivf_bq.IvfBqParams(n_lists=8, seed=0))
+    resilience.arm_faults("ivf_bq.search.scan=hang:1:30")  # 30s cap
+    t0 = time.monotonic()
+    with resilience.Deadline(0.3, label="bq-probe"):
+        with pytest.raises(resilience.DeadlineExceeded) as ei:
+            ivf_bq.search(idx, Q, 5, n_probes=8)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"verdict took {elapsed:.1f}s (budget was 0.3s)"
+    assert resilience.classify(ei.value) == resilience.DEADLINE
+    assert not [e for e in resilience.recent_events()
+                if e["event"] == "degraded_tile"]
+
+
 def test_search_out_of_core_oom_recovers(rng):
     X, Q = _dataset(rng)
     gt_v, gt_i = brute_force.knn(Q, X, 5)

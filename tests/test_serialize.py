@@ -7,8 +7,8 @@ Four layers:
   as a classified FATAL NAMING the corrupt array, v1 files still loadable;
 * atomicity — a fatal injected mid-write (``serialize.save.write``) leaves
   the previous file intact, never a torn one;
-* index round-trips — save→load→search bit parity for all five index
-  types (brute_force, ivf_flat, ivf_pq, cagra, hnsw export);
+* index round-trips — save→load→search bit parity for all six index
+  types (brute_force, ivf_flat, ivf_pq, ivf_bq, cagra, hnsw export);
 * hnsw load validation — wrong-kind / truncated / garbage files fail with
   a classified ValueError before any parse.
 """
@@ -104,6 +104,20 @@ class TestContainerV2:
         assert "CRC32" in str(ei.value)
         assert resilience.classify(ei.value) == resilience.FATAL
 
+    def test_garbage_meta_is_classified(self, tmp_path):
+        """Garbage bytes inside the meta JSON (valid magic, stomped
+        payload) must surface as SnapshotCorruptError, not a raw
+        UnicodeDecodeError/JSONDecodeError — both are ValueError
+        subclasses and used to slip through the re-raise clause."""
+        path = str(tmp_path / "c.raft")
+        save_arrays(path, {"kind": "t"}, {"a": np.zeros(64)})
+        raw = bytearray(open(path, "rb").read())
+        raw[20:40] = bytes([0xFF] * 20)  # inside the meta block
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(SnapshotCorruptError):
+            load_arrays(path)
+
     def test_v1_still_loads(self, tmp_path):
         path = str(tmp_path / "v1.raft")
         arrays = {"x": np.arange(7, dtype=np.int64)}
@@ -135,7 +149,7 @@ class TestContainerV2:
 
 
 # ---------------------------------------------------------------------------
-# index save → load → search bit parity (all five types)
+# index save → load → search bit parity (all six types)
 # ---------------------------------------------------------------------------
 
 
@@ -178,6 +192,28 @@ class TestIndexRoundtrips:
         v1, i1 = ivf_pq.search(idx2, Q, 10, n_probes=8)
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
         np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_ivf_bq(self, tmp_path, data):
+        from raft_tpu.neighbors import ivf_bq
+
+        X, Q = data
+        idx = ivf_bq.build(X, ivf_bq.IvfBqParams(n_lists=8))
+        v0, i0 = ivf_bq.search(idx, Q, 10, n_probes=8)
+        path = str(tmp_path / "bq.raft")
+        idx.save(path)
+        idx2 = ivf_bq.IvfBqIndex.load(path)
+        assert idx2.list_codes.dtype == np.uint8
+        v1, i1 = ivf_bq.search(idx2, Q, 10, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_ivf_bq_wrong_kind_rejected(self, tmp_path):
+        from raft_tpu.neighbors import ivf_bq
+
+        path = str(tmp_path / "notbq.raft")
+        save_arrays(path, {"kind": "ivf_flat"}, {"a": np.zeros(4)})
+        with pytest.raises(ValueError, match="not an ivf_bq index"):
+            ivf_bq.IvfBqIndex.load(path)
 
     def test_cagra(self, tmp_path, data):
         from raft_tpu.neighbors import cagra
