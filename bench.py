@@ -218,6 +218,7 @@ def run_suite():
     from raft_tpu.bench.datasets import sift_like
     from raft_tpu.neighbors import (brute_force, cagra, ivf_bq, ivf_flat,
                                     ivf_pq, refine)
+    from raft_tpu.obs import memory as obs_memory
 
     # telemetry ON for the whole measured child (round-8): the bench window
     # exists to answer where the time went, so spans/counters/latency
@@ -424,6 +425,9 @@ def run_suite():
             flat.update(latency_percentiles("bench.ivf_flat.batch_latency_s"))
             flat["build_s"] = cold_s
             flat["build_warm_s"] = warm_s
+            # per-index residency watermark (ISSUE 10): gauge + metric line
+            flat["index_bytes"] = obs_memory.record_index(
+                "ivf_flat", flat_index)
             if flat_cache:
                 flat["index_cache"] = flat_cache
             extras["ivf_flat"] = flat
@@ -490,6 +494,7 @@ def run_suite():
             pq.update(latency_percentiles("bench.ivf_pq.batch_latency_s"))
             pq["build_s"] = cold_s
             pq["build_warm_s"] = warm_s
+            pq["index_bytes"] = obs_memory.record_index("ivf_pq", pq_index)
             if pq_cache:
                 pq["index_cache"] = pq_cache
             extras["ivf_pq"] = pq
@@ -553,6 +558,7 @@ def run_suite():
             bq.update(latency_percentiles("bench.ivf_bq.batch_latency_s"))
             bq["build_s"] = cold_s
             bq["build_warm_s"] = warm_s
+            bq["index_bytes"] = obs_memory.record_index("ivf_bq", bq_index)
             if bq_cache:
                 bq["index_cache"] = bq_cache
             # resident-bytes accounting: code bytes are the headline (the
@@ -954,10 +960,23 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     QPS + p50/p90/p99 per offered load, the best speedup at
     no-worse-than-baseline p99, and the paged-scan retrace count across
     the serving window (the zero-recompile upsert contract).
+
+    Round 10 (ISSUE 10): the section exercises the WHOLE observability
+    plane — per-request traces through the queue, a seeded shadow sampler
+    maintaining the live recall estimate (pumped between windows, off the
+    measured clock: the worker-thread mode would steal CPU from the window
+    it is measuring), the three-class SLO engine's burn rates, and memory
+    watermarks — and streams ``obs.report`` snapshots to
+    ``results/obs_report.jsonl`` through the crash-safe progress channel.
     """
     import numpy as np
 
     from raft_tpu import obs, serving
+    from raft_tpu.bench import progress as prog
+    from raft_tpu.obs import memory as obs_memory
+    from raft_tpu.obs import report as obs_report
+    from raft_tpu.obs import shadow as obs_shadow
+    from raft_tpu.obs import slo as obs_slo
 
     rng = np.random.default_rng(rng_seed)
     q_pool = np.asarray(queries, np.float32)
@@ -1009,6 +1028,30 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     lat_full = time.perf_counter() - t2
     slo_s = max(4.0 * lat_full, 2.0 * lat1)
 
+    # --- observability plane (ISSUE 10) -------------------------------------
+    # shadow sampler: a seeded fraction of served queries re-checked
+    # against the store's own exact scan (n_probes = n_lists — exact over
+    # the LIVE corpus, so mid-traffic upserts are scored fairly)
+    # default_rate() carries the env knob's garbage-tolerance + [0,1]
+    # clamp; the bench only supplies its own default when the knob is unset
+    raw_rate = os.environ.get(obs_shadow.RATE_ENV, "").strip()
+    shadow_rate = obs_shadow.default_rate() if raw_rate else \
+        (0.5 if tiny else 0.25)
+    sampler = obs_shadow.ShadowSampler(
+        lambda qq: serving.search(store, qq, k, n_probes=store.n_lists),
+        k=k, rate=shadow_rate, seed=rng_seed, max_pending=512)
+    engine = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(slo_s, sampler=sampler))
+    report_path = os.path.join("results", "obs_report.jsonl")
+    prog.truncate(report_path)  # fresh report stream per run
+    out["shadow_rate"] = shadow_rate
+    obs_memory.record_index("serving_store", store)
+    # warm the shadow's exact-scan program (n_probes = n_lists is its own
+    # compiled shape) off the clock, so the serving window's zero-recompile
+    # counter measures the mutation contract, not shadow warmup
+    v, _ = serving.search(store, q_pool[:1], k, n_probes=store.n_lists)
+    _force(v)
+
     # upsert id range fixed per run: re-runs replace, the store stays bounded
     next_upsert = [10_000_000]
 
@@ -1020,7 +1063,10 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
 
     upsert_some()  # warm the assign/encode/scatter programs off the clock
 
-    def run_load(rate: float, batch_cap: int, with_upserts: bool) -> dict:
+    last_queue = [None]  # most recent window's queue (report depth source)
+
+    def run_load(rate: float, batch_cap: int, with_upserts: bool,
+                 shadow=None) -> dict:
         """One Poisson window: submit at ``rate`` req/s with mixed
         per-request deadlines, pump the queue in the gaps (the bench loop
         IS the serving worker — single-threaded, deterministic)."""
@@ -1029,7 +1075,8 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
             slo_s=slo_s, max_batch=batch_cap,
             # waiting longer than one full-batch dispatch to fill a batch
             # never pays: the next batch would have absorbed the arrivals
-            fill_wait_s=lat_full)
+            fill_wait_s=lat_full, shadow=shadow)
+        last_queue[0] = queue
         arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
         # mixed deadlines: most requests roomy, every 5th tight
         timeouts = [slo_s * (2.0 if i % 5 == 0 else 8.0)
@@ -1077,12 +1124,18 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     out["batch1_serving"] = base
 
     # --- dynamic batching at multiples of the strawman's load, upserts
-    # interleaved mid-traffic
+    # interleaved mid-traffic; after each window (off the measured clock)
+    # the shadow queue drains, the SLO engine samples, and one obs.report
+    # snapshot is streamed to the crash-safe report file
     loads = []
     for mult in mults:
         row = run_load(mult * base_rate, batch_cap=max_batch,
-                       with_upserts=True)
+                       with_upserts=True, shadow=sampler)
         row["offered_x_batch1"] = mult
+        sampler.drain(timeout_s=60.0)
+        obs_report.export(report_path, obs_report.collect(
+            engine=engine, sampler=sampler, queue=last_queue[0],
+            extra={"offered_x_batch1": mult}))
         loads.append(row)
     out["recompiles_during_serving"] = serving.scan_trace_count() - traces0
     out["loads"] = loads
@@ -1102,6 +1155,42 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
             best["qps"] / base["qps"], 2)
     if obs.enabled():
         obs.add("bench.serving.requests", (1 + len(mults)) * n_req)
+
+    # --- final operating-point record (ISSUE 10 / ROADMAP item 5): SLO
+    # states + burn rates, live recall ± CI, memory watermark — the row
+    # shape the closed-loop autotuner will consume
+    mem = obs_memory.sample("serving")
+    states = engine.evaluate()
+    out["slo"] = {
+        name: {"state": row.get("state"),
+               "burn_fast": round(row["burn_fast"], 4),
+               "burn_slow": round(row["burn_slow"], 4)}
+        if "burn_fast" in row else {"state": row.get("state")}
+        for name, row in states.items()}
+    lat = states.get("serving_p99") or {}
+    avail = states.get("serving_availability") or {}
+    # a failed signal source (state=unknown, no burn keys) must surface as
+    # ABSENT, not as a perfect 0.0 burn — bench_compare renders the missing
+    # key as "gone", which is the honest row for a broken monitor
+    out["slo_p99_burn_rate"] = (round(lat["burn_rate"], 4)
+                                if "burn_rate" in lat else None)
+    out["availability"] = avail.get("value")
+    out["availability_burn_rate"] = (round(avail["burn_rate"], 4)
+                                     if "burn_rate" in avail else None)
+    est = sampler.estimate()
+    out["recall_estimate"] = est["recall"]
+    out["recall_ci_low"] = round(est["ci_low"], 4)
+    out["recall_ci_high"] = round(est["ci_high"], 4)
+    out["shadow_samples"] = est["samples"]
+    out["shadow_dropped"] = est["dropped"]
+    out["recall_stale"] = est["stale"]
+    out["memory_watermark_bytes"] = mem["bytes_in_use"]
+    out["memory_source"] = mem["source"]
+    out["obs_report_file"] = report_path
+    obs_report.export(report_path, obs_report.collect(
+        engine=engine, sampler=sampler, queue=last_queue[0],
+        extra={"final": True}))
+
     out["store_after"] = store.stats()
     out["_store"] = store  # the section owner compacts + caches this
     return out

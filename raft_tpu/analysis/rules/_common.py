@@ -67,10 +67,14 @@ def is_traced_decorated(fn) -> bool:
 
 
 def calls_record_span(fn) -> bool:
-    """Does the function body open an ``obs.record_span`` span itself?"""
+    """Does the function body record a span itself — ``obs.record_span``
+    or its explicit-lineage twin ``obs.tracing.manual_span`` (the
+    cross-thread request-lifecycle path, which records the same ring node
+    without the contextvar wrapper)?"""
     for node in ast.walk(fn):
         if isinstance(node, ast.Call) and \
-                dotted_name(node.func).rsplit(".", 1)[-1] == "record_span":
+                dotted_name(node.func).rsplit(".", 1)[-1] in (
+                    "record_span", "manual_span"):
             return True
     return False
 

@@ -13,6 +13,12 @@ The serving layer's public surface is method-shaped
 (``PagedListStore.upsert`` / ``.delete`` / ``.compact``,
 ``QueryQueue.submit``), so inside ``serving/`` the rule also walks
 class bodies.
+
+ISSUE 10 extended the scope to the observability plane's own entry points
+(``obs/slo.py`` / ``obs/report.py``): the SLO engine and status report are
+what the autotuner and the driver consume, so their public surface
+(``sample`` / ``evaluate`` / ``collect`` / ``render``, module functions
+and methods alike) must be span-covered too — the watcher is watched.
 """
 
 from __future__ import annotations
@@ -27,11 +33,20 @@ _ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn",
                 "upsert", "delete", "submit", "compact"}
 _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 
+#: the obs plane's own public entry points (ISSUE 10): scoped per-file so
+#: helper modules (aggregate, tracing) keep their non-span shape
+_OBS_FILES = {"slo.py", "report.py"}
+_OBS_ENTRY_NAMES = {"sample", "evaluate", "collect", "render"}
+
 
 def _is_entry_name(name: str) -> bool:
     if name.startswith("_"):
         return False
     return name in _ENTRY_NAMES or name.startswith(_ENTRY_PREFIXES)
+
+
+def _is_obs_entry_name(name: str) -> bool:
+    return not name.startswith("_") and name in _OBS_ENTRY_NAMES
 
 
 @register
@@ -42,11 +57,14 @@ class ObsCoverageRule(Rule):
                    "cluster/distributed must be @traced or record_span")
 
     def check(self, ctx):
-        parts = ctx.rel.split("/")[:-1]  # directories only
-        if not _SCOPED_DIRS.intersection(parts):
+        parts = ctx.rel.split("/")
+        dirs = parts[:-1]
+        obs_scoped = "obs" in dirs and parts[-1] in _OBS_FILES
+        if not (_SCOPED_DIRS.intersection(dirs) or obs_scoped):
             return
+        is_entry = _is_obs_entry_name if obs_scoped else _is_entry_name
         nodes = list(ctx.tree.body)  # module level: the public surface
-        if "serving" in parts:  # ...plus serving's method-shaped entries
+        if "serving" in dirs or obs_scoped:  # ...plus method-shaped entries
             for node in ctx.tree.body:
                 if isinstance(node, ast.ClassDef):
                     nodes.extend(n for n in node.body if isinstance(
@@ -54,7 +72,7 @@ class ObsCoverageRule(Rule):
         for node in nodes:
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if not _is_entry_name(node.name):
+            if not is_entry(node.name):
                 continue
             if is_traced_decorated(node) or calls_record_span(node):
                 continue

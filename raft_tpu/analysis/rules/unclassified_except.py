@@ -21,6 +21,13 @@ class the v2 container exists to classify.
 ISSUE 8 added ``raft_tpu/serving/`` — the query-queue dispatch guard is
 the layer's whole failure story (DEADLINE verdicts, OOM batch halving),
 so an unclassified except there would break serving's one contract.
+
+ISSUE 10 added ``raft_tpu/obs/`` — the SLO/shadow/report plane degrades
+on failure by DESIGN (a broken signal source becomes ``state=unknown``,
+a failed shadow search marks the estimate stale), and every one of those
+degradations is only diagnosable if the kind survives classification.
+The handful of pre-existing jax-presence probes in registry/tracing carry
+inline justifications.
 """
 
 from __future__ import annotations
@@ -39,7 +46,7 @@ def _in_scope(rel: str) -> bool:
     parts = rel.split("/")
     dirs = parts[:-1]
     if parts[-1] == "bench.py" or "distributed" in dirs or \
-            "resilience" in dirs or "serving" in dirs:
+            "resilience" in dirs or "serving" in dirs or "obs" in dirs:
         return True
     return "core" in dirs and parts[-1] in ("serialize.py", "fsio.py")
 

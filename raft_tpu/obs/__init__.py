@@ -39,9 +39,12 @@ report committed time, with the raw dispatch wall-clock kept as the
 ``dispatch_s`` span attribute.
 """
 
-# NOTE: obs.aggregate is deliberately NOT imported here — preloading it
-# would shadow `python -m raft_tpu.obs.aggregate` (runpy double-import);
-# reach it as `from raft_tpu.obs import aggregate` when needed.
+# NOTE: obs.aggregate and obs.report are deliberately NOT imported here —
+# preloading either would shadow its `python -m raft_tpu.obs.<mod>` runpy
+# execution; reach them as `from raft_tpu.obs import aggregate, report`.
+# The SLO plane (obs.slo / obs.shadow / obs.memory / obs.report) is also
+# kept off the package import path because it reaches into resilience,
+# which imports obs back — import those modules directly when needed.
 from raft_tpu.obs import tracing
 from raft_tpu.obs.registry import (
     NOOP_SPAN,
@@ -51,11 +54,13 @@ from raft_tpu.obs.registry import (
     enable,
     enabled,
     export_jsonl,
+    inc_gauge,
     observe,
     record_span,
     record_timing,
     registry,
     reset,
+    set_gauge,
     snapshot,
 )
 from raft_tpu.obs.tracing import (
@@ -85,6 +90,7 @@ __all__ = [
     "enabled",
     "export_chrome_trace",
     "export_jsonl",
+    "inc_gauge",
     "observe",
     "probe",
     "process_info",
@@ -92,6 +98,7 @@ __all__ = [
     "record_timing",
     "registry",
     "reset",
+    "set_gauge",
     "snapshot",
     "spans",
     "sync_enabled",

@@ -13,7 +13,12 @@ merge is **exact**, not approximate:
 * histograms — ``count``/``sum`` sum, ``min``/``max`` min/max, and the
   power-of-two buckets merged KEY-WISE (a bucket bound is a pure function of
   the observed value, so identical bounds on different processes are the
-  same bucket — merging loses nothing the per-process histograms had).
+  same bucket — merging loses nothing the per-process histograms had);
+* gauges — ``min`` min-of-min, ``max`` max-of-max, ``count`` sum, and the
+  per-process LAST values preserved verbatim in the ``last`` map (each
+  process's snapshot keys its final value by process — dict union is
+  associative, so nothing is averaged away); the merged ``value`` is the
+  max over preserved last values (the conservative fleet watermark).
 
 Merging is associative and commutative (sums/mins/maxes of disjoint streams),
 which ``tests/test_aggregate.py`` property-tests; percentile upper bounds
@@ -113,14 +118,38 @@ def _merge_hist(a: dict, b: dict) -> dict:
     return out
 
 
+def _merge_gauge(a: dict, b: dict) -> dict:
+    last = dict(a.get("last") or
+                ({"p0": a["value"]} if "value" in a else {}))
+    last.update(b.get("last") or
+                ({"p0": b["value"]} if "value" in b else {}))
+    out = {
+        "min": min(a.get("min", math.inf), b.get("min", math.inf)),
+        "max": max(a.get("max", -math.inf), b.get("max", -math.inf)),
+        "count": a.get("count", 0) + b.get("count", 0),
+        "last": last,
+    }
+    # the merged headline value: the max over preserved per-process last
+    # values — conservative for usage-shaped gauges (memory watermarks,
+    # queue depth), where the worst process IS the fleet answer. For
+    # quality-shaped gauges (obs.shadow.recall), max hides the degraded
+    # process — direction-sensitive consumers must read ``last``/``min``,
+    # which is exactly why the per-process values are preserved verbatim
+    if last:
+        out["value"] = max(last.values())
+    return out
+
+
 def merge_snapshots(snaps: Iterable[dict]) -> dict:
-    """Fold snapshot dicts ({"counters": .., "timers": .., "histograms": ..})
-    into one fleet snapshot, exactly (module docstring). Left fold in input
-    order; the operation is associative/commutative up to float summation
-    order, and bit-exact for counters and histogram buckets."""
+    """Fold snapshot dicts ({"counters": .., "timers": .., "histograms": ..,
+    "gauges": ..}) into one fleet snapshot, exactly (module docstring). Left
+    fold in input order; the operation is associative/commutative up to
+    float summation order, and bit-exact for counters, histogram buckets and
+    gauge last-value maps."""
     counters: dict = {}
     timers: dict = {}
     hists: dict = {}
+    gauges: dict = {}
     for snap in snaps:
         for key, val in (snap.get("counters") or {}).items():
             counters[key] = counters.get(key, 0) + val
@@ -130,7 +159,11 @@ def merge_snapshots(snaps: Iterable[dict]) -> dict:
         for key, val in (snap.get("histograms") or {}).items():
             hists[key] = _merge_hist(hists[key], val) if key in hists \
                 else _merge_hist({}, val)
-    return {"counters": counters, "timers": timers, "histograms": hists}
+        for key, val in (snap.get("gauges") or {}).items():
+            gauges[key] = _merge_gauge(gauges[key], val) if key in gauges \
+                else _merge_gauge({}, val)
+    return {"counters": counters, "timers": timers, "histograms": hists,
+            "gauges": gauges}
 
 
 def merge_records(records: List[dict]) -> dict:

@@ -30,11 +30,13 @@ import math
 import os
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from raft_tpu.obs import tracing as _tracing
 
 __all__ = [
+    "EXEMPLAR_CAP",
     "MetricsRegistry",
     "NOOP_SPAN",
     "add",
@@ -42,13 +44,20 @@ __all__ = [
     "enable",
     "enabled",
     "export_jsonl",
+    "inc_gauge",
     "observe",
     "record_span",
     "record_timing",
     "registry",
     "reset",
+    "set_gauge",
     "snapshot",
 ]
+
+#: exemplars kept per histogram (newest win) — enough to link each
+#: percentile bucket of a live latency histogram to a recent trace id
+#: without growing the snapshot unboundedly
+EXEMPLAR_CAP = 8
 
 _enabled = os.environ.get("RAFT_TPU_OBS", "").strip().lower() in (
     "1", "true", "on", "yes",
@@ -101,9 +110,17 @@ class _TimerStat:
 
 
 class _HistStat:
-    """Power-of-two-bucketed histogram (+ count/sum/min/max exact)."""
+    """Power-of-two-bucketed histogram (+ count/sum/min/max exact).
 
-    __slots__ = ("count", "sum", "min", "max", "buckets")
+    Carries a small bounded **exemplar ring**: when an observation lands
+    while a trace is open (or the caller passes ``trace_id`` explicitly),
+    the ``(bucket, trace_id, value)`` triple is kept so a percentile bucket
+    in a snapshot links back to a concrete recent trace — "p99 is 80 ms,
+    and HERE is a request that paid it". The ring is ``EXEMPLAR_CAP`` deep
+    (newest win) and dies with ``reset()``, so trace ids never leak across
+    tests or runs."""
+
+    __slots__ = ("count", "sum", "min", "max", "buckets", "exemplars")
 
     def __init__(self):
         self.count = 0
@@ -111,8 +128,9 @@ class _HistStat:
         self.min = math.inf
         self.max = -math.inf
         self.buckets: dict = {}
+        self.exemplars: deque = deque(maxlen=EXEMPLAR_CAP)
 
-    def add(self, value: float) -> None:
+    def add(self, value: float, trace_id: Optional[str] = None) -> None:
         self.count += 1
         self.sum += value
         if value < self.min:
@@ -126,6 +144,9 @@ class _HistStat:
         bound = 0.0 if value <= 0 else 2.0 ** math.ceil(math.log2(value))
         key = f"le_{bound!r}"
         self.buckets[key] = self.buckets.get(key, 0) + 1
+        if trace_id is not None:
+            self.exemplars.append(
+                {"bucket": key, "trace_id": trace_id, "value": value})
 
     def as_dict(self) -> dict:
         out = {
@@ -135,6 +156,8 @@ class _HistStat:
             "max": self.max,
             "buckets": dict(self.buckets),
         }
+        if self.exemplars:
+            out["exemplars"] = list(self.exemplars)
         # p50/p90/p99 UPPER bounds derived from the power-of-two buckets:
         # over-estimates the true quantile by ≤2× (the bucket resolution);
         # shared with the fleet merge so per-process and merged views agree.
@@ -144,6 +167,36 @@ class _HistStat:
 
         out.update(percentile_bounds(self.buckets, self.count))
         return out
+
+
+class _GaugeStat:
+    """Last-value gauge with exact min/max/count of everything set."""
+
+    __slots__ = ("value", "min", "max", "count")
+
+    def __init__(self):
+        self.value = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.count = 0
+
+    def set(self, value: float) -> None:
+        self.value = value
+        self.count += 1
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def inc(self, delta: float) -> None:
+        self.set(self.value + delta)
+
+    def as_dict(self, process_key: str) -> dict:
+        # "last" keys the final value by process so the fleet merge can
+        # preserve per-process last values exactly (obs/aggregate merges
+        # min-of-min / max-of-max and unions these maps)
+        return {"value": self.value, "min": self.min, "max": self.max,
+                "count": self.count, "last": {process_key: self.value}}
 
 
 class MetricsRegistry:
@@ -156,6 +209,7 @@ class MetricsRegistry:
         self._counters: dict = {}
         self._timers: dict = {}
         self._hists: dict = {}
+        self._gauges: dict = {}
 
     # -- writes -------------------------------------------------------------
     def add(self, name: str, value: float = 1) -> None:
@@ -169,22 +223,47 @@ class MetricsRegistry:
                 stat = self._timers[name] = _TimerStat()
             stat.add(seconds)
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                trace_id: Optional[str] = None) -> None:
+        """Record one histogram observation. ``trace_id`` (or, when None,
+        the innermost open span's trace) lands in the histogram's exemplar
+        ring so percentile buckets link to concrete recent traces."""
+        if trace_id is None:
+            cur = _tracing.current_span()
+            if cur is not None:
+                trace_id = cur[0]
         with self._lock:
             stat = self._hists.get(name)
             if stat is None:
                 stat = self._hists[name] = _HistStat()
-            stat.add(value)
+            stat.add(value, trace_id)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            stat = self._gauges.get(name)
+            if stat is None:
+                stat = self._gauges[name] = _GaugeStat()
+            stat.set(float(value))
+
+    def inc_gauge(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            stat = self._gauges.get(name)
+            if stat is None:
+                stat = self._gauges[name] = _GaugeStat()
+            stat.inc(float(delta))
 
     # -- reads --------------------------------------------------------------
     def snapshot(self) -> dict:
-        """Plain-dict copy: {"counters": .., "timers": .., "histograms": ..}.
-        Empty sections are included so consumers need no key checks."""
+        """Plain-dict copy: {"counters": .., "timers": .., "histograms": ..,
+        "gauges": ..}. Empty sections are included so consumers need no key
+        checks."""
+        pk = f"p{_tracing.process_info()[0]}"
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "timers": {k: v.as_dict() for k, v in self._timers.items()},
                 "histograms": {k: v.as_dict() for k, v in self._hists.items()},
+                "gauges": {k: v.as_dict(pk) for k, v in self._gauges.items()},
             }
 
     def reset(self) -> None:
@@ -192,6 +271,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._timers.clear()
             self._hists.clear()
+            self._gauges.clear()
 
     def export_jsonl(self, path, extra: Optional[dict] = None) -> dict:
         """Append one timestamped snapshot line to ``path``; returns the
@@ -229,7 +309,8 @@ def _trace_annotation():
             import jax.profiler
 
             _ann_cls = jax.profiler.TraceAnnotation
-        except Exception:  # pragma: no cover - jax is present in this repo
+        # jax-free parents are a supported state — nothing to classify
+        except Exception:  # pragma: no cover  # graftlint: ignore[unclassified-except]
             _ann_cls = None
     return _ann_cls
 
@@ -242,7 +323,9 @@ def _classify_error(exc) -> str:
         from raft_tpu.resilience.errors import classify
 
         return classify(exc)
-    except Exception:
+    # this IS the classify call site; its own fallback (a partially
+    # imported resilience package) has only the type name to offer
+    except Exception:  # graftlint: ignore[unclassified-except]
         return type(exc).__name__.lower()
 
 
@@ -357,9 +440,23 @@ def record_timing(name: str, seconds: float) -> None:
         _default.record_timing(name, seconds)
 
 
-def observe(name: str, value: float) -> None:
+def observe(name: str, value: float, trace_id: Optional[str] = None) -> None:
     if _enabled:
-        _default.observe(name, value)
+        _default.observe(name, value, trace_id)
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Set a last-value gauge (queue depth, memory watermark, recall
+    estimate). Snapshots carry last value + exact min/max/count; the fleet
+    merge keeps per-process last values (obs/aggregate)."""
+    if _enabled:
+        _default.set_gauge(name, value)
+
+
+def inc_gauge(name: str, delta: float = 1) -> None:
+    """Adjust a gauge relative to its current value (inc semantics)."""
+    if _enabled:
+        _default.inc_gauge(name, delta)
 
 
 def snapshot() -> dict:

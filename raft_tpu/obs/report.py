@@ -1,0 +1,236 @@
+"""Unified serving status: one JSON snapshot of the whole observability
+plane, streamable as JSONL through the crash-safe bench/progress channel.
+
+``collect()`` folds every signal the plane produces into a single
+self-describing record — SLO states with dual-window burn rates
+(obs/slo.py), queue depth and adaptive batch cap (serving.QueryQueue),
+the live shadow-recall estimate ± its Wilson CI (obs/shadow.py), memory
+watermarks (obs/memory.py gauges), shard health (resilience), and the
+request verdict counters with an explicit ``unclassified`` residue (which
+a healthy run keeps at zero). ``export()`` appends it to a JSONL stream
+with the heartbeat file's durability (fsync per record, via
+``bench/progress.export_metrics`` — the round-5 crash-safety contract), so
+a wedged serving process still leaves its last known status on disk.
+
+CLI::
+
+    python -m raft_tpu.obs.report results/obs_report.jsonl   # newest record
+    python -m raft_tpu.obs.report path --validate            # health gate
+
+``--validate`` re-checks the structural invariants (:func:`validate`): all
+three SLO classes present with finite burn rates, a populated recall
+estimate with CI bounds, a nonzero memory watermark, zero unclassified
+verdicts — the check.sh obs-report smoke and the driver both gate on it.
+With no path the CLI renders the *current process*'s plane (useful inside
+a serving process; standalone it is an empty-but-valid skeleton).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+from typing import Optional
+
+from raft_tpu import obs, resilience
+
+__all__ = ["collect", "export", "main", "render", "validate"]
+
+#: verdict counters summarized into the report (everything the queue stamps)
+_VERDICT_PREFIX = "serving.requests."
+
+
+def _classified(fn, label: str, out_errors: dict):
+    """Run one provider; a failure degrades its section to None and lands
+    classified in ``errors`` — a status report must report, not raise."""
+    try:
+        return fn()
+    except Exception as e:
+        out_errors[label] = resilience.classify(e)
+        return None
+
+
+def collect(engine=None, sampler=None, queue=None,
+            snapshot: Optional[dict] = None,
+            extra: Optional[dict] = None) -> dict:
+    """One status snapshot of the observability plane. Every section
+    degrades independently (classified into ``errors``) so a broken
+    provider never costs the rest of the report."""
+    with obs.record_span("obs.report::collect"):
+        errors: dict = {}
+        snap = snapshot if snapshot is not None else \
+            _classified(obs.snapshot, "snapshot", errors) or {}
+        counters = snap.get("counters") or {}
+        verdicts = {k[len(_VERDICT_PREFIX):]: v for k, v in counters.items()
+                    if k.startswith(_VERDICT_PREFIX)}
+        known = {"ok", "deadline", "fatal", "oom", "transient"}
+        out = {
+            "t": round(time.time(), 3),
+            "type": "obs_report",
+            "slo": (_classified(engine.evaluate, "slo", errors)
+                    if engine is not None else {}),
+            "recall": (_classified(sampler.estimate, "recall", errors)
+                       if sampler is not None else None),
+            "queue": (_classified(
+                lambda: {"depth": queue.depth,
+                         "batch_cap": queue.batch_cap,
+                         "batches": queue.batches,
+                         "multi_batches": queue.multi_batches,
+                         "requeued": int(counters.get(
+                             "serving.queue.requeued", 0))},
+                "queue", errors) if queue is not None else None),
+            "memory": {k: {"value": g.get("value"), "max": g.get("max")}
+                       for k, g in (snap.get("gauges") or {}).items()
+                       if k.startswith("memory.")},
+            "shard_health": _classified(
+                lambda: resilience.shard_health().snapshot(),
+                "shard_health", errors),
+            "verdicts": {
+                **verdicts,
+                "unclassified": int(sum(
+                    v for k, v in verdicts.items() if k not in known)),
+            },
+        }
+        if errors:
+            out["errors"] = errors
+        if extra:
+            out.update(extra)
+        return out
+
+
+def export(path: str, report: dict) -> dict:
+    """Append one report record to a JSONL stream through the crash-safe
+    bench/progress channel (fsync per record; the only sanctioned results/
+    write path). Returns the record written."""
+    # bench/progress is stdlib-only and imports nothing from raft_tpu —
+    # reaching it from obs keeps the one fsync'd JSONL writer shared
+    from raft_tpu.bench import progress
+
+    return progress.export_metrics(path, report)
+
+
+def render(report: Optional[dict] = None, indent: int = 2, **providers) -> str:
+    """Pretty-printed JSON of ``report`` (default: a fresh
+    :func:`collect` over ``providers``)."""
+    with obs.record_span("obs.report::render"):
+        if report is None:
+            report = collect(**providers)
+        return json.dumps(report, indent=indent, sort_keys=True,
+                          default=str)
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def validate(report: dict,
+             require_classes=("latency", "availability", "recall")) -> list:
+    """Structural health of one report record: the list of problems (empty
+    = valid). Checks the acceptance invariants: every required SLO class
+    present with finite burn rates, recall estimate populated with CI
+    bounds, a nonzero memory watermark, zero unclassified verdicts."""
+    problems = []
+    slo = report.get("slo") or {}
+    kinds = {row.get("kind") for row in slo.values()
+             if isinstance(row, dict)}
+    for cls in require_classes:
+        if cls not in kinds:
+            problems.append(f"missing SLO class {cls!r} "
+                            f"(declared: {sorted(kinds)})")
+    for name, row in slo.items():
+        if not isinstance(row, dict):
+            problems.append(f"slo[{name}] is not a record")
+            continue
+        if row.get("state") == "unknown":
+            problems.append(f"slo[{name}] source failed (state=unknown)")
+            continue
+        for key in ("burn_fast", "burn_slow"):
+            if not _finite(row.get(key)):
+                problems.append(f"slo[{name}].{key} not finite: "
+                                f"{row.get(key)!r}")
+    rec = report.get("recall")
+    if "recall" in require_classes:
+        if not isinstance(rec, dict) or rec.get("recall") is None:
+            problems.append("recall estimate not populated")
+        elif not (_finite(rec.get("ci_low")) and _finite(rec.get("ci_high"))
+                  and rec["ci_low"] <= rec["recall"] <= rec["ci_high"]):
+            problems.append(f"recall CI malformed: {rec!r}")
+    mem = report.get("memory") or {}
+    if not any(_finite(g.get("value")) and g["value"] > 0
+               for g in mem.values() if isinstance(g, dict)):
+        problems.append("no nonzero memory watermark recorded")
+    verdicts = report.get("verdicts") or {}
+    if verdicts.get("unclassified", 0):
+        problems.append(
+            f"{verdicts['unclassified']} unclassified verdict(s)")
+    return problems
+
+
+def _load_newest(path: str) -> Optional[dict]:
+    """Newest obs_report record in a JSONL stream (torn lines skipped —
+    the read_progress tolerance)."""
+    newest = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("type") == "obs_report":
+                    if newest is None or rec.get("t", 0) >= newest.get("t", 0):
+                        newest = rec
+    except OSError:
+        return None
+    return newest
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.report",
+        description="Render (and optionally validate) one observability-"
+                    "plane status snapshot: SLO burn rates, queue depth, "
+                    "shadow-recall estimate, memory watermarks, shard "
+                    "health.")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="obs-report JSONL stream (newest record wins); "
+                         "omit to collect from the current process")
+    ap.add_argument("--validate", action="store_true",
+                    help="exit 1 unless the record passes validate()")
+    ap.add_argument("--output", default=None, metavar="PATH",
+                    help="write the rendered JSON here instead of stdout")
+    ap.add_argument("--indent", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    if args.path:
+        report = _load_newest(args.path)
+        if report is None:
+            print(f"report: no obs_report records in {args.path}",
+                  file=sys.stderr)
+            return 2
+    else:
+        report = collect()
+    text = render(report, indent=args.indent)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+            f.flush()
+    else:
+        print(text)
+    if args.validate:
+        problems = validate(report)
+        if problems:
+            for p in problems:
+                print(f"report: INVALID: {p}", file=sys.stderr)
+            return 1
+        print("report: valid", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

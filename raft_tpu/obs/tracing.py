@@ -47,6 +47,7 @@ from collections import deque
 from typing import Optional
 
 __all__ = [
+    "alloc_id",
     "chrome_trace",
     "clear_spans",
     "current_span",
@@ -56,6 +57,7 @@ __all__ = [
     "enter_span",
     "exit_span",
     "export_chrome_trace",
+    "manual_span",
     "process_info",
     "push_span",
     "set_ring_cap",
@@ -83,7 +85,9 @@ def _jax_process_info():
         if xb is None or not getattr(xb, "_backends", None):
             return None
         return int(jax.process_index()), int(jax.process_count())
-    except Exception:
+    # a stamp is best-effort decoration: jax-internals drift degrades to
+    # "no live backend", never to a telemetry failure worth classifying
+    except Exception:  # graftlint: ignore[unclassified-except]
         return None
 
 
@@ -150,7 +154,9 @@ def drain_device() -> bool:
             x = jax.device_put(jnp.float32(0), dev) + jnp.float32(0)
             float(x)
         return True
-    except Exception:
+    # a failed drain only means "no device-time attribution for this
+    # span" (the caller records no dispatch_s) — not a failure class
+    except Exception:  # graftlint: ignore[unclassified-except]
         return False
 
 
@@ -233,6 +239,46 @@ def exit_span(ids, token, *, name: str, t0: float, dur_s: float,
         rec["error"] = error
     if dispatch_s is not None:
         rec["dispatch_s"] = dispatch_s
+    push_span(rec)
+    return rec
+
+
+def alloc_id() -> str:
+    """One fresh span/trace id from the process-local counter. Callers
+    building EXPLICIT-lineage spans (:func:`manual_span`) allocate ids up
+    front so children can reference a parent that completes later — the
+    serving request lifecycle, whose root span closes after its dispatch
+    children were already recorded on another thread."""
+    return _next_id()
+
+
+def manual_span(name: str, *, t0: float, dur_s: float,
+                trace_id: Optional[str] = None,
+                span_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                attrs: Optional[dict] = None,
+                error: Optional[str] = None) -> dict:
+    """Record one COMPLETED span with explicit lineage, bypassing the
+    contextvar stack. This is the cross-thread escape hatch: a serving
+    request's submit → admit → dispatch → complete lifecycle spans the
+    caller thread and the batcher's worker, so contextvar parenting cannot
+    link them — the queue allocates the request's ids at submit
+    (:func:`alloc_id`) and files each lifecycle phase under them as it
+    happens. ``t0`` is epoch seconds (the ring/export convention). Returns
+    the record pushed to the ring."""
+    rec = {
+        "name": name,
+        "trace_id": trace_id if trace_id is not None else _next_id(),
+        "span_id": span_id if span_id is not None else _next_id(),
+        "parent_id": parent_id,
+        "t0": t0,
+        "dur_s": dur_s,
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        rec["attrs"] = dict(attrs)
+    if error is not None:
+        rec["error"] = error
     push_span(rec)
     return rec
 
@@ -321,7 +367,9 @@ def _resilience_events() -> list:
         from raft_tpu.resilience.retry import recent_events
 
         return recent_events()
-    except Exception:
+    # a partially imported resilience package (bootstrap orderings) means
+    # "no instant events for this export" — nothing to classify
+    except Exception:  # graftlint: ignore[unclassified-except]
         return []
 
 

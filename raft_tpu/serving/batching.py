@@ -27,7 +27,23 @@ a **classified DEADLINE verdict** (never a fleet failure), an
 OOM-classified dispatch **halves the batch cap** and requeues (adaptive
 degradation, ``degrade_on_oom`` style), a TRANSIENT dispatch retries
 once, and a FATAL error is delivered — classified — to exactly the
-requests in that batch while the queue keeps serving.
+requests in that batch while the queue keeps serving. Requeued-once
+survivors are counted (``serving.queue.requeued``) and flagged on their
+dispatch span, so SLO burn-rate math over the once-per-request verdict
+counters never double-counts their first admission.
+
+**Per-request traces** (round 10): with telemetry on, every request gets
+its own trace — ``submit → admit → dispatch → complete`` recorded as
+children of one ``serving::request`` root via the explicit-lineage path
+(``obs.tracing.manual_span``; the lifecycle crosses the caller thread and
+the batcher, so contextvar parenting cannot link it), carrying
+``queue_wait_s`` / ``batch_size`` / ``bucket`` / ``requeued`` attrs. The
+request-latency histogram links its exemplar ring to these trace ids, so
+"what did the p99 bucket look like?" dereferences to concrete requests.
+With telemetry OFF the hot path is unchanged: the same single
+``obs.enabled()`` branch, no per-request allocation, no trace, no host
+sync (tier-1 asserts the handle's ``trace_id`` stays None and the span
+ring stays empty).
 """
 
 from __future__ import annotations
@@ -49,7 +65,8 @@ _OK = "ok"
 
 class _Request:
     __slots__ = ("query", "t_arrive", "t_deadline", "event", "vals", "ids",
-                 "verdict", "error", "retries", "_latency_s")
+                 "verdict", "error", "retries", "requeued", "_latency_s",
+                 "trace_id", "span_id", "t_epoch", "t_admit")
 
     def __init__(self, query: np.ndarray, t_arrive: float, t_deadline: float):
         self.query = query
@@ -61,6 +78,13 @@ class _Request:
         self.verdict: Optional[str] = None  # "ok" | resilience kind
         self.error: Optional[BaseException] = None
         self.retries = 0
+        self.requeued = False
+        # trace identity: allocated at submit ONLY under obs.enabled() —
+        # the telemetry-off hot path must not pay id allocation
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.t_epoch = 0.0   # epoch twin of t_arrive (span t0 convention)
+        self.t_admit = 0.0   # monotonic admit time (queue_wait_s source)
 
 
 class RequestHandle:
@@ -77,6 +101,12 @@ class RequestHandle:
         """``"ok"``, a :mod:`raft_tpu.resilience` failure kind, or None
         while pending."""
         return self._req.verdict
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """This request's trace id (the ``serving::request`` span tree in
+        ``obs.tracing``); None when telemetry was off at submit."""
+        return self._req.trace_id
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -120,8 +150,13 @@ class QueryQueue:
                  max_batch: int = 64,
                  fill_wait_s: Optional[float] = None,
                  default_timeout_s: Optional[float] = None,
-                 pressure_margin_s: float = 0.002):
+                 pressure_margin_s: float = 0.002,
+                 shadow=None):
         self._search_fn = search_fn
+        # optional online-recall shadow sampler (obs/shadow.ShadowSampler):
+        # served results are OFFERED after each successful dispatch — one
+        # seeded-hash decision per request, drop-on-pressure, never blocking
+        self._shadow = shadow
         self.slo_s = float(slo_s)
         self.max_batch = int(max_batch)
         self.buckets = _buckets(self.max_batch)
@@ -147,12 +182,30 @@ class QueryQueue:
         now = time.monotonic()
         t = timeout_s if timeout_s is not None else self.default_timeout_s
         req = _Request(q, now, now + t if t is not None else math.inf)
-        with obs.record_span("serving::submit"):
-            with self._cv:
-                self._pending.append(req)
-                depth = len(self._pending)
-                self._cv.notify()
-        if obs.enabled():
+        enabled = obs.enabled()
+        if enabled:
+            # request trace root ids, allocated BEFORE the request is
+            # published: the background worker may dequeue, dispatch and
+            # close the request the instant it lands in the deque, and its
+            # lifecycle spans must see fully-initialized identity
+            tracing = obs.tracing
+            req.trace_id = tracing.alloc_id()
+            req.span_id = tracing.alloc_id()
+            req.t_epoch = time.time()
+        with self._cv:
+            self._pending.append(req)
+            depth = len(self._pending)
+            self._cv.notify()
+        if enabled:
+            # ONE submit record per request (the explicit-lineage child of
+            # the request root) + the flat timer series; a second
+            # contextvar span here would double every submit in the ring
+            dur = time.monotonic() - now
+            obs.record_timing("serving::submit", dur)
+            tracing.manual_span(
+                "serving::submit", t0=req.t_epoch, dur_s=dur,
+                trace_id=req.trace_id, parent_id=req.span_id,
+                attrs={"depth": depth})
             obs.add("serving.queue.submits")
             obs.observe("serving.queue.depth", depth)
         return RequestHandle(req)
@@ -213,17 +266,40 @@ class QueryQueue:
                 cap = max(1, self._batch_cap)
                 while self._pending and len(batch) < cap:
                     batch.append(self._pending.popleft())
+        if batch and obs.enabled():
+            t_admit = time.monotonic()
+            for req in batch:
+                req.t_admit = t_admit
         for req in expired:
             self._finish_deadline(req, "expired in queue")
         if batch:
             self._dispatch(batch)
         return bool(expired or batch)
 
+    def _close_request_trace(self, req: _Request, verdict: str) -> None:
+        """Record the request's ``serving::complete`` child and close its
+        ``serving::request`` root span (error-tagged for non-ok verdicts).
+        No-op for requests submitted with telemetry off — or finished
+        after it was switched off (a cleared ring must stay clean)."""
+        if req.trace_id is None or not obs.enabled():
+            return
+        done_epoch = time.time()
+        obs.tracing.manual_span(
+            "serving::complete", t0=done_epoch, dur_s=0.0,
+            trace_id=req.trace_id, parent_id=req.span_id,
+            attrs={"verdict": verdict})
+        obs.tracing.manual_span(
+            "serving::request", t0=req.t_epoch, dur_s=req._latency_s,
+            trace_id=req.trace_id, span_id=req.span_id,
+            attrs={"verdict": verdict, "requeued": req.requeued},
+            error=None if verdict == _OK else verdict)
+
     def _finish_deadline(self, req: _Request, why: str) -> None:
         req.verdict = resilience.DEADLINE
         req.error = DeadlineExceeded(f"DEADLINE_EXCEEDED: request {why}")
         req._latency_s = time.monotonic() - req.t_arrive
         obs.add("serving.requests.deadline")
+        self._close_request_trace(req, resilience.DEADLINE)
         req.event.set()
 
     def _finish_error(self, req: _Request, kind: str, err: BaseException) -> None:
@@ -231,9 +307,19 @@ class QueryQueue:
         req.error = err
         req._latency_s = time.monotonic() - req.t_arrive
         obs.add(f"serving.requests.{kind.lower()}")
+        self._close_request_trace(req, kind)
         req.event.set()
 
     def _requeue_front(self, reqs: List[_Request]) -> None:
+        # requeue accounting (round-10 satellite): survivors of a partial
+        # deadline drain or an OOM cap-halving go back for a SECOND
+        # admission — counted once here and flagged on their dispatch span,
+        # so burn-rate math over the once-per-request verdict counters
+        # never sees their first admission twice
+        for req in reqs:
+            req.requeued = True
+        if obs.enabled():
+            obs.add("serving.queue.requeued", len(reqs))
         with self._cv:
             for req in reversed(reqs):
                 self._pending.appendleft(req)
@@ -253,7 +339,8 @@ class QueryQueue:
         attrs = None
         if obs.enabled():
             attrs = {"batch": n, "bucket": bucket,
-                     "cap": self._batch_cap}
+                     "cap": self._batch_cap,
+                     "requeued": sum(1 for r in batch if r.requeued)}
         try:
             with obs.record_span("serving::dispatch", attrs=attrs):
                 resilience.faultpoint("serving.queue.dispatch")
@@ -280,16 +367,43 @@ class QueryQueue:
             if n > 1:
                 obs.add("serving.batches.multi")
         done = time.monotonic()
+        dispatch_epoch = time.time() - dt  # epoch twin of `now`
         for i, req in enumerate(batch):
             req.vals = vals[i]
             req.ids = ids[i]
             req.verdict = _OK
             req._latency_s = done - req.t_arrive
             if obs.enabled():
-                obs.observe("serving.request_latency_s", req._latency_s)
+                if req.trace_id is not None:
+                    # lifecycle children under the request root: admit
+                    # (covers the queue wait) and dispatch (this batch)
+                    wait_s = (req.t_admit or now) - req.t_arrive
+                    obs.tracing.manual_span(
+                        "serving::admit", t0=req.t_epoch, dur_s=wait_s,
+                        trace_id=req.trace_id, parent_id=req.span_id,
+                        attrs={"queue_wait_s": wait_s,
+                               "requeued": req.requeued})
+                    obs.tracing.manual_span(
+                        "serving::dispatch", t0=dispatch_epoch, dur_s=dt,
+                        trace_id=req.trace_id, parent_id=req.span_id,
+                        attrs={"batch_size": n, "bucket": bucket,
+                               "queue_wait_s": wait_s,
+                               "requeued": req.requeued})
+                # exemplar-linked: the latency histogram's percentile
+                # buckets dereference to these request traces
+                obs.observe("serving.request_latency_s", req._latency_s,
+                            trace_id=req.trace_id)
+                self._close_request_trace(req, _OK)
             req.event.set()
         if obs.enabled():
             obs.add("serving.requests.ok", n)
+        shadow = self._shadow
+        if shadow is not None:
+            # off-hot-path recall estimation: one seeded decision per
+            # request; enqueue-or-drop, never blocks the verdict (requests
+            # were already completed above)
+            for i, req in enumerate(batch):
+                shadow.offer(req.query, ids[i], trace_id=req.trace_id)
 
     def _on_dispatch_error(self, batch: List[_Request], e: Exception,
                            kind: str) -> None:

@@ -149,6 +149,20 @@ def direction(metric: str) -> str:
         return "info"
     if tail.endswith("_ub") or tail.endswith("_s") or "latency" in tail:
         return "down"
+    # SLO plane (round 10): burn rates spend error budget — down is
+    # better (this also catches availability_burn_rate, deliberately
+    # before the availability rule); availability and the live recall
+    # estimate/CI grow toward good; staleness, shadow drops, deadline
+    # misses and unclassified verdicts shrink toward good
+    if "burn" in tail:
+        return "down"
+    if tail == "availability":
+        return "up"
+    if tail in ("recall_estimate", "recall_ci_low", "recall_ci_high"):
+        return "up"
+    if tail in ("recall_stale", "deadline_misses", "unclassified") or \
+            "dropped" in tail:
+        return "down"
     # capacity/compression metrics (bench.ivf_bq.*): resident-bytes and
     # recompile counts shrink toward good; capacity rows and compression
     # ratios grow toward good — without these a 2× code-bytes regression
@@ -174,6 +188,12 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "ivf_bq.recompiles_during_search": 0.0,
     "ivf_bq.recall": 0.01,
     "ivf_bq.per_chip_recall": 0.01,
+    # SLO plane: availability and the recall estimate are promises, not
+    # throughput — tiny slips are real regressions worth a row
+    "serving.availability": 0.001,
+    "serving.recall_estimate": 0.01,
+    "serving.recall_stale": 0.0,
+    "serving.recompiles_during_serving": 0.0,
 }
 
 

@@ -111,6 +111,16 @@ echo "== serving smoke (paged store + SLO-aware dynamic batching, ISSUE 8) =="
 JAX_PLATFORMS=cpu python scripts/serving_smoke.py || fail=1
 
 echo
+echo "== obs-report smoke (SLO burn rates + shadow recall + report CLI, ISSUE 10) =="
+# Tiny serving run with the full observability plane: per-request traces
+# (submit->admit->dispatch->complete), seeded shadow-recall sampler, SLO
+# engine, memory watermark; the unified obs.report snapshot must validate
+# (three SLO classes, finite burns, recall CI, nonzero watermark, zero
+# unclassified verdicts) both in-process and through the
+# `python -m raft_tpu.obs.report --validate` CLI.
+JAX_PLATFORMS=cpu python scripts/obs_report_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

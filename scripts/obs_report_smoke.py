@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Observability-plane CPU smoke (ISSUE 10, wired into scripts/check.sh).
+
+Tiny serving run with the WHOLE plane attached — per-request traces,
+seeded shadow sampler, three-class SLO engine, memory watermarks — then
+the unified ``obs.report`` snapshot is streamed through the crash-safe
+progress channel and re-validated through the ``python -m
+raft_tpu.obs.report --validate`` CLI. Asserts the acceptance gates:
+
+* all three declared SLO classes (latency / availability / recall)
+  present with FINITE burn rates;
+* recall estimate populated with Wilson CI bounds;
+* a nonzero memory watermark (CPU fallback: live-array bytes);
+* zero unclassified request verdicts;
+* at least one request traceable submit → admit → dispatch → complete
+  with queue_wait_s and batch_size attrs.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu import obs, serving  # noqa: E402
+from raft_tpu.neighbors import ivf_flat  # noqa: E402
+from raft_tpu.obs import memory as obs_memory  # noqa: E402
+from raft_tpu.obs import report as obs_report  # noqa: E402
+from raft_tpu.obs import shadow as obs_shadow  # noqa: E402
+from raft_tpu.obs import slo as obs_slo  # noqa: E402
+
+K, NPROBE, N_REQ = 5, 4, 48
+
+
+def main():
+    obs.enable()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2000, 16)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=16,
+                                                   list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=32)
+
+    sampler = obs_shadow.ShadowSampler(
+        lambda q: serving.search(store, q, K, n_probes=store.n_lists),
+        k=K, rate=0.5, seed=3, max_pending=256)
+    engine = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(0.5, sampler=sampler))
+    queue = serving.QueryQueue(
+        serving.searcher(store, K, n_probes=NPROBE),
+        slo_s=0.5, max_batch=16, shadow=sampler)
+
+    handles = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+               for _ in range(N_REQ)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain(timeout_s=30.0)
+    assert all(h.verdict == "ok" for h in handles), \
+        [h.verdict for h in handles]
+
+    # one request traceable submit → admit → dispatch → complete
+    tid = handles[0].trace_id
+    assert tid, "request carried no trace id with telemetry on"
+    spans = [s for s in obs.tracing.spans() if s.get("trace_id") == tid]
+    names = {s["name"] for s in spans}
+    assert {"serving::request", "serving::submit", "serving::admit",
+            "serving::dispatch", "serving::complete"} <= names, names
+    d = [s for s in spans if s["name"] == "serving::dispatch"][-1]
+    assert d["attrs"]["batch_size"] >= 1 and "queue_wait_s" in d["attrs"]
+
+    obs_memory.sample("serving")
+    report = obs_report.collect(engine=engine, sampler=sampler, queue=queue)
+    problems = obs_report.validate(report)
+    assert not problems, problems
+    kinds = {row["kind"] for row in report["slo"].values()}
+    assert kinds == {"latency", "availability", "recall"}, kinds
+    assert all(math.isfinite(row["burn_fast"])
+               for row in report["slo"].values())
+    est = report["recall"]
+    assert est["recall"] is not None and est["samples"] >= 1
+    assert est["ci_low"] <= est["recall"] <= est["ci_high"]
+    assert report["verdicts"]["unclassified"] == 0
+
+    # stream through the crash-safe channel, then the CLI must agree
+    path = os.path.join(tempfile.mkdtemp(), "obs_report_smoke.jsonl")
+    obs_report.export(path, report)
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.report", path, "--validate"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rendered = json.loads(proc.stdout)
+    assert rendered["type"] == "obs_report"
+
+    slo = report["slo"]
+    print("obs-report smoke: OK (recall=%.3f ci=[%.3f, %.3f] over %d "
+          "shadow samples; availability=%s burn=%.2f; p99 burn=%.2f; "
+          "memory=%d bytes [%s]; %d spans for request %s)"
+          % (est["recall"], est["ci_low"], est["ci_high"], est["samples"],
+             slo["serving_availability"].get("value"),
+             slo["serving_availability"]["burn_rate"],
+             slo["serving_p99"]["burn_rate"],
+             report["memory"]["memory.serving.bytes_in_use"]["value"],
+             "live_arrays", len(spans), tid))
+
+
+if __name__ == "__main__":
+    main()

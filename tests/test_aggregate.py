@@ -42,13 +42,15 @@ def test_percentile_bounds_ignore_malformed_keys():
 # ---------------------------------------------------------------------------
 
 
-def _feed(reg, counters, timings, values):
+def _feed(reg, counters, timings, values, gauges=()):
     for name, v in counters:
         reg.add(name, v)
     for name, s in timings:
         reg.record_timing(name, s)
     for name, v in values:
         reg.observe(name, v)
+    for name, v in gauges:
+        reg.set_gauge(name, v)
 
 
 def _random_stream(rng, n):
@@ -59,25 +61,39 @@ def _random_stream(rng, n):
                for _ in range(n)]
     values = [(f"h.{rng.integers(2)}", float(rng.uniform(0.01, 500.0)))
               for _ in range(n)]
-    return counters, timings, values
+    gauges = [(f"g.{rng.integers(2)}", float(rng.uniform(-5.0, 50.0)))
+              for _ in range(n)]
+    return counters, timings, values, gauges
 
 
 @pytest.mark.parametrize("seed", range(8))
 def test_merge_of_split_streams_equals_whole(seed):
     """Split one stream across three fake processes: the merge of the three
     snapshots must equal the snapshot of one registry fed everything —
-    bit-exact for counters and histogram buckets."""
+    bit-exact for counters and histogram buckets (gauges split per process
+    instead: min-of-min / max-of-max / count exact vs the whole)."""
     rng = np.random.default_rng(seed)
-    counters, timings, values = _random_stream(rng, 200)
+    counters, timings, values, gauges = _random_stream(rng, 200)
     whole = MetricsRegistry()
-    _feed(whole, counters, timings, values)
+    _feed(whole, counters, timings, values, gauges)
     parts = [MetricsRegistry() for _ in range(3)]
     for i in range(3):
-        _feed(parts[i], counters[i::3], timings[i::3], values[i::3])
+        _feed(parts[i], counters[i::3], timings[i::3], values[i::3],
+              gauges[i::3])
 
     merged = aggregate.merge_snapshots([p.snapshot() for p in parts])
     expect = whole.snapshot()
     assert merged["counters"] == expect["counters"]
+    for name, g in expect["gauges"].items():
+        m = merged["gauges"][name]
+        assert m["min"] == g["min"] and m["max"] == g["max"]
+        assert m["count"] == g["count"]
+        # all three parts share process key p0 here, so the union keeps
+        # ONE last value — and it must be one a process actually ended on
+        assert set(m["last"]) == {"p0"}
+        ends = {p.snapshot()["gauges"][name]["value"] for p in parts
+                if name in p.snapshot()["gauges"]}
+        assert m["last"]["p0"] in ends
     for name, h in expect["histograms"].items():
         m = merged["histograms"][name]
         assert m["buckets"] == h["buckets"]
@@ -97,12 +113,20 @@ def test_merge_of_split_streams_equals_whole(seed):
 
 @pytest.mark.parametrize("seed", range(8))
 def test_merge_is_associative(seed):
+    """Associativity now covers gauges too (ISSUE 10): the per-process
+    ``last`` maps union associatively (right-wins dict update), so grouping
+    cannot change min/max/count or the preserved last values. Distinct
+    process keys per snapshot mirror the real fleet shape."""
     rng = np.random.default_rng(100 + seed)
     snaps = []
-    for _ in range(3):
+    for pi in range(3):
         reg = MetricsRegistry()
         _feed(reg, *_random_stream(rng, 60))
-        snaps.append(reg.snapshot())
+        os.environ["RAFT_TPU_PROCESS_INDEX"] = str(pi)
+        try:
+            snaps.append(reg.snapshot())
+        finally:
+            del os.environ["RAFT_TPU_PROCESS_INDEX"]
     a, b, c = snaps
     left = aggregate.merge_snapshots(
         [aggregate.merge_snapshots([a, b]), c])
@@ -119,6 +143,12 @@ def test_merge_is_associative(seed):
         assert left["timers"][name]["count"] == right["timers"][name]["count"]
         assert left["timers"][name]["total_s"] == \
             pytest.approx(right["timers"][name]["total_s"])
+    assert left["gauges"] == right["gauges"]
+    for name, g in left["gauges"].items():
+        # every process's LAST value survives the merge verbatim
+        for pi, snap in enumerate(snaps):
+            if name in snap["gauges"]:
+                assert g["last"][f"p{pi}"] == snap["gauges"][name]["value"]
 
 
 def test_merge_records_keeps_newest_per_process():
@@ -140,7 +170,8 @@ def test_merge_records_keeps_newest_per_process():
 
 def test_merge_empty_is_empty():
     out = aggregate.merge_snapshots([])
-    assert out == {"counters": {}, "timers": {}, "histograms": {}}
+    assert out == {"counters": {}, "timers": {}, "histograms": {},
+                   "gauges": {}}
     assert aggregate.merge_records([])["processes"] == []
 
 

@@ -349,6 +349,59 @@ def dispatch(batch):
         return batch
 """,
     ),
+    # ISSUE 10 extension: the obs plane's own entry points (slo.py /
+    # report.py, module functions AND engine methods) must be span-covered
+    # — the layer that measures everything else doesn't get to be invisible
+    (
+        "obs-coverage",
+        "raft_tpu/obs/slo.py",
+        """
+class Engine:
+    def evaluate(self):
+        return {}
+""",
+        # near-miss: record_span-covered methods + a constructor-shaped
+        # helper that is NOT an entry point
+        """
+from raft_tpu import obs
+
+class Engine:
+    def evaluate(self):
+        with obs.record_span("obs.slo::evaluate"):
+            return {}
+
+    def sample(self):
+        with obs.record_span("obs.slo::sample"):
+            return {}
+
+def latency_slo(name):
+    return name
+""",
+    ),
+    # ISSUE 10 extension: shadow-sampler (and the rest of obs/) exception
+    # paths must route through resilience.classify — a swallowed shadow
+    # failure would leave the recall estimate silently stale-free
+    (
+        "unclassified-except",
+        "raft_tpu/obs/shadow.py",
+        """
+def pump(sampler):
+    try:
+        return sampler.score()
+    except Exception as e:
+        return {"error": repr(e)[:200]}
+""",
+        # near-miss: the kind survives via resilience.classify
+        """
+from raft_tpu.resilience import classify
+
+def pump(sampler):
+    try:
+        return sampler.score()
+    except Exception as e:
+        return {"error": repr(e)[:200], "kind": classify(e)}
+""",
+    ),
 ]
 
 

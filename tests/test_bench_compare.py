@@ -117,3 +117,32 @@ def test_from_zero_transition_gets_a_verdict(tmp_path):
 def test_unreadable_inputs_exit_2(tmp_path):
     proc = _run(str(tmp_path / "nope.json"), str(tmp_path / "nope2.json"))
     assert proc.returncode == 2
+
+def test_slo_plane_direction_rules(tmp_path):
+    """ISSUE 10: burn rates gate downward, availability/recall-estimate
+    upward — a service burning its error budget 10× faster must render as
+    a regression, not an informational row."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"serving": {"slo_p99_burn_rate": 1.0,
+                                  "availability": 0.999,
+                                  "availability_burn_rate": 0.5,
+                                  "recall_estimate": 0.97,
+                                  "recall_stale": False}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"serving": {"slo_p99_burn_rate": 10.0,
+                                  "availability": 0.90,
+                                  "availability_burn_rate": 50.0,
+                                  "recall_estimate": 0.80,
+                                  "recall_stale": True}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    assert rows["serving.slo_p99_burn_rate"] == "regression"
+    assert rows["serving.availability_burn_rate"] == "regression"
+    assert rows["serving.availability"] == "regression"  # 0.1% threshold
+    assert rows["serving.recall_estimate"] == "regression"
+    assert rows["serving.recall_stale"] == "regression"  # went stale

@@ -82,7 +82,8 @@ def test_registry_reset_and_export(tmp_path):
     assert lines[0]["counters"]["a"] == 2
     assert lines[0]["timers"]["t"]["count"] == 1
     reg.reset()
-    assert reg.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+    assert reg.snapshot() == {"counters": {}, "timers": {}, "histograms": {},
+            "gauges": {}}
 
 
 def test_record_span_timing_monotonic(telemetry):
@@ -111,7 +112,8 @@ def test_disabled_gate_is_noop():
     obs.add("never", 5)
     obs.record_timing("never", 1.0)
     obs.observe("never", 1.0)
-    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {},
+            "gauges": {}}
 
 
 def test_span_records_on_exception(telemetry):
@@ -159,7 +161,8 @@ def test_instrumented_path_untouched_when_disabled(rng):
     assert not obs.enabled()
     data = jnp.asarray(rng.standard_normal((64, 8), dtype=np.float32))
     brute_force.knn(data[:4], data, 3)
-    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {},
+            "gauges": {}}
 
 
 # ---------------------------------------------------------------------------

@@ -497,3 +497,175 @@ class TestStoreFaults:
             rng.standard_normal((50, 24)).astype(np.float32),
             np.arange(70_000, 70_050))
         assert out["upserts"] == 50 and store.size == 1550
+
+
+# ---------------------------------------------------------------------------
+# Per-request trace propagation (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTracing:
+    @pytest.fixture
+    def served_store(self, flat_setup):
+        _, _, idx = flat_setup
+        return serving.PagedListStore.from_index(idx, page_rows=64)
+
+    @pytest.fixture
+    def telemetry(self):
+        obs.reset()
+        obs.tracing.clear_spans()
+        obs.enable()
+        try:
+            yield obs
+        finally:
+            obs.disable()
+            obs.reset()
+            obs.tracing.clear_spans()
+
+    def test_request_traceable_submit_to_complete(self, served_store, rng,
+                                                  telemetry):
+        """Acceptance: one individual request is traceable submit → admit
+        → dispatch → complete as children of its serving::request root,
+        with queue_wait_s and batch_size attrs."""
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(8)]
+        _drain_sync(q)
+        assert all(h.verdict == "ok" for h in hs)
+        for h in hs:
+            assert h.trace_id is not None
+        assert len({h.trace_id for h in hs}) == len(hs)  # one trace each
+        tid = hs[3].trace_id
+        spans = [s for s in obs.tracing.spans()
+                 if s.get("trace_id") == tid]
+        roots = [s for s in spans if s["name"] == "serving::request"]
+        assert len(roots) == 1 and roots[0]["parent_id"] is None
+        kids = {s["name"]: s for s in spans
+                if s.get("parent_id") == roots[0]["span_id"]}
+        assert {"serving::submit", "serving::admit", "serving::dispatch",
+                "serving::complete"} <= set(kids)
+        d = kids["serving::dispatch"]
+        assert d["attrs"]["batch_size"] == 8
+        assert d["attrs"]["bucket"] == 8
+        assert d["attrs"]["queue_wait_s"] >= 0.0
+        assert kids["serving::admit"]["attrs"]["queue_wait_s"] >= 0.0
+        assert roots[0]["attrs"]["verdict"] == "ok"
+
+    def test_deadline_verdict_closes_trace_with_error(self, served_store,
+                                                      rng, telemetry):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8), slo_s=0.05)
+        h = q.submit(rng.standard_normal(24), timeout_s=0.0)
+        time.sleep(0.01)
+        q.pump()
+        assert h.verdict == resilience.DEADLINE
+        roots = [s for s in obs.tracing.spans()
+                 if s.get("trace_id") == h.trace_id
+                 and s["name"] == "serving::request"]
+        assert roots and roots[0]["error"] == resilience.DEADLINE
+
+    def test_latency_exemplars_link_to_request_traces(self, served_store,
+                                                      rng, telemetry):
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(8)]
+        _drain_sync(q)
+        ex = obs.snapshot()["histograms"][
+            "serving.request_latency_s"]["exemplars"]
+        tids = {h.trace_id for h in hs}
+        assert ex and all(e["trace_id"] in tids for e in ex)
+
+    def test_noop_gate_no_per_request_trace(self, served_store, rng):
+        """Acceptance (c): with telemetry OFF the hot path allocates no
+        trace identity and records no spans — the same single-branch gate
+        as before this plane existed."""
+        assert not obs.enabled()
+        obs.tracing.clear_spans()
+        assert obs.record_span("serving::submit") is obs.NOOP_SPAN
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(8)]
+        _drain_sync(q)
+        assert all(h.verdict == "ok" for h in hs)
+        assert all(h.trace_id is None for h in hs)
+        assert obs.tracing.spans() == []
+        assert obs.snapshot() == {"counters": {}, "timers": {},
+                                  "histograms": {}, "gauges": {}}
+
+    def test_requeued_survivors_counted_once(self, served_store, rng,
+                                             telemetry):
+        """Satellite: OOM cap-halving requeues increment
+        serving.queue.requeued and flag the dispatch span, while verdict
+        counters stay once-per-request (no burn-rate double count)."""
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        resilience.arm_faults("serving.queue.dispatch=oom:1")
+        hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+              for _ in range(8)]
+        _drain_sync(q)
+        assert all(h.verdict == "ok" for h in hs)
+        counters = obs.snapshot()["counters"]
+        assert counters["serving.queue.requeued"] == 8
+        assert counters["serving.requests.ok"] == 8  # once per request
+        assert counters["serving.queue.submits"] == 8
+        # every survivor's dispatch span carries the requeued flag
+        dspans = [s for s in obs.tracing.spans()
+                  if s["name"] == "serving::dispatch"
+                  and s.get("trace_id") == hs[0].trace_id]
+        assert dspans and dspans[-1]["attrs"]["requeued"] is True
+        # root spans carry it too (the SLO math's audit trail)
+        roots = [s for s in obs.tracing.spans()
+                 if s["name"] == "serving::request"]
+        assert len(roots) == 8
+        assert all(s["attrs"]["requeued"] for s in roots)
+
+    def test_worker_thread_traces_complete(self, served_store, rng,
+                                           telemetry):
+        """Race regression: trace identity is assigned BEFORE the request
+        is published, so even the background worker (which can dispatch a
+        request the instant it lands) records a complete root span with a
+        real epoch t0 and children parented on a real span id."""
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.02, max_batch=16)
+        q.start()
+        try:
+            hs = [q.submit(rng.standard_normal(24), timeout_s=10.0)
+                  for _ in range(40)]
+            for h in hs:
+                h.result(timeout=15.0)
+        finally:
+            q.stop()
+        roots = {s["trace_id"]: s for s in obs.tracing.spans()
+                 if s["name"] == "serving::request"}
+        for h in hs:
+            root = roots[h.trace_id]
+            assert root["t0"] > 1e9  # real epoch, never the 0.0 default
+            assert root["span_id"] is not None
+
+    def test_partial_deadline_drain_requeues_survivors(self, served_store,
+                                                       rng, telemetry):
+        """The other requeue source: a hang burns the batch deadline;
+        survivors of the partial drain are requeued-once and counted."""
+        q = serving.QueryQueue(
+            serving.searcher(served_store, k=5, n_probes=8),
+            slo_s=0.05, max_batch=8)
+        resilience.arm_faults("serving.queue.dispatch=hang:1:10")
+        short = [q.submit(rng.standard_normal(24), timeout_s=0.15)
+                 for _ in range(3)]
+        longer = [q.submit(rng.standard_normal(24), timeout_s=30.0)
+                  for _ in range(3)]
+        _drain_sync(q, timeout=20.0)
+        assert [h.verdict for h in short] == [resilience.DEADLINE] * 3
+        assert [h.verdict for h in longer] == ["ok"] * 3
+        counters = obs.snapshot()["counters"]
+        assert counters["serving.queue.requeued"] == 3  # the survivors
+        assert counters["serving.requests.ok"] == 3
+        assert counters["serving.requests.deadline"] == 3

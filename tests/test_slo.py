@@ -1,0 +1,558 @@
+"""Observability plane (ISSUE 10): gauges + exemplars, SLO burn rates,
+shadow recall estimation, memory watermarks, unified status report.
+
+Tier-1 contracts:
+
+* gauges — set/inc semantics, last+min/max in snapshots, exact fleet merge
+  (the associativity property lives in test_aggregate);
+* exemplar rings — bounded at ``EXEMPLAR_CAP``, linked to trace ids, and
+  cleared by ``registry.reset()`` (no trace-id leaks across tests);
+* SLO engine — burn rates are finite and window-correct on synthetic
+  timelines, breaches emit classified events (never exceptions), broken
+  sources degrade to ``state="unknown"``;
+* shadow sampler — seeded decisions pick a REPRODUCIBLE query subset,
+  drop-on-pressure never blocks, and (round-7 invariant) an armed
+  ``obs.shadow.search`` faultpoint degrades the estimate to stale with a
+  classified event while serving requests complete normally;
+* memory accounting — nonzero live-bytes watermark on the CPU fallback,
+  per-index byte counts;
+* report — collect/validate round-trip, and the ``python -m
+  raft_tpu.obs.report --validate`` CLI contract the check.sh smoke uses.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, resilience, serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import memory as obs_memory
+from raft_tpu.obs import report as obs_report
+from raft_tpu.obs import shadow as obs_shadow
+from raft_tpu.obs import slo as obs_slo
+from raft_tpu.obs.registry import EXEMPLAR_CAP, MetricsRegistry
+from raft_tpu.resilience.retry import clear_events, recent_events
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    obs.reset()
+    obs.tracing.clear_spans()
+    clear_events()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+        obs.tracing.clear_spans()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# gauges
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_set_inc_semantics():
+    reg = MetricsRegistry()
+    reg.set_gauge("g", 10.0)
+    reg.inc_gauge("g", 5.0)
+    reg.inc_gauge("g", -12.0)
+    g = reg.snapshot()["gauges"]["g"]
+    assert g["value"] == 3.0
+    assert g["min"] == 3.0 and g["max"] == 15.0
+    assert g["count"] == 3
+    assert g["last"] == {"p0": 3.0}
+
+
+def test_gauge_module_level_gated(telemetry):
+    obs.set_gauge("depth", 7)
+    assert obs.snapshot()["gauges"]["depth"]["value"] == 7.0
+    obs.disable()
+    obs.set_gauge("depth", 99)
+    obs.enable()
+    assert obs.snapshot()["gauges"]["depth"]["value"] == 7.0
+
+
+def test_gauge_reset():
+    reg = MetricsRegistry()
+    reg.set_gauge("g", 1.0)
+    reg.reset()
+    assert reg.snapshot()["gauges"] == {}
+
+
+# ---------------------------------------------------------------------------
+# exemplar rings
+# ---------------------------------------------------------------------------
+
+
+def test_exemplars_bounded_and_linked(telemetry):
+    with obs.record_span("t::outer"):
+        for i in range(3 * EXEMPLAR_CAP):
+            obs.observe("lat", 0.5 + i)
+    h = obs.snapshot()["histograms"]["lat"]
+    ex = h["exemplars"]
+    assert len(ex) == EXEMPLAR_CAP  # bounded, newest win
+    assert all(e["trace_id"] for e in ex)
+    assert ex[-1]["value"] == 0.5 + 3 * EXEMPLAR_CAP - 1
+    assert all(e["bucket"] in h["buckets"] for e in ex)
+
+
+def test_exemplars_explicit_trace_id(telemetry):
+    obs.observe("lat", 1.0, trace_id="req-42")
+    ex = obs.snapshot()["histograms"]["lat"]["exemplars"]
+    assert ex == [{"bucket": "le_1.0", "trace_id": "req-42", "value": 1.0}]
+
+
+def test_exemplars_absent_outside_traces(telemetry):
+    obs.observe("lat", 1.0)  # no open span, no explicit id
+    assert "exemplars" not in obs.snapshot()["histograms"]["lat"]
+
+
+def test_exemplars_cleared_by_reset(telemetry):
+    obs.observe("lat", 1.0, trace_id="leaky")
+    obs.reset()
+    obs.observe("lat", 2.0)
+    h = obs.snapshot()["histograms"]["lat"]
+    assert "exemplars" not in h  # no trace ids leaked across the reset
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def _engine(reg, clock, sampler=None, **kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("threshold", 10.0)
+    return obs_slo.SloEngine(
+        obs_slo.default_serving_slos(0.05, sampler=sampler),
+        registry=reg, clock=clock, **kw)
+
+
+def test_slo_constructors_validate():
+    with pytest.raises(ValueError, match="budget"):
+        obs_slo.Slo(name="x", kind=obs_slo.LATENCY, target=1.0, budget=0.0)
+    with pytest.raises(ValueError, match="quantile"):
+        obs_slo.latency_slo("x", "h", 0.1, quantile=1.0)
+    with pytest.raises(ValueError, match="kind"):
+        obs_slo.Slo(name="x", kind="nope", target=1.0, budget=0.1)
+    with pytest.raises(ValueError, match="duplicate"):
+        obs_slo.SloEngine([obs_slo.latency_slo("a", "h", 0.1),
+                           obs_slo.latency_slo("a", "h", 0.2)])
+
+
+def test_burn_rates_finite_with_no_traffic():
+    reg = MetricsRegistry()
+    eng = _engine(reg, clock=lambda: 0.0)
+    out = eng.evaluate(now=30.0)
+    for row in out.values():
+        assert row["state"] == "ok"
+        assert math.isfinite(row["burn_fast"])
+        assert math.isfinite(row["burn_slow"])
+        assert row["burn_rate"] == 0.0
+
+
+def test_availability_burn_and_breach_event():
+    clear_events()
+    reg = MetricsRegistry()
+    t = [0.0]
+    eng = _engine(reg, clock=lambda: t[0])
+    reg.add("serving.requests.ok", 90)
+    reg.add("serving.requests.deadline", 10)
+    t[0] = 30.0
+    out = eng.evaluate()
+    row = out["serving_availability"]
+    # error rate 0.1 against a 0.001 budget: burn 100 in both windows
+    assert row["burn_fast"] == pytest.approx(100.0)
+    assert row["burn_slow"] == pytest.approx(100.0)
+    assert row["state"] == "breach"
+    assert row["value"] == pytest.approx(0.9)
+    events = [e for e in recent_events() if e["event"] == "slo_breach"]
+    assert events and events[-1]["site"] == "serving_availability"
+    # the transition fires ONE event; a still-breaching re-evaluate doesn't
+    t[0] = 31.0
+    eng.evaluate()
+    assert len([e for e in recent_events()
+                if e["event"] == "slo_breach"]) == len(events)
+
+
+def test_latency_burn_conservative_buckets():
+    reg = MetricsRegistry()
+    t = [0.0]
+    eng = _engine(reg, clock=lambda: t[0])
+    for _ in range(99):
+        reg.observe("serving.request_latency_s", 0.01)  # le_0.015625 <= ok
+    reg.observe("serving.request_latency_s", 1.0)       # bucket > target
+    t[0] = 30.0
+    row = eng.evaluate()["serving_p99"]
+    # 1 violation / 100 against the 1% budget: burn exactly 1.0
+    assert row["burn_fast"] == pytest.approx(1.0)
+    assert row["state"] == "ok"
+
+
+def test_slo_dual_windows_filter_blips():
+    """A burst inside the fast window but diluted over the slow window is
+    'warn', not 'breach' — the dual-window point."""
+    clear_events()
+    reg = MetricsRegistry()
+    t = [0.0]
+    eng = _engine(reg, clock=lambda: t[0],
+                  fast_window_s=10.0, slow_window_s=1000.0)
+    # long clean history: 10k ok over ~900 s
+    reg.add("serving.requests.ok", 10_000)
+    t[0] = 900.0
+    eng.sample()
+    # fast-window burst: 50 deadline misses in the last 5 s
+    reg.add("serving.requests.deadline", 50)
+    t[0] = 905.0
+    out = eng.evaluate()
+    row = out["serving_availability"]
+    assert row["burn_fast"] > 10.0 > row["burn_slow"]
+    assert row["state"] == "warn"
+    assert not [e for e in recent_events() if e["event"] == "slo_breach"
+                and e["site"] == "serving_availability"]
+
+
+def test_sparse_sampling_still_breaches():
+    """Evaluations sparser than the fast window must not collapse burn to
+    zero: the newest sample is never its own window baseline, so a
+    sustained 100% failure rate breaches even when evaluate() runs every
+    150 s against a 60 s fast window."""
+    clear_events()
+    reg = MetricsRegistry()
+    t = [0.0]
+    eng = _engine(reg, clock=lambda: t[0])
+    reg.add("serving.requests.deadline", 150)
+    t[0] = 150.0
+    row = eng.evaluate()["serving_availability"]
+    assert row["burn_fast"] > 10.0 and row["burn_slow"] > 10.0
+    assert row["state"] == "breach"
+    # still breaching on the next sparse evaluation (baseline = the
+    # nearest OLDER sample, not the one just appended)
+    reg.add("serving.requests.deadline", 150)
+    t[0] = 300.0
+    assert eng.evaluate()["serving_availability"]["state"] == "breach"
+
+
+def test_recall_slo_rides_sampler_counts():
+    reg = MetricsRegistry()
+    t = [0.0]
+
+    class FakeSampler:
+        matched, total = 0, 0
+
+        def counts(self):
+            return (self.matched, self.total)
+
+    sampler = FakeSampler()
+    eng = _engine(reg, clock=lambda: t[0], sampler=sampler)
+    sampler.matched, sampler.total = 80, 100  # recall 0.8 < 0.95 floor
+    t[0] = 30.0
+    row = eng.evaluate()["serving_recall"]
+    assert row["value"] == pytest.approx(0.8)
+    # miss rate 0.2 / budget 0.05 = burn 4
+    assert row["burn_fast"] == pytest.approx(4.0)
+
+
+def test_broken_source_degrades_to_unknown_not_raise():
+    clear_events()
+    reg = MetricsRegistry()
+
+    class BrokenSampler:
+        def counts(self):
+            raise RuntimeError("RESOURCE_EXHAUSTED: shadow oom")
+
+    eng = _engine(reg, clock=lambda: 0.0, sampler=BrokenSampler())
+    out = eng.evaluate(now=1.0)  # must not raise
+    assert out["serving_recall"]["state"] == "unknown"
+    assert out["serving_availability"]["state"] == "ok"  # others unaffected
+    errs = [e for e in recent_events() if e["event"] == "slo_source_error"]
+    assert errs and errs[-1]["kind"] == resilience.OOM
+
+
+# ---------------------------------------------------------------------------
+# shadow sampler
+# ---------------------------------------------------------------------------
+
+
+def _exact_stub(ids_row):
+    def exact(q):
+        return np.zeros((1, len(ids_row))), np.asarray([ids_row])
+    return exact
+
+
+def test_shadow_seeded_subset_is_reproducible():
+    def picks(seed):
+        s = obs_shadow.ShadowSampler(_exact_stub([1, 2, 3]), k=3,
+                                     rate=0.5, seed=seed, max_pending=1000)
+        out = []
+        for i in range(200):
+            if s.offer(np.zeros(4), np.array([1, 2, 3])):
+                out.append(i)
+        return out
+
+    a, b = picks(7), picks(7)
+    assert a == b and 40 < len(a) < 160  # same subset, plausible rate
+    assert picks(8) != a  # a different seed picks a different subset
+    # the decision is a pure function, replayable offline
+    assert a == [i for i in range(200)
+                 if obs_shadow.sample_decision(7, i, 0.5)]
+
+
+def test_shadow_recall_estimate_and_ci():
+    s = obs_shadow.ShadowSampler(_exact_stub([1, 2, 3, 4]), k=4, rate=1.0)
+    for served in ([1, 2, 3, 4], [1, 2, 9, 9]):  # 4/4 then 2/4
+        s.offer(np.zeros(4), np.array(served))
+        assert s.pump()
+    est = s.estimate()
+    assert est["recall"] == pytest.approx(6 / 8)
+    assert 0.0 <= est["ci_low"] <= est["recall"] <= est["ci_high"] <= 1.0
+    assert est["samples"] == 2 and est["slots"] == 8
+    assert not est["stale"]
+
+
+def test_shadow_wilson_interval_bounds():
+    assert obs_shadow.wilson_interval(0, 0) == (0.0, 1.0)
+    low, high = obs_shadow.wilson_interval(10, 10)
+    assert low < 1.0 and high == 1.0  # honest width at the boundary
+    low2, high2 = obs_shadow.wilson_interval(1000, 1000)
+    assert low2 > low  # more evidence, tighter bound
+
+
+def test_shadow_drop_on_pressure_never_blocks(telemetry):
+    s = obs_shadow.ShadowSampler(_exact_stub([1]), k=1, rate=1.0,
+                                 max_pending=2)
+    results = [s.offer(np.zeros(2), np.array([1])) for _ in range(10)]
+    assert results[:2] == [True, True] and not any(results[2:])
+    assert s.estimate()["dropped"] == 8
+    counters = obs.snapshot()["counters"]
+    assert counters["obs.shadow.dropped"] == 8
+    assert counters["obs.shadow.offered"] == 2
+
+
+def test_shadow_fault_degrades_to_stale_classified(telemetry):
+    clear_events()
+    s = obs_shadow.ShadowSampler(_exact_stub([1, 2]), k=2, rate=1.0)
+    s.offer(np.zeros(2), np.array([1, 2]))
+    assert s.pump()
+    assert not s.estimate()["stale"]
+    resilience.arm_faults("obs.shadow.search=oom:1")
+    s.offer(np.zeros(2), np.array([1, 2]))
+    assert s.pump()  # consumed, not raised
+    est = s.estimate()
+    assert est["stale"] and est["errors"] == 1
+    events = [e for e in recent_events() if e["event"] == "shadow_error"]
+    assert events and events[-1]["kind"] == resilience.OOM
+    assert obs.snapshot()["counters"]["obs.shadow.errors.oom"] == 1
+    # the next successful sample clears staleness
+    s.offer(np.zeros(2), np.array([1, 2]))
+    s.pump()
+    assert not s.estimate()["stale"]
+
+
+def test_shadow_hang_bounded_by_deadline(telemetry):
+    """Round-7 invariant: a HUNG shadow search is bounded by the sampler's
+    hard deadline and lands as a classified DEADLINE error — the estimate
+    goes stale, nothing wedges."""
+    clear_events()
+    s = obs_shadow.ShadowSampler(_exact_stub([1]), k=1, rate=1.0,
+                                 timeout_s=0.2)
+    resilience.arm_faults("obs.shadow.search=hang:1:30")
+    s.offer(np.zeros(2), np.array([1]))
+    assert s.pump()
+    est = s.estimate()
+    assert est["stale"] and est["errors"] == 1
+    events = [e for e in recent_events() if e["event"] == "shadow_error"]
+    assert events and events[-1]["kind"] == resilience.DEADLINE
+
+
+# ---------------------------------------------------------------------------
+# serving integration: shadow failures never fail requests (acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def served_store(rng):
+    X = rng.standard_normal((1200, 16)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8,
+                                                   list_size_cap=0))
+    return serving.PagedListStore.from_index(idx, page_rows=64)
+
+
+def test_shadow_fault_requests_still_ok(served_store, rng, telemetry):
+    """Armed obs.shadow.search faultpoint (OOM): every serving request
+    completes normally while the recall estimate degrades to stale with a
+    classified event — the shadow path is invisible to callers."""
+    clear_events()
+    sampler = obs_shadow.ShadowSampler(
+        lambda q: serving.search(served_store, q, 5, n_probes=8),
+        k=5, rate=1.0, seed=1)
+    queue = serving.QueryQueue(
+        serving.searcher(served_store, k=5, n_probes=4),
+        slo_s=0.05, max_batch=8, shadow=sampler)
+    resilience.arm_faults("obs.shadow.search=oom:100")
+    hs = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+          for _ in range(12)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain()
+    assert all(h.verdict == "ok" for h in hs)
+    est = sampler.estimate()
+    assert est["stale"] and est["errors"] >= 1
+    assert [e for e in recent_events() if e["event"] == "shadow_error"]
+
+
+def test_shadow_live_recall_through_queue(served_store, rng, telemetry):
+    sampler = obs_shadow.ShadowSampler(
+        lambda q: serving.search(served_store, q, 5,
+                                 n_probes=served_store.n_lists),
+        k=5, rate=1.0, seed=2)
+    queue = serving.QueryQueue(
+        serving.searcher(served_store, k=5, n_probes=8),
+        slo_s=0.05, max_batch=8, shadow=sampler)
+    hs = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+          for _ in range(16)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain()
+    assert all(h.verdict == "ok" for h in hs)
+    est = sampler.estimate()
+    assert est["samples"] == 16
+    assert 0.0 < est["recall"] <= 1.0
+    assert est["ci_low"] <= est["recall"] <= est["ci_high"]
+
+
+# ---------------------------------------------------------------------------
+# memory accounting
+# ---------------------------------------------------------------------------
+
+
+def test_memory_sample_cpu_fallback_nonzero(telemetry):
+    import jax.numpy as jnp
+
+    x = jnp.ones((512, 64), jnp.float32)  # keep a live array around
+    out = obs_memory.sample("test_scope")
+    assert out["bytes_in_use"] >= x.nbytes
+    assert out["source"] in ("device_stats", "live_arrays")
+    g = obs.snapshot()["gauges"]["memory.test_scope.bytes_in_use"]
+    assert g["value"] == out["bytes_in_use"] > 0
+
+
+def test_memory_index_bytes(served_store, rng):
+    from raft_tpu.neighbors import ivf_flat as _flat
+
+    X = rng.standard_normal((500, 16)).astype(np.float32)
+    idx = _flat.build(X, _flat.IvfFlatParams(n_lists=4, list_size_cap=0))
+    b = obs_memory.index_bytes(idx)
+    assert b >= X.nbytes  # at least the packed vectors
+    assert obs_memory.index_bytes(served_store) > 0
+    assert obs_memory.index_bytes(object()) == 0
+
+
+def test_memory_record_index_gauge(served_store, telemetry):
+    b = obs_memory.record_index("store", served_store)
+    g = obs.snapshot()["gauges"]["memory.index.store.bytes"]
+    assert g["value"] == b > 0
+
+
+# ---------------------------------------------------------------------------
+# unified report
+# ---------------------------------------------------------------------------
+
+
+def _full_plane(served_store, rng):
+    sampler = obs_shadow.ShadowSampler(
+        lambda q: serving.search(served_store, q, 5,
+                                 n_probes=served_store.n_lists),
+        k=5, rate=1.0, seed=0)
+    engine = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(0.5, sampler=sampler),
+        fast_window_s=60, slow_window_s=600)
+    queue = serving.QueryQueue(
+        serving.searcher(served_store, k=5, n_probes=8),
+        slo_s=0.05, max_batch=8, shadow=sampler)
+    hs = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+          for _ in range(12)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain()
+    assert all(h.verdict == "ok" for h in hs)
+    obs_memory.sample("serving")
+    return engine, sampler, queue
+
+
+def test_report_collect_validate_roundtrip(served_store, rng, telemetry):
+    engine, sampler, queue = _full_plane(served_store, rng)
+    rep = obs_report.collect(engine=engine, sampler=sampler, queue=queue)
+    assert obs_report.validate(rep) == []
+    kinds = {row["kind"] for row in rep["slo"].values()}
+    assert kinds == {"latency", "availability", "recall"}
+    assert rep["verdicts"]["ok"] == 12
+    assert rep["verdicts"]["unclassified"] == 0
+    assert rep["queue"]["depth"] == 0
+    assert any(k.startswith("memory.serving") for k in rep["memory"])
+    assert isinstance(rep["shard_health"], dict)
+
+
+def test_report_validate_catches_problems():
+    assert obs_report.validate({}) != []
+    rep = {"slo": {"a": {"kind": "latency", "state": "ok",
+                         "burn_fast": float("inf"), "burn_slow": 0.0}},
+           "recall": {"recall": None},
+           "memory": {}, "verdicts": {"unclassified": 2}}
+    problems = obs_report.validate(rep)
+    text = "\n".join(problems)
+    assert "burn_fast" in text
+    assert "recall estimate" in text
+    assert "memory watermark" in text
+    assert "unclassified" in text
+    assert "availability" in text  # missing class
+
+
+def test_report_export_and_cli_validate(served_store, rng, telemetry,
+                                        tmp_path):
+    engine, sampler, queue = _full_plane(served_store, rng)
+    rep = obs_report.collect(engine=engine, sampler=sampler, queue=queue)
+    path = str(tmp_path / "obs_report.jsonl")
+    obs_report.export(path, rep)
+    obs_report.export(path, obs_report.collect(
+        engine=engine, sampler=sampler, queue=queue))
+    with open(path) as f:
+        lines = [json.loads(x) for x in f if x.strip()]
+    assert len(lines) == 2
+    assert all(x["type"] == "obs_report" for x in lines)
+    assert all("process_index" in x for x in lines)  # fleet-stamped
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.report", path, "--validate"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "found in sys.modules" not in proc.stderr  # clean -m execution
+    assert json.loads(proc.stdout)["type"] == "obs_report"
+
+
+def test_report_cli_rejects_empty(tmp_path):
+    bad = tmp_path / "empty.jsonl"
+    bad.write_text("not json\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.report", str(bad)],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2
+    assert "no obs_report records" in proc.stderr

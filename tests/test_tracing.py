@@ -136,7 +136,8 @@ def test_span_raise_disabled_is_pure_passthrough():
         with span:
             raise RuntimeError("boom")
     # nothing recorded anywhere: registry, ring, or error counters
-    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {}}
+    assert obs.snapshot() == {"counters": {}, "timers": {}, "histograms": {},
+            "gauges": {}}
     assert obs.spans() == []
     assert obs.NOOP_SPAN.set_attr("k", 1) is obs.NOOP_SPAN
 
