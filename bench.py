@@ -218,6 +218,7 @@ def run_suite():
     from raft_tpu.bench.datasets import sift_like
     from raft_tpu.neighbors import (brute_force, cagra, ivf_bq, ivf_flat,
                                     ivf_pq, refine)
+    from raft_tpu.obs import costmodel as obs_costmodel
     from raft_tpu.obs import memory as obs_memory
 
     # telemetry ON for the whole measured child (round-8): the bench window
@@ -377,6 +378,46 @@ def run_suite():
                                  "bench.brute_force.batch_latency_s")}
     hb.section("brute_force", extras["brute_force"])
 
+    # --- static-HBM predictor baseline (round 11): the watermark with the
+    # shared residents (dataset, queries, gt, brute-force anchor) in place
+    # but no section index yet. Each section's predicted_hbm_bytes is this
+    # baseline + the static index prediction (+ the dispatch transients
+    # where the backend's allocator sees them) — the admission projection
+    # (in_use + predicted), validated per section against the measured
+    # watermark with bench_compare direction rules (ratio toward 1.0).
+    extras["hbm_baseline_bytes"] = int(
+        obs_memory.sample("bench.baseline")["bytes_in_use"])
+
+    def hbm_section_start(name):
+        """Watermark at section start — the ``in_use`` half of the
+        admission projection the section's prediction adds onto."""
+        return int(obs_memory.sample(f"bench.{name}.start")["bytes_in_use"])
+
+    def stamp_cost(row, name, index, n_probes, mem0):
+        """Predicted-vs-measured HBM accounting for one section:
+        ``predicted_index_bytes`` must equal the ``index_bytes`` gauge
+        EXACTLY (the static layout model vs the built artifact), and
+        ``predicted_hbm_bytes / measured_watermark_bytes`` should sit near
+        1.0. Both sides are RESIDENT-state numbers — ``mem0 + static
+        index prediction`` vs ``bytes_in_use`` after the section's
+        searches — on every backend: dispatch transients are freed by
+        sample time (and TPU ``peak_bytes_in_use`` is process-monotonic,
+        so it would fold earlier sections' peaks in). The transient
+        estimate ships separately (``predicted_dispatch_transient_bytes``,
+        what ``check_admission`` projects per dispatch)."""
+        row["predicted_index_bytes"] = obs_costmodel.predict_index_bytes(
+            **obs_costmodel.index_layout(index))
+        est = obs_costmodel.estimate_search(index, q=Q, k=K,
+                                            n_probes=n_probes)
+        row["predicted_dispatch_transient_bytes"] = est["transient_bytes"]
+        mem = obs_memory.sample(f"bench.{name}")
+        pred = mem0 + row["predicted_index_bytes"]
+        row["predicted_hbm_bytes"] = int(pred)
+        row["measured_watermark_bytes"] = int(mem["bytes_in_use"])
+        if row["measured_watermark_bytes"]:
+            row["hbm_predicted_to_measured"] = round(
+                pred / row["measured_watermark_bytes"], 3)
+
     def timed_build(build):
         """(index, cold_s, warm_s): cold includes XLA compiles (cached on
         disk across runs); warm rebuilds with the programs hot — the
@@ -397,6 +438,8 @@ def run_suite():
     if section_on("ivf_flat"):
         hb.set_section("ivf_flat")
         try:
+            mem0 = hbm_section_start("ivf_flat")
+
             def build_flat():
                 idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
                     n_lists=NLIST, kmeans_trainset_fraction=0.2))
@@ -428,6 +471,9 @@ def run_suite():
             # per-index residency watermark (ISSUE 10): gauge + metric line
             flat["index_bytes"] = obs_memory.record_index(
                 "ivf_flat", flat_index)
+            # static-HBM predictor validation (ISSUE 11): exact index
+            # prediction + admission-projection vs measured watermark
+            stamp_cost(flat, "ivf_flat", flat_index, flat["nprobe"], mem0)
             if flat_cache:
                 flat["index_cache"] = flat_cache
             extras["ivf_flat"] = flat
@@ -445,6 +491,8 @@ def run_suite():
     if section_on("ivf_pq"):
         hb.set_section("ivf_pq")
         try:
+            mem0 = hbm_section_start("ivf_pq")
+
             def build_pq():
                 idx = ivf_pq.build(dataset, ivf_pq.IvfPqParams(
                     n_lists=NLIST, pq_dim=DIM // 2, pq_bits=8,
@@ -495,6 +543,7 @@ def run_suite():
             pq["build_s"] = cold_s
             pq["build_warm_s"] = warm_s
             pq["index_bytes"] = obs_memory.record_index("ivf_pq", pq_index)
+            stamp_cost(pq, "ivf_pq", pq_index, pq["nprobe"], mem0)
             if pq_cache:
                 pq["index_cache"] = pq_cache
             extras["ivf_pq"] = pq
@@ -515,6 +564,8 @@ def run_suite():
     if section_on("ivf_bq"):
         hb.set_section("ivf_bq")
         try:
+            mem0 = hbm_section_start("ivf_bq")
+
             def build_bq():
                 idx = ivf_bq.build(dataset, ivf_bq.IvfBqParams(
                     n_lists=NLIST, kmeans_trainset_fraction=0.2))
@@ -559,6 +610,7 @@ def run_suite():
             bq["build_s"] = cold_s
             bq["build_warm_s"] = warm_s
             bq["index_bytes"] = obs_memory.record_index("ivf_bq", bq_index)
+            stamp_cost(bq, "ivf_bq", bq_index, bq["nprobe"], mem0)
             if bq_cache:
                 bq["index_cache"] = bq_cache
             # resident-bytes accounting: code bytes are the headline (the
@@ -973,6 +1025,8 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
 
     from raft_tpu import obs, serving
     from raft_tpu.bench import progress as prog
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import costmodel as obs_costmodel
     from raft_tpu.obs import memory as obs_memory
     from raft_tpu.obs import report as obs_report
     from raft_tpu.obs import shadow as obs_shadow
@@ -1045,7 +1099,6 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     report_path = os.path.join("results", "obs_report.jsonl")
     prog.truncate(report_path)  # fresh report stream per run
     out["shadow_rate"] = shadow_rate
-    obs_memory.record_index("serving_store", store)
     # warm the shadow's exact-scan program (n_probes = n_lists is its own
     # compiled shape) off the clock, so the serving window's zero-recompile
     # counter measures the mutation contract, not shadow warmup
@@ -1075,7 +1128,12 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
             slo_s=slo_s, max_batch=batch_cap,
             # waiting longer than one full-batch dispatch to fill a batch
             # never pays: the next batch would have absorbed the arrivals
-            fill_wait_s=lat_full, shadow=shadow)
+            fill_wait_s=lat_full, shadow=shadow,
+            # pre-dispatch admission gauges (ISSUE 11): every batch's
+            # predicted footprint is checked against the live watermark
+            # and the verdict recorded — observability only this round
+            cost_model=obs_costmodel.paged_scan_estimator(
+                store, k, n_probes=nprobe))
         last_queue[0] = queue
         arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
         # mixed deadlines: most requests roomy, every 5th tight
@@ -1118,7 +1176,19 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     # --- batch-size-1 SERVING reference: the no-batching strawman at its
     # own sustainable load (0.7 × its capacity — beyond that its queue
     # diverges). Its p99 is the "equal p99" bar the dynamic rows answer to.
+    # serving-window recompile + HBM prediction baselines (ISSUE 11): the
+    # reserve() above pre-paid growth, so the window's prediction is "the
+    # watermark holds flat" — validated against the post-traffic sample;
+    # every retrace inside the window must land shape-attributed in the
+    # compile ledger with ZERO unexplained residue
     traces0 = serving.scan_trace_count()
+    unexplained0 = obs_compile.unexplained_retraces()
+    out["predicted_index_bytes"] = obs_costmodel.predict_index_bytes(
+        **obs_costmodel.index_layout(store))
+    out["index_bytes"] = obs_memory.record_index("serving_store", store)
+    mem_before = obs_memory.sample("serving.window_start")
+    scan_est = obs_costmodel.estimate_search(store, q=max_batch, k=k,
+                                             n_probes=nprobe)
     base_rate = 0.7 / lat1
     base = run_load(base_rate, batch_cap=1, with_upserts=False)
     out["batch1_serving"] = base
@@ -1138,6 +1208,11 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
             extra={"offered_x_batch1": mult}))
         loads.append(row)
     out["recompiles_during_serving"] = serving.scan_trace_count() - traces0
+    # zero-tolerance residue (bench_compare gates it): a retrace without a
+    # shape-diff has no attribution and is a contract violation; attributed
+    # retraces ship with their diffs in the obs_report compile section
+    out["unexplained_retraces"] = \
+        obs_compile.unexplained_retraces() - unexplained0
     out["loads"] = loads
     out["slo_ms"] = round(slo_s * 1e3, 3)
     # headline comparison: best dynamic throughput among loads whose p99
@@ -1186,6 +1261,23 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     out["recall_stale"] = est["stale"]
     out["memory_watermark_bytes"] = mem["bytes_in_use"]
     out["memory_source"] = mem["source"]
+    # predicted-vs-measured for the serving window (ISSUE 11): reserve()
+    # pre-paid all growth, so the static resident prediction is simply
+    # "the window-start watermark holds" — compared resident-to-resident
+    # (dispatch transients are freed by sample time; their estimate ships
+    # separately as the per-dispatch admission projection)
+    pred = int(mem_before["bytes_in_use"])
+    out["predicted_hbm_bytes"] = pred
+    out["predicted_dispatch_transient_bytes"] = scan_est["transient_bytes"]
+    out["measured_watermark_bytes"] = int(mem["bytes_in_use"])
+    if out["measured_watermark_bytes"]:
+        out["hbm_predicted_to_measured"] = round(
+            pred / out["measured_watermark_bytes"], 3)
+    # pre-dispatch admission verdict counts over the whole window (the
+    # item-4 controller's input; a healthy CPU window is all-admit with
+    # budget_source=unknown, a TPU window projects against bytes_limit)
+    out["admission"] = obs_costmodel.admission_counts(
+        obs.snapshot()["counters"])
     out["obs_report_file"] = report_path
     obs_report.export(report_path, obs_report.collect(
         engine=engine, sampler=sampler, queue=last_queue[0],

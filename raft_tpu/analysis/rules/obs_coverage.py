@@ -19,6 +19,11 @@ ISSUE 10 extended the scope to the observability plane's own entry points
 what the autotuner and the driver consume, so their public surface
 (``sample`` / ``evaluate`` / ``collect`` / ``render``, module functions
 and methods alike) must be span-covered too — the watcher is watched.
+ISSUE 11 extends it again to the dispatch cost model and compile ledger
+(``obs/costmodel.py`` / ``obs/compile.py``): ``estimate`` /
+``check_admission`` / ``predict_index_bytes`` / ``summary`` are the
+item-4 admission controller's inputs and must be as observable as what
+they observe (``trace_event`` stays exempt — it runs at jit trace time).
 """
 
 from __future__ import annotations
@@ -33,10 +38,15 @@ _ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn",
                 "upsert", "delete", "submit", "compact"}
 _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 
-#: the obs plane's own public entry points (ISSUE 10): scoped per-file so
-#: helper modules (aggregate, tracing) keep their non-span shape
-_OBS_FILES = {"slo.py", "report.py"}
-_OBS_ENTRY_NAMES = {"sample", "evaluate", "collect", "render"}
+#: the obs plane's own public entry points (ISSUE 10; ISSUE 11 extended
+#: the scope to the cost model and compile ledger): scoped per-file so
+#: helper modules (aggregate, tracing) keep their non-span shape.
+#: ``trace_event`` is deliberately NOT an entry name — it runs at jit
+#: TRACE time, where opening a span would record tracing as work.
+_OBS_FILES = {"slo.py", "report.py", "costmodel.py", "compile.py"}
+_OBS_ENTRY_NAMES = {"sample", "evaluate", "collect", "render",
+                    "estimate", "check_admission", "predict_index_bytes",
+                    "summary"}
 
 
 def _is_entry_name(name: str) -> bool:

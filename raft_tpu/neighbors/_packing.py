@@ -17,15 +17,23 @@ import jax.numpy as jnp
 # (round 3: one alternative was not enough — see _spill_core)
 _N_ALT = 4
 
-#: shared (re)trace counter for the serving layer's paged scans — each
-#: `_paged_impl` (ivf_flat, ivf_pq, future paged backends) bumps it at
-#: TRACE time only, so a delta across a serving window counts recompiles
-#: (the zero-recompile upsert contract asserted in tier-1/bench/smoke)
-PAGED_TRACES = {"count": 0}
+#: ledger entries backing the paged-scan (re)trace count — each
+#: `_paged_impl` (ivf_flat, ivf_pq, future paged backends) records a
+#: ledger trace_event at TRACE time only, so a delta across a serving
+#: window counts recompiles (the zero-recompile upsert contract asserted
+#: in tier-1/bench/smoke) AND names the operand whose shape caused each
+#: one (obs/compile.py — the round-11 replacement for the ad-hoc
+#: PAGED_TRACES counter dict)
+PAGED_ENTRIES = ("ivf_flat.paged_scan", "ivf_pq.paged_scan")
 
 
 def paged_trace_count() -> int:
-    return PAGED_TRACES["count"]
+    """Total (re)traces of the paged scan programs in this process — a
+    thin shim over the compile ledger (public name and delta semantics
+    unchanged from the PAGED_TRACES era)."""
+    from raft_tpu.obs import compile as obs_compile
+
+    return sum(obs_compile.trace_count(e) for e in PAGED_ENTRIES)
 
 
 def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,

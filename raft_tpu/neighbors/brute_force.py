@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
@@ -121,6 +122,13 @@ def _tile_distances(queries, qn, tile, tile_norms, metric, metric_arg, compute_d
 )
 def _search_impl(queries, dataset, norms, filter, k, metric, metric_arg,
                  tile_rows, select_algo, compute_dtype):
+    # compile-ledger registration: runs at trace time only (obs/compile.py)
+    obs_compile.trace_event(
+        "brute_force.search", queries=queries, dataset=dataset, norms=norms,
+        filter=filter,
+        static={"k": k, "metric": metric, "metric_arg": metric_arg,
+                "tile_rows": tile_rows, "select_algo": select_algo,
+                "compute_dtype": compute_dtype})
     n, dim = dataset.shape
     q = queries.shape[0]
     select_min = metric not in _MAX_METRICS

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.trace import traced
@@ -63,15 +64,21 @@ from raft_tpu.ops.select_k import select_k
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
 
-#: trace-time (re)compile counter for the fused scan — a delta of zero
+#: compile-ledger entry for the fused scan — a trace-count delta of zero
 #: across repeated searches is the steady-state zero-recompile contract
-#: asserted by the bench section and the check.sh smoke (the serving
-#: layer's PAGED_TRACES pattern)
-_BQ_TRACES = {"count": 0}
+#: asserted by the bench section and the check.sh smoke; every retrace
+#: additionally lands in the ledger with the operand shape-diff that
+#: caused it (obs/compile.py, the round-11 replacement for the ad-hoc
+#: _BQ_TRACES counter)
+_LEDGER_ENTRY = "ivf_bq.search"
 
 
 def scan_trace_count() -> int:
-    return _BQ_TRACES["count"]
+    """(Re)traces of the fused BQ search program — a thin shim over the
+    compile ledger (public name and delta semantics unchanged)."""
+    from raft_tpu.obs import compile as obs_compile
+
+    return obs_compile.trace_count(_LEDGER_ENTRY)
 
 
 @dataclass(frozen=True)
@@ -418,7 +425,15 @@ def _bq_fused(queries, centers, rotation, list_codes, list_scale, list_bias,
     bin-collision loss (the IVF-PQ precedent)."""
     from raft_tpu.ops.bq_scan import bq_strip_search_traced
 
-    _BQ_TRACES["count"] += 1  # runs at trace time only
+    # ledger registration: runs at trace time only (obs/compile.py)
+    obs_compile.trace_event(
+        _LEDGER_ENTRY, queries=queries, centers=centers, rotation=rotation,
+        list_codes=list_codes, list_scale=list_scale, list_bias=list_bias,
+        list_ids=list_ids, filter=filter, cls_ord=cls_ord,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "classes": classes, "class_counts": class_counts,
+                "q_tile": q_tile, "interpret": interpret, "impl": impl})
     l2 = metric in ("sqeuclidean", "euclidean")
     # packed coarse select only while its perturbation bound stays tight
     # (2^-(23-ceil(log2 n_lists)) ≤ 5e-4 at 4096 lists — see
@@ -504,7 +519,10 @@ def search(
     while True:
         try:
             resilience.faultpoint("ivf_bq.search.scan")
-            with obs.record_span("ivf_bq::scan", attrs=scan_attrs):
+            # ledger watch: a (re)tracing dispatch gets its wall-clock
+            # stamped on the ledger record (steady state stamps nothing)
+            with obs.record_span("ivf_bq::scan", attrs=scan_attrs), \
+                    obs_compile.watch():
                 return _bq_fused(
                     queries, index.centers, index.rotation, index.list_codes,
                     index.list_scale, index.list_bias, index.list_ids,

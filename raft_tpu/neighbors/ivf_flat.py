@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu import obs
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -378,6 +379,16 @@ def _ragged_fused(queries, centers, list_data, bias, list_ids, cls_ord,
     an index probing 3% of the data lost to brute force at 1M rows)."""
     from raft_tpu.ops.strip_scan import strip_search_traced
 
+    # ledger registration for the TPU-default backend too (trace time
+    # only): a retrace on the platform of record must not be invisible
+    obs_compile.trace_event(
+        "ivf_flat.search_ragged", queries=queries, centers=centers,
+        list_data=list_data, bias=bias, list_ids=list_ids, cls_ord=cls_ord,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "classes": classes, "class_counts": class_counts,
+                "q_tile": q_tile, "interpret": interpret})
+
     # "exact" probe selection rides the packed iter (half the VPU passes)
     # only while n_lists keeps the index bits cheap: the perturbation is
     # 2^-(23-ceil(log2 n_lists)) relative — ≤ 5e-4 at 4096 lists, where it
@@ -467,6 +478,15 @@ def _search_impl(
     queries, centers, list_data, list_ids, list_norms, filter,
     k, n_probes, metric, q_tile, select_algo, compute_dtype,
 ):
+    # compile-ledger registration: runs at trace time only, so every
+    # (re)trace of this program lands attributed (obs/compile.py)
+    obs_compile.trace_event(
+        "ivf_flat.search", queries=queries, centers=centers,
+        list_data=list_data, list_ids=list_ids, list_norms=list_norms,
+        filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "q_tile": q_tile, "select_algo": select_algo,
+                "compute_dtype": compute_dtype})
     q, dim = queries.shape
     n_lists, max_size, _ = list_data.shape
     select_min = metric != "inner_product"
@@ -630,7 +650,15 @@ def _paged_impl(
     both fill-count tails and tombstones. All operand shapes derive from
     CAPACITY (page pool, table width) — appends and tombstones re-dispatch
     this same program."""
-    _packing.PAGED_TRACES["count"] += 1  # runs at trace time only
+    # ledger registration (runs at trace time only): a growth retrace
+    # lands attributed to the operand that grew (pages / table)
+    obs_compile.trace_event(
+        "ivf_flat.paged_scan", queries=queries, centers=centers,
+        pages=pages, page_ids=page_ids, page_aux=page_aux, table=table,
+        filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "q_tile": q_tile, "select_algo": select_algo,
+                "compute_dtype": compute_dtype})
     q, dim = queries.shape
     select_min = metric != "inner_product"
     bad = jnp.float32(jnp.inf if select_min else -jnp.inf)
@@ -722,8 +750,11 @@ def search_paged(
     q_tile = int(max(1, min(queries.shape[0],
                             res.workspace_bytes // per_query)))
     with obs.record_span("ivf_flat::paged_scan", attrs=scan_attrs):
-        return _paged_impl(
-            queries, store.centers, pages, page_ids, page_aux, table,
-            filter, int(k), n_probes, store.metric,
-            q_tile, select_algo, res.compute_dtype,
-        )
+        # ledger watch: a dispatch that (re)traces gets its wall-clock
+        # stamped onto the ledger record (steady state stamps nothing)
+        with obs_compile.watch():
+            return _paged_impl(
+                queries, store.centers, pages, page_ids, page_aux, table,
+                filter, int(k), n_probes, store.metric,
+                q_tile, select_algo, res.compute_dtype,
+            )

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -1142,6 +1143,17 @@ def _ragged_fused_pq(queries, centers, rotation, b_sum, list_ids, decoded,
     neighbors/refine, which absorbs its ~1e-4/row bin-collision loss."""
     from raft_tpu.ops.strip_scan import strip_search_traced
 
+    # ledger registration for the TPU-default backend too (trace time
+    # only): a retrace on the platform of record must not be invisible
+    obs_compile.trace_event(
+        "ivf_pq.search_ragged", queries=queries, centers=centers,
+        rotation=rotation, b_sum=b_sum, list_ids=list_ids, decoded=decoded,
+        decoded_scale=decoded_scale, filter=filter, cls_ord=cls_ord,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "l2": l2, "classes": classes, "class_counts": class_counts,
+                "q_tile": q_tile, "interpret": interpret})
+
     # packed coarse select only while its perturbation bound stays tight
     # (2^-(23-ceil(log2 n_lists)) ≤ 5e-4 at 4096 lists; ADVICE r4 medium —
     # see ivf_flat._ragged_fused)
@@ -1249,6 +1261,15 @@ def _search_impl_jnp(
 ):
     """Gather-backend search: stage-1 coarse gemm + per-query LUT + code
     lookup via take_along_axis, tiled over queries."""
+    # compile-ledger registration: runs at trace time only (obs/compile.py)
+    obs_compile.trace_event(
+        "ivf_pq.search", queries=queries, centers=centers,
+        rotation=rotation, codebooks=codebooks, list_codes=list_codes,
+        list_ids=list_ids, b_sum=b_sum, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "q_tile": q_tile, "select_algo": select_algo,
+                "compute_dtype": compute_dtype, "pq_dim": pq_dim,
+                "pq_bits": pq_bits, "cluster": cluster})
     q, dim = queries.shape
     n_lists, max_size = list_codes.shape[0], list_codes.shape[1]
     l2 = metric in ("sqeuclidean", "euclidean")
@@ -1349,6 +1370,17 @@ def _search_impl_pallas(
 ):
     """Pallas-backend search: list-centric scan kernel (ops/pq_scan.py).
     Subspace codebooks only (the kernel's LUT is per query, not per list)."""
+    # ledger registration (trace time only): the qpl_cap escalation retry
+    # DELIBERATELY retraces — the ledger attributes it to static.qpl_cap
+    obs_compile.trace_event(
+        "ivf_pq.search_pallas", queries=queries, centers=centers,
+        rotation=rotation, codebooks=codebooks, list_codes=list_codes,
+        list_ids=list_ids, b_sum=b_sum, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "q_tile": q_tile, "qpl_cap": qpl_cap,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "interpret": interpret, "pq_dim": pq_dim,
+                "pq_bits": pq_bits})
     q, dim = queries.shape
     n_lists, max_size = list_codes.shape[0], list_codes.shape[1]
     if pq_dim is None:
@@ -1640,7 +1672,16 @@ def _paged_impl(
     and the ``ids >= 0`` validity mask covers tombstones. All operand
     shapes derive from CAPACITY (page pool, table width) — appends and
     tombstones re-dispatch this same program."""
-    _packing.PAGED_TRACES["count"] += 1  # runs at trace time only
+    # ledger registration (runs at trace time only): a growth retrace
+    # lands attributed to the operand that grew (pages / table)
+    obs_compile.trace_event(
+        "ivf_pq.paged_scan", queries=queries, centers=centers,
+        rotation=rotation, codebooks=codebooks, pages=pages,
+        page_ids=page_ids, page_aux=page_aux, table=table, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "q_tile": q_tile, "select_algo": select_algo,
+                "compute_dtype": compute_dtype, "pq_dim": pq_dim,
+                "pq_bits": pq_bits})
     q, dim = queries.shape
     l2 = metric in ("sqeuclidean", "euclidean")
     if l2:
@@ -1733,12 +1774,15 @@ def search_paged(
     q_tile = int(max(1, min(queries.shape[0],
                             res.workspace_bytes // per_query)))
     with obs.record_span("ivf_pq::paged_scan", attrs=scan_attrs):
-        vals, ids = _paged_impl(
-            queries, store.centers, store.rotation, store.codebooks,
-            pages, page_ids, page_aux, table, filter,
-            int(k), n_probes, store.metric, q_tile, select_algo,
-            res.compute_dtype, store.pq_dim, store.pq_bits,
-        )
+        # ledger watch: a dispatch that (re)traces gets its wall-clock
+        # stamped onto the ledger record (steady state stamps nothing)
+        with obs_compile.watch():
+            vals, ids = _paged_impl(
+                queries, store.centers, store.rotation, store.codebooks,
+                pages, page_ids, page_aux, table, filter,
+                int(k), n_probes, store.metric, q_tile, select_algo,
+                res.compute_dtype, store.pq_dim, store.pq_bits,
+            )
     if store.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
     return vals, ids

@@ -1,0 +1,322 @@
+"""Repo-wide compile ledger: every (re)trace recorded with shape provenance.
+
+The zero-recompile serving contract — the load-bearing invariant of the
+paged stores and the ``QueryQueue`` — used to be enforced by scattered
+ad-hoc trace counters (``_packing.PAGED_TRACES``, ``ivf_bq._BQ_TRACES``):
+they could say *how many* retraces a window paid, but not *which operand
+shape caused one*. A mid-traffic retrace (the ``reserve()`` headroom
+failure mode the round-8 bench caught) shipped as an unexplained number.
+
+This module replaces the counters with one process-wide **ledger**: every
+registered jit entry point calls :func:`trace_event` at the top of its
+jitted body — host code that runs at TRACE time only, exactly like the old
+counter bumps — and each trace lands as a record carrying
+
+* the entry name and a per-entry sequence number,
+* every operand's shape/dtype signature (tracer avals) plus the static
+  arguments that participate in the jit cache key,
+* a **diff against the previous trace of that entry** — which operands
+  changed, from what to what — so a growth retrace reads "``table``
+  widened ``i32[16,4]`` → ``i32[16,8]``", not "count went up",
+* the ambient ``trace_id`` (obs.tracing), linking the retrace to the
+  request/span that paid it,
+* and, when the dispatch site wraps itself in :func:`watch`, the host
+  wall-clock of the dispatch that traced (trace + compile + first run —
+  the latency a mid-traffic retrace actually costs).
+
+A retrace whose signature did NOT change (same shapes, same statics, yet
+traced again — jit cache eviction, a fresh jit object) is **unexplained**;
+:func:`unexplained_retraces` counts them and the bench/check smokes gate
+the count at zero. "recompiles_during_search == 0" claims become "zero
+retraces, and here is the shape-diff for each one that ever happened".
+
+The ledger is a bounded ring (``RAFT_TPU_OBS_LEDGER_CAP``, default 512
+records) and records **unconditionally** — the zero-recompile tier-1
+assertions run with telemetry off, so counting cannot ride the
+``obs.enabled()`` gate; only the derived counters/gauges do. Per-entry
+counts survive ring eviction (they are a separate map), so
+:func:`trace_count` deltas stay exact over arbitrarily long windows.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# the obs package re-exports `registry` as a FUNCTION, so the submodule
+# must be imported by its dotted path
+from raft_tpu.obs import tracing as _tracing
+from raft_tpu.obs.registry import add as _metric_add
+from raft_tpu.obs.registry import enabled as _metrics_enabled
+from raft_tpu.obs.registry import record_span
+
+__all__ = [
+    "LEDGER_CAP_ENV",
+    "entries",
+    "ledger",
+    "reset",
+    "set_ledger_cap",
+    "summary",
+    "suppress_analysis",
+    "trace_count",
+    "trace_event",
+    "unexplained_retraces",
+    "watch",
+]
+
+LEDGER_CAP_ENV = "RAFT_TPU_OBS_LEDGER_CAP"
+_DEFAULT_CAP = 512
+
+
+def _ledger_cap() -> int:
+    raw = os.environ.get(LEDGER_CAP_ENV, "").strip()
+    if raw.isdigit() and int(raw) > 0:
+        return int(raw)
+    return _DEFAULT_CAP
+
+
+_LOCK = threading.Lock()
+_LEDGER: deque = deque(maxlen=_ledger_cap())
+_COUNTS: dict = {}      # entry -> traces ever (survives ring eviction)
+_LAST_SIG: dict = {}    # entry -> {operand: signature str}
+_UNEXPLAINED = {"count": 0}  # retraces with an empty diff, ever
+
+# analysis-only lowerings (costmodel.xla_memory_analysis re-lowers a
+# registered entry's body to ask the COMPILER for its byte accounting)
+# must not land in the ledger: the signature is unchanged by construction,
+# so recording would fabricate an "unexplained retrace" and corrupt the
+# zero-recompile trace-count deltas. Thread-local: a concurrent dispatch
+# on another thread keeps recording normally.
+_SUPPRESS = threading.local()
+
+
+def set_ledger_cap(cap: int) -> None:
+    """Resize the ledger ring at runtime (newest records kept) — the
+    ``RAFT_TPU_OBS_LEDGER_CAP`` env var is read once at import, like the
+    span ring's cap."""
+    global _LEDGER
+    with _LOCK:
+        _LEDGER = deque(_LEDGER, maxlen=max(1, int(cap)))
+
+
+def _sig_of(value) -> str:
+    """``dtype[d0,d1,...]`` signature of one operand (tracers and concrete
+    arrays both answer shape/dtype); ``none`` for absent optionals. A
+    container operand (a Bitset filter, any pytree) flattens to its leaf
+    signatures — its repr would embed tracer identities that differ
+    between otherwise-identical traces and fake a shape diff. Plain
+    Python values fall back to repr."""
+    if value is None:
+        return "none"
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(int(d)) for d in shape)}]"
+    # sys.modules lookup, never an import: a signature read must not pull
+    # (or first-touch-init) jax — the tracing.process_info contract
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            leaves = jax.tree_util.tree_leaves(value)
+            if leaves and any(getattr(lf, "shape", None) is not None
+                              for lf in leaves):
+                inner = "/".join(_sig_of(lf) for lf in leaves)
+                return f"{type(value).__name__}({inner})"
+        # an unflattenable value is signed by its repr below — the
+        # signature is provenance decoration, never a failure class
+        except Exception:  # graftlint: ignore[unclassified-except,swallowed-exception]
+            pass
+    return repr(value)
+
+
+def _diff(prev: dict, cur: dict) -> list:
+    """Operand-level provenance: which operands changed between two traces
+    of the same entry (``from`` None = operand is new, ``to`` None =
+    operand gone)."""
+    out = []
+    for name in list(prev) + [n for n in cur if n not in prev]:
+        a, b = prev.get(name), cur.get(name)
+        if a != b:
+            out.append({"operand": name, "from": a, "to": b})
+    return out
+
+
+def trace_event(entry: str, static: Optional[dict] = None,
+                **operands) -> None:
+    """Record one trace of ``entry``. Call at the TOP of a jitted body —
+    it runs at trace time only (the ``PAGED_TRACES`` pattern), so a delta
+    of :func:`trace_count` over a serving window counts recompiles.
+
+    ``operands`` are the jit function's array arguments (tracers are
+    fine — only shape/dtype are read); ``static`` carries the static
+    arguments that participate in the cache key, so a retrace caused by a
+    static flip (new ``k``, new ``n_probes``) is attributed too.
+    """
+    if getattr(_SUPPRESS, "on", False):
+        return  # analysis-only lowering (see suppress_analysis)
+    sig = {name: _sig_of(v) for name, v in operands.items()}
+    if static:
+        for key, v in static.items():
+            sig[f"static.{key}"] = repr(v)
+    cur = _tracing.current_span()
+    rec = {
+        "entry": entry,
+        "t": round(time.time(), 3),
+        "shapes": sig,
+        "trace_id": cur[0] if cur is not None else None,
+        # tracing thread: watch() stamps wall-clock only onto records its
+        # OWN thread traced (a shadow-thread retrace inside another
+        # dispatch's window must not inherit that dispatch's duration)
+        "tid": threading.get_ident(),
+    }
+    with _LOCK:
+        prev = _LAST_SIG.get(entry)
+        seq = _COUNTS.get(entry, 0) + 1
+        _COUNTS[entry] = seq
+        _LAST_SIG[entry] = sig
+        rec["seq"] = seq
+        rec["first"] = prev is None
+        rec["changed"] = [] if prev is None else _diff(prev, sig)
+        if prev is not None and not rec["changed"]:
+            _UNEXPLAINED["count"] += 1
+            rec["unexplained"] = True
+        _LEDGER.append(rec)
+    if _metrics_enabled():
+        _metric_add(f"compile.traces.{entry}")
+        if rec.get("unexplained"):
+            _metric_add("compile.unexplained_retraces")
+
+
+class _Watch:
+    """Context manager stamping the dispatch wall-clock onto any ledger
+    records created inside it — the host-observed cost of the call that
+    (re)traced. Steady-state dispatches create no records and stamp
+    nothing; nested watches stamp innermost-first (already-stamped records
+    are left alone). New records are detected by the TOTAL trace count,
+    not the ring length — a ring already at capacity keeps its length
+    constant while still appending, and the stamp must survive that.
+    Only records traced by THIS thread are stamped: another thread's
+    concurrent retrace (the shadow sampler re-tracing inside a queue
+    dispatch's window) carries its own cost, not this dispatch's."""
+
+    __slots__ = ("_t0", "_c0", "_tid")
+
+    def __enter__(self):
+        self._tid = threading.get_ident()
+        with _LOCK:
+            self._c0 = sum(_COUNTS.values())
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        with _LOCK:
+            new = sum(_COUNTS.values()) - self._c0
+            if new > 0:
+                # the newest `new` records are the window's (ring eviction
+                # can only have dropped OLDER ones); stamp own-thread only
+                for rec in list(_LEDGER)[-min(new, len(_LEDGER)):]:
+                    if rec.get("tid") == self._tid:
+                        rec.setdefault("wall_s", round(dt, 6))
+        return False
+
+
+def watch() -> _Watch:
+    """``with compile.watch(): jitted(...)`` around a dispatch site —
+    records that trace inside the block gain ``wall_s``, the wall-clock of
+    the dispatch that paid the compile."""
+    return _Watch()
+
+
+class _SuppressAnalysis:
+    """Ledger mute for analysis-only lowerings on THIS thread (re-entrant:
+    nesting keeps the outermost scope's restore value)."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self):
+        self._prev = getattr(_SUPPRESS, "on", False)
+        _SUPPRESS.on = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _SUPPRESS.on = self._prev
+        return False
+
+
+def suppress_analysis() -> _SuppressAnalysis:
+    """``with compile.suppress_analysis(): jitted.lower(...)`` around a
+    lowering done to ANALYZE a program, not to run it
+    (``costmodel.xla_memory_analysis``): the re-trace's signature is
+    unchanged by construction, so letting it record would fabricate an
+    unexplained retrace and inflate the zero-recompile trace-count deltas
+    the shims assert on. Thread-local — concurrent real dispatches keep
+    recording."""
+    return _SuppressAnalysis()
+
+
+def trace_count(entry: Optional[str] = None, prefix: Optional[str] = None) -> int:
+    """Traces ever recorded: for one ``entry``, for every entry under a
+    ``prefix``, or in total. Exact over ring eviction (counts live in
+    their own map). This is what the zero-recompile shims
+    (``serving.scan_trace_count`` / ``ivf_bq.scan_trace_count``) delta."""
+    with _LOCK:
+        if entry is not None:
+            return _COUNTS.get(entry, 0)
+        if prefix is not None:
+            return sum(v for k, v in _COUNTS.items() if k.startswith(prefix))
+        return sum(_COUNTS.values())
+
+
+def unexplained_retraces() -> int:
+    """Retraces whose operand/static signature did not change — every one
+    of these is a contract violation to chase (jit cache eviction, a fresh
+    jit object per call, a non-hashable static). Zero on a healthy run."""
+    with _LOCK:
+        return _UNEXPLAINED["count"]
+
+
+def entries() -> dict:
+    """{entry: trace count} for every entry point that ever traced."""
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def ledger(entry: Optional[str] = None, prefix: Optional[str] = None) -> list:
+    """Snapshot of the ledger ring, oldest first; optionally filtered to
+    one entry or an entry-name prefix."""
+    with _LOCK:
+        recs = list(_LEDGER)
+    if entry is not None:
+        recs = [r for r in recs if r["entry"] == entry]
+    if prefix is not None:
+        recs = [r for r in recs if r["entry"].startswith(prefix)]
+    return recs
+
+
+def reset() -> None:
+    """Clear the ledger, counts and signatures (tests)."""
+    with _LOCK:
+        _LEDGER.clear()
+        _COUNTS.clear()
+        _LAST_SIG.clear()
+        _UNEXPLAINED["count"] = 0
+
+
+def summary(recent: int = 5) -> dict:
+    """One report-ready section: total traces, per-entry counts, the
+    unexplained residue, and the newest ``recent`` records (shape diffs
+    included) — what ``obs.report.collect`` folds in, so a status snapshot
+    answers "did anything retrace, and why" directly."""
+    with record_span("obs.compile::summary"), _LOCK:
+        recs = list(_LEDGER)[-max(0, int(recent)):]
+        return {
+            "total_traces": sum(_COUNTS.values()),
+            "entries": dict(_COUNTS),
+            "unexplained_retraces": _UNEXPLAINED["count"],
+            "recent": [dict(r) for r in recs],
+        }

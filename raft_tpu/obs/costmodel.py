@@ -1,0 +1,660 @@
+"""Static HBM footprint prediction + pre-dispatch admission gauges.
+
+ROADMAP item 4 names its prerequisite outright: "static HBM-footprint
+prediction for a search/build dispatch before it runs" — today the only
+memory policy is OOM-then-halve after the fact. The "Memory Safe
+Computations with XLA" line (PAPERS.md) shows per-program cost accounting
+is tractable precisely because this repo's shapes are **capacity-padded
+and enumerable**: every scan operand's shape derives from layout
+parameters known on the host (n_lists, max_list_size, page capacity,
+table width), never from data. So the footprint of a dispatch is a sum of
+closed-form terms, computed before anything touches the device:
+
+* :func:`predict_index_bytes` — resident bytes of an index from its
+  layout parameters alone, for the five index families (brute_force /
+  ivf_flat / ivf_pq / ivf_bq / cagra) plus the serving
+  ``PagedListStore``. EXACT against ``obs.memory.index_bytes`` of the
+  built artifact (tier-1 property-tested): the formula IS the field
+  layout.
+* :func:`estimate` — one dispatch's operand + output + workspace byte
+  accounting per registered jit entry point, using the same
+  ``per_query``/``q_tile`` workspace-budget arithmetic the dispatch sites
+  themselves use; :func:`estimate_search` builds the kwargs from a live
+  index/store.
+* :func:`xla_memory_analysis` — the compiler cross-check: where the
+  backend provides ``lowered.compile().memory_analysis()`` (or
+  ``cost_analysis``), returns XLA's own argument/output/temp byte counts
+  to validate the static model against (None, classified, where the
+  backend doesn't).
+* :func:`check_admission` — the pre-dispatch hook: compares a predicted
+  footprint against the live ``memory.*`` watermark (obs/memory.py) and
+  an HBM budget (``Device.memory_stats()['bytes_limit']`` on TPU,
+  ``RAFT_TPU_OBS_HBM_BYTES`` override elsewhere), returning a classified
+  ``ADMIT`` / ``QUEUE`` / ``REJECT`` verdict record. Gauges and events
+  only — never a hot-path exception (the obs/slo.py posture); the item-4
+  admission controller is the consumer that will act on the verdicts.
+
+Admission thresholds ride env knobs: a projected footprint under
+``RAFT_TPU_OBS_ADMIT_SOFT`` (default 0.85) of budget ADMITs, under
+``RAFT_TPU_OBS_ADMIT_HARD`` (default 0.97) QUEUEs, above it REJECTs.
+With no budget discoverable the verdict is ADMIT with
+``budget_source="unknown"`` — prediction without a denominator is still a
+gauge, not a guess at a verdict.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import memory as obs_memory
+
+__all__ = [
+    "ADMIT",
+    "HARD_ENV",
+    "HBM_ENV",
+    "QUEUE",
+    "REJECT",
+    "SOFT_ENV",
+    "admission_counts",
+    "check_admission",
+    "estimate",
+    "estimate_search",
+    "hbm_budget",
+    "index_layout",
+    "paged_scan_estimator",
+    "predict_index_bytes",
+    "xla_memory_analysis",
+]
+
+ADMIT, QUEUE, REJECT = "admit", "queue", "reject"
+
+#: counter namespace every verdict lands under (obs registry); consumers
+#: fold it back out with :func:`admission_counts`
+ADMISSION_COUNTER_PREFIX = "costmodel.admission."
+
+HBM_ENV = "RAFT_TPU_OBS_HBM_BYTES"
+SOFT_ENV = "RAFT_TPU_OBS_ADMIT_SOFT"
+HARD_ENV = "RAFT_TPU_OBS_ADMIT_HARD"
+
+
+def _frac(env: str, default: float) -> float:
+    raw = os.environ.get(env, "").strip()
+    try:
+        v = float(raw) if raw else default
+    except ValueError:
+        v = default
+    return min(max(v, 0.0), 1.0)
+
+
+def _isize(dtype) -> int:
+    return int(np.dtype(dtype).itemsize)
+
+
+# ---------------------------------------------------------------------------
+# resident-index prediction (the five families + the paged store)
+# ---------------------------------------------------------------------------
+
+
+def _predict_brute_force(*, n: int, dim: int, dtype="float32",
+                         norms: bool = True) -> int:
+    total = n * dim * _isize(dtype)
+    if norms:
+        total += n * 4
+    return total
+
+
+def _predict_ivf_flat(*, n_lists: int, dim: int, max_list_size: int,
+                      dtype="float32", norms: bool = True,
+                      plan_cache: bool = False) -> int:
+    total = n_lists * dim * 4                                # centers
+    total += n_lists * max_list_size * dim * _isize(dtype)   # list_data
+    total += n_lists * max_list_size * 4                     # list_ids
+    if norms:
+        total += n_lists * max_list_size * 4                 # list_norms
+    if plan_cache:
+        total += n_lists * 4     # _lens_np_cache (first ragged-plan search)
+    return total
+
+
+def _predict_ivf_pq(*, n_lists: int, dim: int, max_list_size: int,
+                    pq_dim: int, pq_bits: int = 8,
+                    rot_dim: Optional[int] = None,
+                    codebook_kind: str = "subspace",
+                    decoded: bool = False,
+                    plan_cache: bool = False) -> int:
+    if rot_dim is None:
+        rot_dim = pq_dim * (-(-dim // pq_dim))
+    dsub = rot_dim // pq_dim
+    n_codes = 1 << pq_bits
+    code_width = (pq_dim * pq_bits + 7) // 8
+    total = n_lists * dim * 4                                # centers
+    total += rot_dim * rot_dim * 4                           # rotation
+    cb_rows = n_lists if codebook_kind == "cluster" else pq_dim
+    total += cb_rows * n_codes * dsub * 4                    # codebooks
+    total += n_lists * max_list_size * code_width            # list_codes
+    total += n_lists * max_list_size * 4                     # list_ids
+    total += n_lists * max_list_size * 4                     # b_sum
+    if decoded:
+        total += n_lists * max_list_size * rot_dim + 4       # int8 + scale
+    if plan_cache:
+        total += n_lists * 4     # _lens_np_cache (first ragged-plan search)
+    return total
+
+
+def _predict_ivf_bq(*, n_lists: int, dim: int, max_list_size: int,
+                    rot_dim: Optional[int] = None,
+                    plan_cache: bool = False) -> int:
+    if rot_dim is None:
+        rot_dim = -(-dim // 8) * 8
+    total = n_lists * dim * 4                                # centers
+    total += rot_dim * rot_dim * 4                           # rotation
+    total += n_lists * max_list_size * (rot_dim // 8)        # list_codes
+    total += n_lists * max_list_size * 4                     # list_ids
+    total += n_lists * max_list_size * 4                     # list_scale
+    total += n_lists * max_list_size * 4                     # list_bias
+    if plan_cache:
+        total += n_lists * 4     # _lens_np_cache (first ragged-plan search)
+    return total
+
+
+def _predict_cagra(*, n: int, dim: int, graph_degree: int, dtype="float32",
+                   proj_dim: int = 0, n_centroids: int = 0) -> int:
+    total = n * dim * _isize(dtype)                          # dataset
+    total += n * graph_degree * 4                            # graph
+    total += n * 4                                           # norms
+    if proj_dim:
+        total += dim * proj_dim * 4 + 4 + 4                  # proj+scale+energy
+        total += n * graph_degree * proj_dim                 # nbr_codes int8
+    if n_centroids:
+        total += n_centroids * dim * 4 + n_centroids * 4
+    return total
+
+
+def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
+                         page_rows: int, table_width: int, payload_width: int,
+                         payload_dtype="float32", store_kind: str = "ivf_flat",
+                         pq_dim: int = 0, pq_bits: int = 8,
+                         rot_dim: Optional[int] = None) -> int:
+    total = n_lists * dim * 4                                         # centers
+    total += capacity_pages * page_rows * payload_width * _isize(payload_dtype)
+    total += capacity_pages * page_rows * 4                           # page_ids
+    total += capacity_pages * page_rows * 4                           # page_aux
+    total += n_lists * table_width * 4                        # device table
+    # host bookkeeping (counted by index_bytes too — numpy arrays carry
+    # nbytes): page table + per-list chain lengths + per-page fill counts
+    # + page→list ownership
+    total += n_lists * table_width * 4                          # host _table
+    total += n_lists * 4                                        # _list_pages
+    total += capacity_pages * 4                                 # _fill
+    total += capacity_pages * 4                                 # _page_list
+    if store_kind == "ivf_pq":
+        if rot_dim is None:
+            rot_dim = pq_dim * (-(-dim // pq_dim))
+        total += rot_dim * rot_dim * 4                                # rotation
+        total += pq_dim * (1 << pq_bits) * (rot_dim // pq_dim) * 4    # codebooks
+    return total
+
+
+_FAMILIES = {
+    "brute_force": _predict_brute_force,
+    "ivf_flat": _predict_ivf_flat,
+    "ivf_pq": _predict_ivf_pq,
+    "ivf_bq": _predict_ivf_bq,
+    "cagra": _predict_cagra,
+    "paged_store": _predict_paged_store,
+}
+
+
+def predict_index_bytes(kind: str, **layout) -> int:
+    """Resident bytes of a ``kind`` index from its capacity-padded layout
+    parameters — computable BEFORE the index exists (the admission
+    controller's build-side input), and EXACT against
+    ``obs.memory.index_bytes`` of the built artifact (the formula is the
+    field layout; tier-1 property-tests pin the equality for
+    flat/pq/bq)."""
+    with obs.record_span("obs.costmodel::predict_index_bytes",
+                         attrs={"kind": kind} if obs.enabled() else None):
+        fn = _FAMILIES.get(kind)
+        if fn is None:
+            raise ValueError(
+                f"unknown index family {kind!r} (have {sorted(_FAMILIES)})")
+        return int(fn(**layout))
+
+
+def index_layout(index) -> dict:
+    """``{"kind": ..., **layout}`` of a built index/store, suitable for
+    ``predict_index_bytes(**index_layout(idx))`` — how the bench stamps
+    verify the predictor against the ``index_bytes`` gauge of the real
+    artifact."""
+    # lazy imports: neighbors/serving import obs, so the reverse edge must
+    # not run at module import time
+    from raft_tpu.neighbors import brute_force as bf_mod
+    from raft_tpu.neighbors import cagra as cagra_mod
+    from raft_tpu.neighbors import ivf_bq as bq_mod
+    from raft_tpu.neighbors import ivf_flat as flat_mod
+    from raft_tpu.neighbors import ivf_pq as pq_mod
+    from raft_tpu.serving.store import PagedListStore
+
+    # the ragged-plan search path memoizes a (n_lists,) host array on the
+    # index after its first search — part of the artifact's real footprint
+    plan = getattr(index, "_lens_np_cache", None) is not None
+    if isinstance(index, flat_mod.IvfFlatIndex):
+        return {"kind": "ivf_flat", "n_lists": index.n_lists,
+                "dim": index.dim, "max_list_size": index.max_list_size,
+                "dtype": str(index.list_data.dtype),
+                "norms": index.list_norms is not None, "plan_cache": plan}
+    if isinstance(index, pq_mod.IvfPqIndex):
+        return {"kind": "ivf_pq", "n_lists": index.n_lists,
+                "dim": index.dim, "max_list_size": index.max_list_size,
+                "pq_dim": index.pq_dim, "pq_bits": index.pq_bits,
+                "rot_dim": int(index.rotation.shape[0]),
+                "codebook_kind": index.codebook_kind,
+                "decoded": index.decoded is not None, "plan_cache": plan}
+    if isinstance(index, bq_mod.IvfBqIndex):
+        return {"kind": "ivf_bq", "n_lists": index.n_lists,
+                "dim": index.dim, "max_list_size": index.max_list_size,
+                "rot_dim": index.rot_dim, "plan_cache": plan}
+    if isinstance(index, cagra_mod.CagraIndex):
+        return {"kind": "cagra", "n": index.size, "dim": index.dim,
+                "graph_degree": index.graph_degree,
+                "dtype": str(index.dataset.dtype),
+                "proj_dim": (0 if index.proj is None
+                             else int(index.proj.shape[1])),
+                "n_centroids": (0 if index.centroids is None
+                                else int(index.centroids.shape[0]))}
+    if isinstance(index, bf_mod.BruteForceIndex):
+        return {"kind": "brute_force", "n": index.size, "dim": index.dim,
+                "dtype": str(index.dataset.dtype),
+                "norms": index.norms is not None}
+    if isinstance(index, PagedListStore):
+        return {"kind": "paged_store", "store_kind": index.kind,
+                "n_lists": index.n_lists, "dim": index.dim,
+                "capacity_pages": index.capacity_pages,
+                "page_rows": index.page_rows,
+                "table_width": index.table_width,
+                "payload_width": int(index.pages.shape[2]),
+                "payload_dtype": str(index.pages.dtype),
+                "pq_dim": index.pq_dim, "pq_bits": index.pq_bits,
+                "rot_dim": (None if index.rotation is None
+                            else int(index.rotation.shape[0]))}
+    raise TypeError(f"unsupported index type {type(index).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# per-dispatch estimators (operand + output + workspace)
+# ---------------------------------------------------------------------------
+
+
+def _ws_tile(q: int, per_query: int, workspace_bytes: int) -> int:
+    """The dispatch sites' own tile arithmetic (ivf_flat.search et al.):
+    q_tile = clamp(workspace // per_query, 1..q)."""
+    return int(max(1, min(q, workspace_bytes // max(1, per_query))))
+
+
+def _workspace_bytes() -> int:
+    from raft_tpu.core.resources import current_resources
+
+    return int(current_resources().workspace_bytes)
+
+
+def _est_ivf_flat_search(*, q, dim, n_lists, max_list_size, n_probes, k,
+                         dtype="float32", norms=True, workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    operands = q * dim * 4 + _predict_ivf_flat(
+        n_lists=n_lists, dim=dim, max_list_size=max_list_size, dtype=dtype,
+        norms=norms)
+    per_query = max(1, n_probes * max_list_size * (dim + 2) * 4)
+    qt = _ws_tile(q, per_query, ws)
+    workspace = qt * per_query + q * n_lists * 8       # gather tile + coarse
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_ivf_flat_paged(*, q, dim, n_lists, capacity_pages, page_rows,
+                        table_width, n_probes, k, dtype="float32",
+                        workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    operands = q * dim * 4 + _predict_paged_store(
+        n_lists=n_lists, dim=dim, capacity_pages=capacity_pages,
+        page_rows=page_rows, table_width=table_width, payload_width=dim,
+        payload_dtype=dtype)
+    per_query = max(1, n_probes * table_width * page_rows * (dim + 2) * 4)
+    qt = _ws_tile(q, per_query, ws)
+    workspace = qt * per_query + q * n_lists * 8
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_ivf_pq_search(*, q, dim, n_lists, max_list_size, pq_dim, n_probes,
+                       k, pq_bits=8, rot_dim=None, workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    if rot_dim is None:
+        rot_dim = pq_dim * (-(-dim // pq_dim))
+    operands = q * dim * 4 + _predict_ivf_pq(
+        n_lists=n_lists, dim=dim, max_list_size=max_list_size, pq_dim=pq_dim,
+        pq_bits=pq_bits, rot_dim=rot_dim)
+    per_query = max(1, n_probes * max_list_size * (pq_dim * 5 + 8))
+    qt = _ws_tile(q, per_query, ws)
+    luts = q * pq_dim * (1 << pq_bits) * 4
+    workspace = qt * per_query + luts + q * n_lists * 8
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_ivf_pq_paged(*, q, dim, n_lists, capacity_pages, page_rows,
+                      table_width, pq_dim, n_probes, k, pq_bits=8,
+                      rot_dim=None, workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    code_width = (pq_dim * pq_bits + 7) // 8
+    operands = q * dim * 4 + _predict_paged_store(
+        n_lists=n_lists, dim=dim, capacity_pages=capacity_pages,
+        page_rows=page_rows, table_width=table_width,
+        payload_width=code_width, payload_dtype="uint8", store_kind="ivf_pq",
+        pq_dim=pq_dim, pq_bits=pq_bits, rot_dim=rot_dim)
+    per_query = max(1, n_probes * table_width * page_rows * (pq_dim * 5 + 8))
+    qt = _ws_tile(q, per_query, ws)
+    luts = q * pq_dim * (1 << pq_bits) * 4
+    workspace = qt * per_query + luts + q * n_lists * 8
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_ivf_bq_search(*, q, dim, n_lists, max_list_size, n_probes, k,
+                       rot_dim=None, workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    if rot_dim is None:
+        rot_dim = -(-dim // 8) * 8
+    operands = q * dim * 4 + _predict_ivf_bq(
+        n_lists=n_lists, dim=dim, max_list_size=max_list_size,
+        rot_dim=rot_dim)
+    # rotated queries + coarse gemm + the unpacked ±1 strip block the scan
+    # holds per tile (bf16 rows, rot_dim wide) + score/merge rows
+    per_query = max(1, n_probes * max_list_size * (rot_dim * 2 + 8))
+    qt = _ws_tile(q, per_query, ws)
+    workspace = qt * per_query + q * rot_dim * 4 + q * n_lists * 8
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_brute_force_search(*, q, n, dim, k, tile_rows=65536,
+                            dtype="float32", workspace_bytes=None):
+    operands = q * dim * 4 + _predict_brute_force(n=n, dim=dim, dtype=dtype)
+    tile = min(n, tile_rows)
+    workspace = q * tile * 4 * 2                       # distance tile + select
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
+def _est_serving_upsert(*, n_rows, payload_width, dim,
+                        payload_dtype="float32", workspace_bytes=None):
+    batch = 1 << max(0, int(n_rows - 1).bit_length())  # pow2 scatter bucket
+    operands = n_rows * dim * 4                        # incoming vectors
+    workspace = batch * (payload_width * _isize(payload_dtype) + 4 + 4 + 16)
+    outputs = 0                                        # in-place pool update
+    return operands, outputs, workspace
+
+
+_ESTIMATORS = {
+    "ivf_flat.search": _est_ivf_flat_search,
+    "ivf_flat.paged_scan": _est_ivf_flat_paged,
+    "ivf_pq.search": _est_ivf_pq_search,
+    "ivf_pq.paged_scan": _est_ivf_pq_paged,
+    "ivf_bq.search": _est_ivf_bq_search,
+    "brute_force.search": _est_brute_force_search,
+    "serving.upsert": _est_serving_upsert,
+}
+
+
+def estimate(entry: str, **shapes) -> dict:
+    """Static footprint of ONE dispatch of ``entry``: operand bytes (the
+    resident arrays the program reads), output bytes, and workspace bytes
+    (the big intermediates, via the same per-query/tile arithmetic the
+    dispatch site uses to size itself). ``transient_bytes`` = outputs +
+    workspace — the allocation the dispatch ADDS on top of what is already
+    resident, which is the number admission projects forward."""
+    with obs.record_span("obs.costmodel::estimate",
+                         attrs={"entry": entry} if obs.enabled() else None):
+        fn = _ESTIMATORS.get(entry)
+        if fn is None:
+            raise ValueError(
+                f"unknown entry {entry!r} (have {sorted(_ESTIMATORS)})")
+        operands, outputs, workspace = fn(**shapes)
+        out = {
+            "entry": entry,
+            "operand_bytes": int(operands),
+            "output_bytes": int(outputs),
+            "workspace_bytes": int(workspace),
+            "transient_bytes": int(outputs + workspace),
+            "total_bytes": int(operands + outputs + workspace),
+        }
+        if obs.enabled():
+            obs.set_gauge(f"costmodel.{entry}.total_bytes",
+                          out["total_bytes"])
+        return out
+
+
+def estimate_search(index, q: int, k: int, n_probes: int = 0,
+                    workspace_bytes: Optional[int] = None) -> dict:
+    """:func:`estimate` with kwargs derived from a live index/store — the
+    bench-section and serving-dispatch convenience."""
+    layout = index_layout(index)
+    kind = layout.pop("kind")
+    ws = {"workspace_bytes": workspace_bytes}
+    if kind == "ivf_flat":
+        return estimate("ivf_flat.search", q=q, k=k, n_probes=n_probes,
+                        dim=layout["dim"], n_lists=layout["n_lists"],
+                        max_list_size=layout["max_list_size"],
+                        dtype=layout["dtype"], norms=layout["norms"], **ws)
+    if kind == "ivf_pq":
+        return estimate("ivf_pq.search", q=q, k=k, n_probes=n_probes,
+                        dim=layout["dim"], n_lists=layout["n_lists"],
+                        max_list_size=layout["max_list_size"],
+                        pq_dim=layout["pq_dim"], pq_bits=layout["pq_bits"],
+                        rot_dim=layout["rot_dim"], **ws)
+    if kind == "ivf_bq":
+        return estimate("ivf_bq.search", q=q, k=k, n_probes=n_probes,
+                        dim=layout["dim"], n_lists=layout["n_lists"],
+                        max_list_size=layout["max_list_size"],
+                        rot_dim=layout["rot_dim"], **ws)
+    if kind == "brute_force":
+        return estimate("brute_force.search", q=q, k=k, n=layout["n"],
+                        dim=layout["dim"], dtype=layout["dtype"], **ws)
+    if kind == "paged_store":
+        entry = ("ivf_pq.paged_scan" if layout.get("store_kind") == "ivf_pq"
+                 else "ivf_flat.paged_scan")
+        kw = dict(q=q, k=k, n_probes=n_probes, dim=layout["dim"],
+                  n_lists=layout["n_lists"],
+                  capacity_pages=layout["capacity_pages"],
+                  page_rows=layout["page_rows"],
+                  table_width=layout["table_width"], **ws)
+        if entry == "ivf_pq.paged_scan":
+            kw.update(pq_dim=layout["pq_dim"], pq_bits=layout["pq_bits"],
+                      rot_dim=layout["rot_dim"])
+        return estimate(entry, **kw)
+    raise ValueError(f"no dispatch estimator for index family {kind!r}")
+
+
+def paged_scan_estimator(store, k: int, n_probes: int):
+    """``batch_size -> estimate dict`` closed over one store's CURRENT
+    capacity layout — the ``QueryQueue(cost_model=...)`` hook. Re-reads
+    the layout each call, so a capacity growth is priced from the next
+    dispatch on."""
+
+    def cost(batch: int) -> dict:
+        return estimate_search(store, q=int(batch), k=k, n_probes=n_probes)
+
+    return cost
+
+
+# ---------------------------------------------------------------------------
+# XLA cross-check
+# ---------------------------------------------------------------------------
+
+
+def xla_memory_analysis(jitted, *args, **kwargs) -> Optional[dict]:
+    """The backend's own byte accounting for one lowering of ``jitted``:
+    ``{"argument_bytes", "output_bytes", "temp_bytes", "generated_code_bytes"}``
+    from ``lower(...).compile().memory_analysis()``, falling back to
+    ``cost_analysis()``'s ``bytes accessed``. None (classified into the
+    event ring) where the backend provides neither — the static model
+    stands alone there."""
+    from raft_tpu import resilience
+
+    with obs.record_span("obs.costmodel::xla_memory_analysis"):
+        try:
+            # analysis-only lowering: mute the compile ledger — the body's
+            # trace_event would otherwise record a same-signature re-trace
+            # as a fabricated "unexplained retrace" and inflate the
+            # zero-recompile deltas this module exists to validate
+            with obs_compile.suppress_analysis():
+                compiled = jitted.lower(*args, **kwargs).compile()
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                out = {}
+                for ours, theirs in (
+                        ("argument_bytes", "argument_size_in_bytes"),
+                        ("output_bytes", "output_size_in_bytes"),
+                        ("temp_bytes", "temp_size_in_bytes"),
+                        ("generated_code_bytes",
+                         "generated_code_size_in_bytes")):
+                    v = getattr(mem, theirs, None)
+                    if v is not None:
+                        out[ours] = int(v)
+                if out:
+                    return out
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if isinstance(cost, dict) and "bytes accessed" in cost:
+                return {"bytes_accessed": int(cost["bytes accessed"])}
+            return None
+        except Exception as e:
+            # a backend without the analysis API is a supported state; the
+            # event carries the kind so a real lowering failure is visible
+            resilience.record_event(
+                "costmodel_xla_analysis_unavailable",
+                kind=resilience.classify(e), error=repr(e)[:200])
+            return None
+
+
+# ---------------------------------------------------------------------------
+# pre-dispatch admission
+# ---------------------------------------------------------------------------
+
+
+def admission_counts(counters: dict) -> dict:
+    """``{verdict: count}`` folded out of a counters snapshot — the ONE
+    definition of the verdict-counter namespace, shared by
+    ``obs.report.collect`` and the bench operating-point record."""
+    return {k[len(ADMISSION_COUNTER_PREFIX):]: int(v)
+            for k, v in (counters or {}).items()
+            if k.startswith(ADMISSION_COUNTER_PREFIX)}
+
+
+def hbm_budget() -> dict:
+    """``{"bytes": int, "source": str}`` — the denominator admission
+    projects against: ``RAFT_TPU_OBS_HBM_BYTES`` when set (tests, CPU
+    serving hosts), else the sum of ``Device.memory_stats()['bytes_limit']``
+    over local devices (TPU), else 0 with ``source="unknown"``."""
+    raw = os.environ.get(HBM_ENV, "").strip()
+    if raw.isdigit() and int(raw) > 0:
+        return {"bytes": int(raw), "source": "env"}
+    total = 0
+    for dev in obs_memory.device_stats():
+        total += int(dev.get("bytes_limit", 0) or 0)
+    if total > 0:
+        return {"bytes": total, "source": "device_stats"}
+    return {"bytes": 0, "source": "unknown"}
+
+
+def check_admission(predicted, entry: str = "",
+                    budget_bytes: Optional[int] = None) -> dict:
+    """Pre-dispatch admission verdict for a predicted footprint:
+    ``predicted`` is an :func:`estimate` dict (its ``transient_bytes`` is
+    the projected delta) or a plain byte count. Projects ``bytes_in_use +
+    predicted`` against the budget and classifies ADMIT (≤ soft·budget) /
+    QUEUE (≤ hard·budget) / REJECT — recorded as gauges
+    (``costmodel.admission.*``) and, for non-admit verdicts, classified
+    events in the resilience ring. On a multi-device backend with
+    per-device allocator limits the verdict is the WORST device's: the
+    whole predicted footprint is projected onto each device's own
+    ``(bytes_in_use + predicted) / bytes_limit`` — summing across devices
+    would dilute one hot chip's pressure by the device count and admit
+    the dispatch that OOMs it. Returns the verdict record; NEVER raises
+    (an admission check that throws is worse than no check — failures
+    degrade to an ``unknown``-budget ADMIT, classified)."""
+    from raft_tpu import resilience
+
+    with obs.record_span("obs.costmodel::check_admission",
+                         attrs={"entry": entry} if obs.enabled() else None):
+        try:
+            if isinstance(predicted, dict):
+                pred_bytes = int(predicted.get(
+                    "transient_bytes", predicted.get("total_bytes", 0)))
+            else:
+                pred_bytes = int(predicted)
+        except Exception as e:
+            # a malformed prediction must not cost the dispatch either:
+            # zero-byte ADMIT, classified — the caller's hook is broken,
+            # not the request
+            resilience.record_event("admission_bad_prediction",
+                                    kind=resilience.classify(e),
+                                    error=repr(e)[:200])
+            pred_bytes = 0
+        per_dev = []
+        try:
+            mem = obs_memory.sample(f"admission.{entry}" if entry
+                                    else "admission")
+            in_use = int(mem["bytes_in_use"])
+            per_dev = [d for d in (mem.get("per_device") or [])
+                       if d.get("bytes_limit")]
+            budget = ({"bytes": int(budget_bytes), "source": "caller"}
+                      if budget_bytes else hbm_budget())
+        except Exception as e:
+            # the check must not cost the dispatch: degrade classified
+            resilience.record_event("admission_check_error",
+                                    kind=resilience.classify(e),
+                                    error=repr(e)[:200])
+            in_use, budget = 0, {"bytes": 0, "source": "unknown"}
+        projected = in_use + pred_bytes
+        soft, hard = _frac(SOFT_ENV, 0.85), _frac(HARD_ENV, 0.97)
+        if budget["source"] == "device_stats" and per_dev:
+            # worst-device projection (see docstring)
+            frac = max((d["bytes_in_use"] + pred_bytes) / d["bytes_limit"]
+                       for d in per_dev)
+            verdict = (ADMIT if frac <= soft
+                       else QUEUE if frac <= hard else REJECT)
+        elif budget["bytes"] <= 0:
+            verdict, frac = ADMIT, None
+        else:
+            frac = projected / budget["bytes"]
+            verdict = (ADMIT if frac <= soft
+                       else QUEUE if frac <= hard else REJECT)
+        rec = {
+            "verdict": verdict,
+            "entry": entry,
+            "predicted_bytes": pred_bytes,
+            "bytes_in_use": in_use,
+            "projected_bytes": projected,
+            "budget_bytes": budget["bytes"],
+            "budget_source": budget["source"],
+            "projected_fraction": (round(frac, 4)
+                                   if frac is not None else None),
+            "t": round(time.time(), 3),
+        }
+        if obs.enabled():
+            obs.add(f"{ADMISSION_COUNTER_PREFIX}{verdict}")
+            obs.set_gauge("costmodel.admission.predicted_bytes", pred_bytes)
+            obs.set_gauge("costmodel.admission.projected_bytes", projected)
+        if verdict != ADMIT:
+            resilience.record_event(f"admission_{verdict}", entry=entry,
+                                    predicted_bytes=pred_bytes,
+                                    projected_bytes=projected,
+                                    budget_bytes=budget["bytes"])
+        return rec

@@ -67,25 +67,49 @@ def device_stats() -> list:
             stats = None
         if not stats:
             continue
-        out.append({
+        row = {
             "device": str(dev.id),
             "platform": dev.platform,
             "bytes_in_use": int(stats.get("bytes_in_use", 0)),
             "peak_bytes_in_use": int(stats.get(
                 "peak_bytes_in_use", stats.get("bytes_in_use", 0))),
-        })
+        }
+        # allocator capacity, where the backend reports one — the HBM
+        # budget denominator obs/costmodel.hbm_budget projects against
+        if stats.get("bytes_limit"):
+            row["bytes_limit"] = int(stats["bytes_limit"])
+        out.append(row)
     return out
 
 
 def live_bytes() -> int:
     """Total bytes of every live committed array in the process — the CPU
-    fallback watermark (the CPU allocator exposes no per-device stats)."""
+    fallback watermark (the CPU allocator exposes no per-device stats).
+
+    Deduplicated by buffer identity (round-11 audit): ``jax.live_arrays()``
+    can hand back several Array objects over the SAME device buffer
+    (no-copy ``device_put``, donated-buffer aliasing), and summing their
+    ``nbytes`` naively double-counts the buffer. Arrays are keyed by
+    ``unsafe_buffer_pointer()`` where the runtime provides it (single-shard
+    arrays), falling back to object identity — distinct buffers never share
+    a pointer, so the dedup can only remove true aliases."""
     jax = _live_jax()
     if jax is None:
         return 0
     total = 0
+    seen = set()
     for arr in jax.live_arrays():
         try:
+            try:
+                key = ("buf", int(arr.unsafe_buffer_pointer()))
+            # sharded/committed-elsewhere arrays expose no single buffer
+            # pointer — object identity is the conservative fallback
+            # (never merges distinct buffers)
+            except Exception:  # graftlint: ignore[unclassified-except]
+                key = ("obj", id(arr))
+            if key in seen:
+                continue
+            seen.add(key)
             total += int(arr.nbytes)
         # a deleted-buffer race during iteration must not fail a
         # watermark read

@@ -42,6 +42,22 @@ __all__ = ["collect", "export", "main", "render", "validate"]
 _VERDICT_PREFIX = "serving.requests."
 
 
+def _compile_summary() -> dict:
+    """The compile ledger's report section (lazy import: obs/compile is
+    kept off the package import path like the rest of the SLO plane)."""
+    from raft_tpu.obs import compile as obs_compile
+
+    return obs_compile.summary(recent=3)
+
+
+def _admission_counts(counters: dict) -> dict:
+    """Verdict counts via the one shared namespace fold (costmodel owns
+    the prefix; lazy import as above)."""
+    from raft_tpu.obs import costmodel
+
+    return costmodel.admission_counts(counters)
+
+
 def _classified(fn, label: str, out_errors: dict):
     """Run one provider; a failure degrades its section to None and lands
     classified in ``errors`` — a status report must report, not raise."""
@@ -84,6 +100,17 @@ def collect(engine=None, sampler=None, queue=None,
             "memory": {k: {"value": g.get("value"), "max": g.get("max")}
                        for k, g in (snap.get("gauges") or {}).items()
                        if k.startswith("memory.")},
+            # compile ledger (round 11): total traces, per-entry counts,
+            # the unexplained residue (zero on a healthy run) and the
+            # newest shape-diffed records — "did anything retrace, and
+            # which operand caused it" straight from the status snapshot
+            "compile": _classified(_compile_summary, "compile", errors),
+            # pre-dispatch admission verdict counters (obs/costmodel.py):
+            # a healthy over-subscribed plane queues/rejects CLASSIFIED
+            # instead of OOMing — these are the counts the item-4
+            # controller consumes
+            "admission": _classified(
+                lambda: _admission_counts(counters), "admission", errors),
             "shard_health": _classified(
                 lambda: resilience.shard_health().snapshot(),
                 "shard_health", errors),
@@ -165,6 +192,14 @@ def validate(report: dict,
     if verdicts.get("unclassified", 0):
         problems.append(
             f"{verdicts['unclassified']} unclassified verdict(s)")
+    # compile ledger (round 11): every retrace must carry a shape-diff —
+    # an unexplained retrace is a zero-recompile-contract violation.
+    # Lenient on absence (pre-round-11 report streams have no section).
+    comp = report.get("compile")
+    if isinstance(comp, dict) and comp.get("unexplained_retraces", 0):
+        problems.append(
+            f"{comp['unexplained_retraces']} unexplained retrace(s) "
+            f"in the compile ledger")
     return problems
 
 

@@ -64,10 +64,12 @@ def searcher(store: PagedListStore, k: int, n_probes: int = 20, **kwargs):
 
 
 def scan_trace_count() -> int:
-    """Total (re)traces of the paged scan programs in this process (one
-    shared counter, `_packing.PAGED_TRACES`, bumped by every paged
-    backend) — the zero-recompile serving contract is asserted on deltas
-    of this counter."""
+    """Total (re)traces of the paged scan programs in this process — a
+    thin shim over the compile ledger (`obs/compile.py`; every paged
+    backend records a ledger trace_event at trace time). The
+    zero-recompile serving contract is asserted on deltas of this counter,
+    and each retrace additionally carries its operand shape-diff in the
+    ledger, so a nonzero delta names the operand that grew."""
     return _packing.paged_trace_count()
 
 

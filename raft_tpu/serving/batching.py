@@ -32,6 +32,17 @@ survivors are counted (``serving.queue.requeued``) and flagged on their
 dispatch span, so SLO burn-rate math over the once-per-request verdict
 counters never double-counts their first admission.
 
+**Pre-dispatch admission gauges** (round 11): with a ``cost_model`` hook
+(``obs.costmodel.paged_scan_estimator(store, k, n_probes)``), every batch
+dispatch first runs ``costmodel.check_admission`` — its predicted HBM
+footprint projected against the live watermark and budget — and the
+classified ADMIT/QUEUE/REJECT verdict lands as gauges, events and a
+dispatch-span attribute. Record-only this round: the ROADMAP item-4
+admission controller is the consumer that will act on non-admit
+verdicts. Each dispatch also runs under ``obs.compile.watch()``, so a
+mid-traffic retrace is stamped with the wall-clock it cost in the
+compile ledger.
+
 **Per-request traces** (round 10): with telemetry on, every request gets
 its own trace — ``submit → admit → dispatch → complete`` recorded as
 children of one ``serving::request`` root via the explicit-lineage path
@@ -57,6 +68,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from raft_tpu import obs, resilience
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.resilience.deadline import DeadlineExceeded
 from raft_tpu.resilience.retry import record_event
 
@@ -151,12 +163,23 @@ class QueryQueue:
                  fill_wait_s: Optional[float] = None,
                  default_timeout_s: Optional[float] = None,
                  pressure_margin_s: float = 0.002,
-                 shadow=None):
+                 shadow=None,
+                 cost_model: Optional[Callable] = None):
         self._search_fn = search_fn
         # optional online-recall shadow sampler (obs/shadow.ShadowSampler):
         # served results are OFFERED after each successful dispatch — one
         # seeded-hash decision per request, drop-on-pressure, never blocking
         self._shadow = shadow
+        # optional pre-dispatch cost hook (round 11): ``batch_size -> bytes
+        # or obs.costmodel.estimate dict``; each dispatch is first run
+        # through ``costmodel.check_admission`` and the ADMIT/QUEUE/REJECT
+        # verdict lands as gauges + classified events and on the dispatch
+        # span. Observability only — a non-admit verdict does NOT block the
+        # dispatch here; acting on it is the ROADMAP item-4 admission
+        # controller, which consumes exactly these records.
+        # (``costmodel.paged_scan_estimator(store, k, n_probes)`` builds
+        # the hook for a paged store.)
+        self._cost_model = cost_model
         self.slo_s = float(slo_s)
         self.max_batch = int(max_batch)
         self.buckets = _buckets(self.max_batch)
@@ -336,17 +359,41 @@ class QueryQueue:
                 [qarr, np.repeat(qarr[:1], bucket - n, axis=0)])
         now = time.monotonic()
         budget = min(r.t_deadline for r in batch) - now
+        verdict_rec = None
+        if self._cost_model is not None:
+            # pre-dispatch admission gauge (round 11): predict the batch's
+            # footprint, compare against the live memory watermark, record
+            # the classified verdict — never raises, never blocks (the
+            # item-4 controller is the consumer that will act on REJECTs)
+            from raft_tpu.obs import costmodel
+
+            try:
+                predicted = self._cost_model(bucket)
+            except Exception as e:
+                record_event("serving_cost_model_error",
+                             kind=resilience.classify(e),
+                             error=repr(e)[:200])
+                predicted = None
+            if predicted is not None:
+                verdict_rec = costmodel.check_admission(
+                    predicted, entry="serving.dispatch")
         attrs = None
         if obs.enabled():
             attrs = {"batch": n, "bucket": bucket,
                      "cap": self._batch_cap,
                      "requeued": sum(1 for r in batch if r.requeued)}
+            if verdict_rec is not None:
+                attrs["admission"] = verdict_rec["verdict"]
         try:
             with obs.record_span("serving::dispatch", attrs=attrs):
                 resilience.faultpoint("serving.queue.dispatch")
                 with resilience.Deadline(max(budget, 0.0),
                                          label="serving.dispatch"):
-                    vals, ids = self._search_fn(qarr)
+                    # ledger watch: a mid-traffic retrace inside this
+                    # dispatch gets the dispatch's wall-clock stamped on
+                    # its ledger record (obs/compile.py)
+                    with obs_compile.watch():
+                        vals, ids = self._search_fn(qarr)
                     # force completion INSIDE the deadline scope: a result
                     # is only served once it is actually materialized
                     vals = np.asarray(vals)

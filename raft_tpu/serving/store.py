@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import obs, resilience
+from raft_tpu.obs import compile as obs_compile
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.trace import traced
@@ -82,6 +83,10 @@ def _pow2_at_least(v: int) -> int:
 @jax.jit
 def _tombstone(page_ids, pp, rr):
     """Scatter -1 into (pp, rr) slots; sentinel coords >= capacity drop."""
+    # ledger registration: pow2-bucketed coords compile O(log) programs —
+    # each one lands attributed (obs/compile.py; trace time only)
+    obs_compile.trace_event("serving.tombstone", page_ids=page_ids,
+                            pp=pp, rr=rr)
     return page_ids.at[pp, rr].set(-1, mode="drop")
 
 
@@ -90,6 +95,11 @@ def _scatter_rows(pages, page_ids, page_aux, payload, ids, aux, pp, rr):
     carry ``pp == capacity`` which ``mode="drop"`` discards. jit'd below —
     kept un-donated: on a failed dispatch the caller's arrays must stay
     valid (upsert commits host metadata only after the scatter lands)."""
+    # ledger registration: a capacity-growth retrace lands attributed to
+    # the pool operand that grew (obs/compile.py; trace time only)
+    obs_compile.trace_event("serving.scatter", pages=pages,
+                            page_ids=page_ids, page_aux=page_aux,
+                            payload=payload, ids=ids, aux=aux, pp=pp, rr=rr)
     pages = pages.at[pp, rr].set(payload, mode="drop")
     page_ids = page_ids.at[pp, rr].set(ids, mode="drop")
     page_aux = page_aux.at[pp, rr].set(aux, mode="drop")

@@ -171,6 +171,15 @@ def direction(metric: str) -> str:
         return "down"
     if tail.endswith("capacity_rows") or tail.endswith("compression_x"):
         return "up"
+    # cost-model accuracy (round 11): the predicted/measured HBM ratio is
+    # best AT 1.0 — drift in either direction is the predictor degrading,
+    # so the verdict compares |ratio − 1| across rounds ("one" direction);
+    # an unexplained retrace (no shape-diff attribution) is a
+    # zero-recompile-contract violation, shrinking toward good
+    if tail.endswith("predicted_to_measured"):
+        return "one"
+    if tail == "unexplained_retraces":
+        return "down"
     if "qps" in tail or tail in ("value", "vs_baseline", "recall",
                                  "recall_gate_met", "ann_beats_brute",
                                  "per_chip_measured", "per_chip_recall"):
@@ -194,6 +203,14 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "serving.recall_estimate": 0.01,
     "serving.recall_stale": 0.0,
     "serving.recompiles_during_serving": 0.0,
+    # cost model (round 11): an unexplained retrace is a contract
+    # violation at ANY count; prediction accuracy gets a 5% band before a
+    # drift away from ratio 1.0 becomes a regression row
+    "serving.unexplained_retraces": 0.0,
+    "serving.hbm_predicted_to_measured": 0.05,
+    "ivf_flat.hbm_predicted_to_measured": 0.05,
+    "ivf_pq.hbm_predicted_to_measured": 0.05,
+    "ivf_bq.hbm_predicted_to_measured": 0.05,
 }
 
 
@@ -219,6 +236,11 @@ def compare(a: dict, b: dict, threshold: float, per_metric: dict):
             # regression the gate must not wave through as informational
             verdict = ("improved" if (dirn == "up") == (vb > va)
                        else "regression")
+        elif dirn == "one":
+            # accuracy metric: best AT 1.0 — compare distances from 1
+            ea, eb = abs(va - 1.0), abs(vb - 1.0)
+            verdict = ("regression" if eb > ea + thr
+                       else "improved" if eb < ea - thr else "ok")
         elif dirn == "up":
             verdict = ("regression" if delta < -thr
                        else "improved" if delta > thr else "ok")

@@ -121,6 +121,17 @@ echo "== obs-report smoke (SLO burn rates + shadow recall + report CLI, ISSUE 10
 JAX_PLATFORMS=cpu python scripts/obs_report_smoke.py || fail=1
 
 echo
+echo "== costmodel + compile-ledger smoke (HBM prediction + retrace attribution, ISSUE 11) =="
+# Tiny serving run through the dispatch-observability plane: exact
+# predict_index_bytes for index AND paged store, ONE forced growth
+# retrace -> exactly one ledger record with an operand shape-diff (zero
+# unexplained retraces), static HBM prediction within 25% of the measured
+# watermark, admission verdicts recorded (budget squeeze -> REJECT), and
+# the obs.report snapshot (now carrying the compile section) validating
+# through the CLI.
+JAX_PLATFORMS=cpu python scripts/costmodel_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1
@@ -156,6 +167,11 @@ assert "error" not in bq, bq
 assert bq["recall"] >= 0.9, bq
 assert bq.get("recompiles_during_search", 99) == 0, bq
 assert bq.get("per_chip_measured"), bq
+# ISSUE 11: the static layout prediction must equal the residency stamp
+# EXACTLY, and the section's HBM projection must land within 25% of the
+# measured watermark
+assert bq["predicted_index_bytes"] == bq["index_bytes"], bq
+assert 0.75 <= bq["hbm_predicted_to_measured"] <= 1.25, bq
 print("tiny ivf_bq smoke: OK (qps=%s recall=%s code_bytes/row=%s "
       "compression=%sx)" % (bq["qps"], bq["recall"],
                             bq["code_bytes_per_row"],

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Compile-ledger + cost-model CPU smoke (ISSUE 11, wired into check.sh).
+
+Tiny serving run that exercises the dispatch-observability plane end to
+end and asserts the acceptance gates:
+
+* ``predict_index_bytes`` EXACTLY matches the ``index_bytes`` stamp for
+  the built index AND the paged store (the static layout model vs the
+  real artifact);
+* ONE forced paged-store capacity growth mid-traffic → exactly one new
+  scan retrace, present in the compile ledger as an ATTRIBUTED record
+  (non-empty operand shape-diff naming what grew), with ZERO unexplained
+  retraces;
+* the static HBM prediction (watermark-at-start + predicted store bytes)
+  lands within 25% of the measured watermark;
+* pre-dispatch admission: the ``QueryQueue`` cost hook records verdicts,
+  and squeezing the budget env knob flips the verdict to QUEUE/REJECT —
+  classified records, never exceptions;
+* the unified ``obs.report`` snapshot carries the compile section and
+  still validates through the ``python -m raft_tpu.obs.report --validate``
+  CLI (which now also gates on zero unexplained retraces).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu import obs, serving  # noqa: E402
+from raft_tpu.neighbors import ivf_flat  # noqa: E402
+from raft_tpu.obs import compile as obs_compile  # noqa: E402
+from raft_tpu.obs import costmodel  # noqa: E402
+from raft_tpu.obs import memory as obs_memory  # noqa: E402
+from raft_tpu.obs import report as obs_report  # noqa: E402
+from raft_tpu.obs import shadow as obs_shadow  # noqa: E402
+from raft_tpu.obs import slo as obs_slo  # noqa: E402
+
+K, NPROBE, N_REQ = 5, 4, 32
+
+
+def _exact(kind_obj, label):
+    pred = costmodel.predict_index_bytes(**costmodel.index_layout(kind_obj))
+    real = obs_memory.index_bytes(kind_obj)
+    assert pred == real, (label, pred, real)
+    return pred
+
+
+def main():
+    obs.enable()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2000, 16)).astype(np.float32)
+    Q = rng.standard_normal((8, 16)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=16,
+                                                   list_size_cap=0))
+    _exact(idx, "ivf_flat")
+
+    mem0 = int(obs_memory.sample("smoke.start")["bytes_in_use"])
+    store = serving.PagedListStore.from_index(idx, page_rows=32)
+    serving.search(store, Q, K, n_probes=NPROBE)  # warm: the legal trace
+    pred_store = _exact(store, "paged_store")
+
+    # --- one forced growth retrace, attributed --------------------------
+    t0 = serving.scan_trace_count()
+    u0 = obs_compile.unexplained_retraces()
+    n0 = len(obs_compile.ledger(entry="ivf_flat.paged_scan"))
+    g0 = store.growth_events
+    nid = 10_000_000
+    while store.growth_events == g0:  # force a capacity growth
+        vecs = rng.standard_normal((256, 16)).astype(np.float32)
+        store.upsert(vecs, np.arange(nid, nid + 256))
+        nid += 256
+    serving.search(store, Q, K, n_probes=NPROBE)  # pays the one retrace
+    retraces = serving.scan_trace_count() - t0
+    assert retraces == 1, f"expected exactly one growth retrace, got {retraces}"
+    new = obs_compile.ledger(entry="ivf_flat.paged_scan")[n0:]
+    assert len(new) == 1 and new[0]["changed"], new
+    grown = {c["operand"] for c in new[0]["changed"]}
+    assert grown & {"pages", "page_ids", "page_aux", "table"}, new[0]
+    assert obs_compile.unexplained_retraces() - u0 == 0, \
+        "growth retrace left an unexplained residue"
+
+    # --- static HBM prediction within 25% of the measured watermark -----
+    predicted = mem0 + costmodel.predict_index_bytes(
+        **costmodel.index_layout(store))
+    measured = int(obs_memory.sample("smoke.end")["bytes_in_use"])
+    ratio = predicted / measured
+    assert 0.75 <= ratio <= 1.25, \
+        f"predicted {predicted} vs measured {measured} (ratio {ratio:.3f})"
+
+    # --- admission: queue hook + budget-squeeze verdicts ----------------
+    sampler = obs_shadow.ShadowSampler(
+        lambda q: serving.search(store, q, K, n_probes=store.n_lists),
+        k=K, rate=0.5, seed=3, max_pending=256)
+    engine = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(0.5, sampler=sampler))
+    queue = serving.QueryQueue(
+        serving.searcher(store, K, n_probes=NPROBE),
+        slo_s=0.5, max_batch=8, shadow=sampler,
+        cost_model=costmodel.paged_scan_estimator(store, K, NPROBE))
+    handles = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+               for _ in range(N_REQ)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain(timeout_s=30.0)
+    assert all(h.verdict == "ok" for h in handles), \
+        [h.verdict for h in handles]
+    counters = obs.snapshot()["counters"]
+    admits = counters.get("costmodel.admission.admit", 0)
+    assert admits >= 1, counters
+
+    est = costmodel.estimate_search(store, q=8, k=K, n_probes=NPROBE)
+    squeezed = costmodel.check_admission(
+        est, entry="smoke.squeeze", budget_bytes=est["transient_bytes"])
+    assert squeezed["verdict"] == costmodel.REJECT, squeezed
+    roomy = costmodel.check_admission(
+        est, entry="smoke.roomy",
+        budget_bytes=(measured + est["transient_bytes"]) * 100)
+    assert roomy["verdict"] == costmodel.ADMIT, roomy
+
+    # --- unified report: compile section + CLI validation ----------------
+    report = obs_report.collect(engine=engine, sampler=sampler, queue=queue)
+    comp = report["compile"]
+    assert comp["unexplained_retraces"] == 0, comp
+    assert comp["entries"].get("ivf_flat.paged_scan", 0) >= 2, comp
+    problems = obs_report.validate(report)
+    assert not problems, problems
+    path = os.path.join(tempfile.mkdtemp(), "costmodel_smoke.jsonl")
+    obs_report.export(path, report)
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.report", path, "--validate"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rendered = json.loads(proc.stdout)
+    assert rendered["compile"]["unexplained_retraces"] == 0, \
+        rendered["compile"]
+
+    print("costmodel smoke: OK (store bytes exact=%d; growth retrace "
+          "attributed to %s in %.0f ms; prediction ratio %.3f; "
+          "admission admits=%d squeeze=%s)"
+          % (pred_store, sorted(grown), (new[0].get("wall_s") or 0) * 1e3,
+             ratio, admits, squeezed["verdict"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -378,6 +378,41 @@ def latency_slo(name):
     return name
 """,
     ),
+    # ISSUE 11 extension: the dispatch cost model's entry points
+    # (obs/costmodel.py, and obs/compile.py's summary) are the item-4
+    # admission controller's inputs — as observable as what they observe
+    (
+        "obs-coverage",
+        "raft_tpu/obs/costmodel.py",
+        """
+def estimate(entry, **shapes):
+    return {"entry": entry}
+""",
+        # near-miss: span-covered entry points + exempt helpers (an
+        # estimator closure builder and a layout extractor are not
+        # entry-point names)
+        """
+from raft_tpu import obs
+
+def estimate(entry, **shapes):
+    with obs.record_span("obs.costmodel::estimate"):
+        return {"entry": entry}
+
+def check_admission(predicted, entry=""):
+    with obs.record_span("obs.costmodel::check_admission"):
+        return {"verdict": "admit"}
+
+def predict_index_bytes(kind, **layout):
+    with obs.record_span("obs.costmodel::predict_index_bytes"):
+        return 0
+
+def index_layout(index):
+    return {}
+
+def paged_scan_estimator(store, k, n_probes):
+    return lambda batch: 0
+""",
+    ),
     # ISSUE 10 extension: shadow-sampler (and the rest of obs/) exception
     # paths must route through resilience.classify — a swallowed shadow
     # failure would leave the recall estimate silently stale-free

@@ -454,6 +454,34 @@ def test_memory_sample_cpu_fallback_nonzero(telemetry):
     assert g["value"] == out["bytes_in_use"] > 0
 
 
+def test_live_bytes_dedups_aliased_buffers(monkeypatch):
+    """Round-11 audit regression: ``jax.live_arrays()`` can return several
+    Array objects over ONE device buffer (no-copy device_put, donation
+    aliasing) — the fallback watermark must count the buffer once, keyed
+    by ``unsafe_buffer_pointer`` (or object identity where the runtime
+    withholds a pointer)."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.ones((256, 8), jnp.float32)
+    b = jnp.ones((64,), jnp.float32)
+    # the same array object handed back twice = one buffer, aliased
+    monkeypatch.setattr(jax, "live_arrays", lambda: [a, a, b, b, a])
+    assert obs_memory.live_bytes() == a.nbytes + b.nbytes
+
+    class NoPointer:
+        """Array-shaped object that refuses unsafe_buffer_pointer (the
+        sharded-array case): identity fallback still dedups repeats."""
+        nbytes = 128
+
+        def unsafe_buffer_pointer(self):
+            raise RuntimeError("multi-shard array has no single buffer")
+
+    c = NoPointer()
+    monkeypatch.setattr(jax, "live_arrays", lambda: [c, c, a])
+    assert obs_memory.live_bytes() == 128 + a.nbytes
+
+
 def test_memory_index_bytes(served_store, rng):
     from raft_tpu.neighbors import ivf_flat as _flat
 
