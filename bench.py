@@ -189,6 +189,19 @@ def _time_qps(run, queries, reps: int, hist: str = "") -> float:
     return queries.shape[0] / dt
 
 
+def section_error(e):
+    """Classified section-failure stamp (ISSUE 3): every section guard
+    routes through resilience.classify so the failure CLASS survives into
+    the metric line and the obs counters, not just repr(e). Lazy imports:
+    bench.py's parent mode must stay off the raft_tpu/jax import lock, so
+    this only runs inside the measuring child."""
+    from raft_tpu import obs, resilience
+
+    kind = resilience.classify(e)
+    obs.add(f"bench.section_error.{kind}")
+    return {"error": repr(e)[:300], "kind": kind}
+
+
 def _sections_filter():
     """RAFT_TPU_BENCH_SECTIONS="ivf_flat,cagra" → the enabled subset; None
     means everything. brute_force ignores this (it is the gt anchor)."""
@@ -220,6 +233,7 @@ def run_suite():
                                     ivf_pq, refine)
     from raft_tpu.obs import costmodel as obs_costmodel
     from raft_tpu.obs import memory as obs_memory
+    from raft_tpu.obs import roofline as obs_roofline
 
     # telemetry ON for the whole measured child (round-8): the bench window
     # exists to answer where the time went, so spans/counters/latency
@@ -240,13 +254,6 @@ def run_suite():
         h = obs.snapshot()["histograms"].get(hist_name) or {}
         return {k: h[k] for k in ("p50_ub", "p90_ub", "p99_ub") if k in h}
 
-    def section_error(e):
-        """Classified section-failure stamp (ISSUE 3): every section guard
-        routes through resilience.classify so the failure CLASS survives
-        into the metric line and the obs counters, not just repr(e)."""
-        kind = resilience.classify(e)
-        obs.add(f"bench.section_error.{kind}")
-        return {"error": repr(e)[:300], "kind": kind}
 
     on_cpu = jax.devices()[0].platform == "cpu"
     tiny = bool(os.environ.get("RAFT_TPU_BENCH_TINY"))
@@ -417,6 +424,59 @@ def run_suite():
         if row["measured_watermark_bytes"]:
             row["hbm_predicted_to_measured"] = round(
                 pred / row["measured_watermark_bytes"], 3)
+        stamp_roofline(row, name, index, row.get("k_fetch", K), n_probes)
+
+    def stamp_roofline(row, name, index, k_fetch, n_probes):
+        """Roofline stamp for one section (ISSUE 12 acceptance: every
+        section that stamps ``predicted_index_bytes`` also stamps
+        ``mxu_utilization`` / ``bound`` / ``padded_fraction`` /
+        ``achieved_gflops``). ``measured_s`` is the MIN of the section's
+        per-batch latency histogram — the cleanest forced-completion
+        batch; it includes refine + dispatch overhead, so the stamped
+        utilization is END-TO-END (a floor on kernel utilization, which
+        is the honest per-config efficiency record). ``bound`` is the
+        static roofline verdict; on platforms off the peak table it
+        reads ``unknown`` and ``peaks_source`` says why."""
+        try:
+            h = obs.snapshot()["histograms"].get(
+                f"bench.{name}.batch_latency_s") or {}
+            measured = h.get("min") if h.get("count") else None
+            # occupancy rides the dispatch note the search itself made
+            # (telemetry is on suite-wide); storage padding from the
+            # host-cached lens is the fallback when no kernel planning ran
+            rec = (obs_roofline.entries().get(f"{name}.search") or {})
+            occ = rec.get("occupancy")
+            util = obs_roofline.utilization_search(
+                index, q=Q, k=int(k_fetch), n_probes=n_probes,
+                measured_s=measured, occupancy=occ)
+            row["flops_per_batch"] = util["flops"]
+            row["bytes_per_batch"] = util["bytes"]
+            row["bound"] = util["bound"]
+            row["peaks_source"] = util["peaks_source"]
+            for key in ("achieved_gflops", "mxu_utilization",
+                        "hbm_bw_utilization", "model_to_measured"):
+                if util.get(key) is not None:
+                    row[key] = util[key]
+            # ONE meaning for padded_fraction across backends and rounds
+            # (bench_compare diffs it directionally — a semantics flip
+            # between kernel-relative and storage-relative numbers would
+            # fake a regression): always the STORAGE padding of the
+            # capacity-padded lists. The kernel planner's scan-relative
+            # fraction (pow2 fetch blocks, only where kernel planning
+            # ran) rides separately as scan_padded_fraction.
+            import numpy as _np
+
+            lens = getattr(index, "_lens_np_cache", None)
+            if lens is None:
+                lens = _np.asarray(index.list_sizes())
+            cap = index.n_lists * index.max_list_size
+            row["padded_fraction"] = round(
+                max(0.0, 1.0 - float(lens.sum()) / cap), 4) if cap else 0.0
+            if occ and "padded_row_fraction" in occ:
+                row["scan_padded_fraction"] = occ["padded_row_fraction"]
+        except Exception as e:
+            # a broken stamp must not cost the section's numbers
+            row["roofline_error"] = section_error(e)
 
     def timed_build(build):
         """(index, cold_s, warm_s): cold includes XLA compiles (cached on
@@ -1029,6 +1089,7 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     from raft_tpu.obs import costmodel as obs_costmodel
     from raft_tpu.obs import memory as obs_memory
     from raft_tpu.obs import report as obs_report
+    from raft_tpu.obs import roofline as obs_roofline
     from raft_tpu.obs import shadow as obs_shadow
     from raft_tpu.obs import slo as obs_slo
 
@@ -1213,6 +1274,34 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     # retraces ship with their diffs in the obs_report compile section
     out["unexplained_retraces"] = \
         obs_compile.unexplained_retraces() - unexplained0
+    # roofline stamp (ISSUE 12): the paged gather scan at the full batch
+    # bucket against platform peaks — measured by the forced full-batch
+    # dispatch (lat_full), end-to-end like the section stamps. The
+    # padded fraction is the capacity-padded chain waste every probe
+    # pays (table_width × page_rows slots vs live rows) — the number
+    # ROADMAP item 2's paged-Pallas merge would shrink.
+    try:
+        st = store.stats()
+        chain_slots = store.n_lists * st["table_width"] * st["page_rows"]
+        occ = {"padded_row_fraction": round(
+            max(0.0, 1.0 - st["rows"] / chain_slots), 4)
+            if chain_slots else 0.0,
+            "fill_fraction": round(st["fill_fraction"], 4)}
+        util = obs_roofline.utilization_search(
+            store, q=max_batch, k=k, n_probes=nprobe,
+            measured_s=lat_full, occupancy=occ)
+        out["flops_per_batch"] = util["flops"]
+        out["bytes_per_batch"] = util["bytes"]
+        out["bound"] = util["bound"]
+        out["peaks_source"] = util["peaks_source"]
+        out["padded_fraction"] = occ["padded_row_fraction"]
+        for key in ("achieved_gflops", "mxu_utilization",
+                    "hbm_bw_utilization", "model_to_measured"):
+            if util.get(key) is not None:
+                out[key] = util[key]
+    except Exception as e:
+        # same classified stamp + counter every section guard uses
+        out["roofline_error"] = section_error(e)
     out["loads"] = loads
     out["slo_ms"] = round(slo_s * 1e3, 3)
     # headline comparison: best dynamic throughput among loads whose p99

@@ -24,6 +24,11 @@ ISSUE 11 extends it again to the dispatch cost model and compile ledger
 ``check_admission`` / ``predict_index_bytes`` / ``summary`` are the
 item-4 admission controller's inputs and must be as observable as what
 they observe (``trace_event`` stays exempt — it runs at jit trace time).
+ISSUE 12 adds the roofline plane (``obs/roofline.py``):
+``estimate_flops`` / ``utilization`` / ``summary`` feed the per-config
+efficiency record the autotuner frontier fit consumes, so they are
+span-covered too (``note_dispatch`` stays exempt — it sits on the hot
+path behind the callers' own ``obs.enabled()`` gate).
 """
 
 from __future__ import annotations
@@ -43,10 +48,11 @@ _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 #: helper modules (aggregate, tracing) keep their non-span shape.
 #: ``trace_event`` is deliberately NOT an entry name — it runs at jit
 #: TRACE time, where opening a span would record tracing as work.
-_OBS_FILES = {"slo.py", "report.py", "costmodel.py", "compile.py"}
+_OBS_FILES = {"slo.py", "report.py", "costmodel.py", "compile.py",
+              "roofline.py"}
 _OBS_ENTRY_NAMES = {"sample", "evaluate", "collect", "render",
                     "estimate", "check_admission", "predict_index_bytes",
-                    "summary"}
+                    "summary", "estimate_flops", "utilization"}
 
 
 def _is_entry_name(name: str) -> bool:

@@ -25,6 +25,7 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
@@ -211,6 +212,12 @@ def search(
         obs.add("brute_force.search.queries", q_obs)
         obs.add("brute_force.search.rows_scanned", q_obs * n)
         obs.add("brute_force.search.tiles", ceil_div(n, int(tile_rows)))
+        # roofline note (round 15): the exact scan is the plane's
+        # calibration anchor — one dense gemm, no padding waste
+        obs_roofline.note_dispatch(
+            "brute_force.search",
+            {"q": q_obs, "n": n, "dim": index.dim, "k": int(k),
+             "dtype": str(index.dataset.dtype)})
     from raft_tpu.resilience import degrade_on_oom, faultpoint
 
     def attempt(tr):

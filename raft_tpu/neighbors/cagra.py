@@ -1243,6 +1243,21 @@ def search(
         obs.add("cagra.search.tiles", n_tiles)
         obs.add("cagra.search.iterations", nq * max_iter)
         obs.add(f"cagra.search.traversal.{mode}", 1)
+        if mode == "fused":
+            # roofline note (round 15): the fused hop's static FLOP/byte
+            # model + the q-block occupancy stats — the "does the kernel
+            # underfill the MXU" number the ROADMAP has been guessing at
+            from raft_tpu.obs import roofline as obs_roofline
+            from raft_tpu.ops.cagra_hop import occupancy_stats
+
+            obs_roofline.note_dispatch(
+                "cagra.fused_hop",
+                {"q": q_tile, "width": width,
+                 "degree": index.graph_degree, "proj_dim": p,
+                 "itopk": itopk, "hops": _CAGRA_HOP_CHUNK},
+                occupancy=occupancy_stats(
+                    min(nq, q_tile), q_block, width, index.graph_degree,
+                    p, itopk))
 
     from raft_tpu import resilience
     from raft_tpu.core.interruptible import check_interrupt

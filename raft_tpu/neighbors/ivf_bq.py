@@ -51,6 +51,7 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.trace import traced
@@ -507,6 +508,30 @@ def search(
         obs.add(f"ivf_bq.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        # roofline note (round 15): packed-scan FLOP/byte model + strip
+        # occupancy at the scan's real planning width (rot_dim) when the
+        # host already caches per-list lengths (no forced device sync)
+        occ = None
+        lens_cached = getattr(index, "_lens_np_cache", None)
+        if lens_cached is not None \
+                and lens_cached.shape[0] == index.n_lists:
+            from raft_tpu.ops.bq_scan import occupancy_stats
+            kf_occ = min(int(k), 512)
+            occ = obs_roofline.memo_occupancy(
+                index,
+                (id(lens_cached), q_obs, int(n_probes), kf_occ,
+                 res.workspace_bytes),
+                lambda: occupancy_stats(
+                    lens_cached, index.max_list_size, q_obs, n_probes,
+                    rot_dim=index.rot_dim,
+                    workspace_bytes=res.workspace_bytes, kf=kf_occ))
+        obs_roofline.note_dispatch(
+            "ivf_bq.search",
+            {"q": q_obs, "dim": index.dim, "n_lists": index.n_lists,
+             "max_list_size": index.max_list_size,
+             "n_probes": int(n_probes), "k": int(k),
+             "rot_dim": index.rot_dim},
+            occupancy=occ)
     from raft_tpu import resilience
     from raft_tpu.neighbors.ivf_flat import _ragged_plan_static
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from raft_tpu import obs
 from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -590,6 +591,31 @@ def search(
         obs.add(f"ivf_flat.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        # roofline note (round 15): static FLOP/byte model of this
+        # dispatch, plus the strip planner's occupancy stats when the
+        # host already holds the per-list lengths (the ragged path's
+        # cache — telemetry must never force a device sync to get them)
+        occ = None
+        lens_cached = getattr(index, "_lens_np_cache", None)
+        if backend == "ragged" and lens_cached is not None \
+                and lens_cached.shape[0] == index.n_lists:
+            from raft_tpu.ops.strip_scan import occupancy_stats
+            kf_occ = min(int(k), 512)
+            occ = obs_roofline.memo_occupancy(
+                index,
+                (id(lens_cached), q_obs, int(n_probes), kf_occ,
+                 res.workspace_bytes),
+                lambda: occupancy_stats(
+                    lens_cached, index.max_list_size, q_obs, n_probes,
+                    dim=index.dim, workspace_bytes=res.workspace_bytes,
+                    kf=kf_occ))
+        obs_roofline.note_dispatch(
+            "ivf_flat.search",
+            {"q": q_obs, "dim": index.dim, "n_lists": index.n_lists,
+             "max_list_size": index.max_list_size,
+             "n_probes": int(n_probes), "k": int(k),
+             "dtype": str(index.list_data.dtype)},
+            occupancy=occ)
     from raft_tpu.resilience import faultpoint
 
     faultpoint("ivf_flat.search.scan")
@@ -745,6 +771,15 @@ def search_paged(
         obs.add("ivf_flat.search_paged.probes", q_obs * n_probes)
         scan_attrs = {"queries": q_obs, "probes": int(n_probes),
                       "k": int(k), "table_width": width}
+        # roofline note (round 15): the gather scan's per-(query, probe)
+        # capacity-padded chain cost — no cross-query sharing, which is
+        # exactly what this model makes visible vs the packed kernel
+        obs_roofline.note_dispatch(
+            "ivf_flat.paged_scan",
+            {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+             "page_rows": store.page_rows, "table_width": width,
+             "n_probes": int(n_probes), "k": int(k),
+             "dtype": str(pages.dtype)})
     # the (qt, p, W, R, d) page gather is the big intermediate
     per_query = max(1, n_probes * width * store.page_rows * (store.dim + 2) * 4)
     q_tile = int(max(1, min(queries.shape[0],

@@ -45,6 +45,7 @@ from jax import lax
 
 from raft_tpu import obs
 from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.neighbors import _packing
@@ -1530,6 +1531,32 @@ def search(
         obs.add(f"ivf_pq.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        # roofline note (round 15): static FLOP/byte model + strip
+        # occupancy when the host already caches per-list lengths (the
+        # ragged path; telemetry must never force a device sync)
+        rot_dim_obs = int(index.rotation.shape[0])
+        occ = None
+        lens_cached = getattr(index, "_lens_np_cache", None)
+        if backend == "ragged" and lens_cached is not None \
+                and lens_cached.shape[0] == index.n_lists:
+            from raft_tpu.ops.strip_scan import occupancy_stats
+            kf_occ = min(int(k), 512)
+            occ = obs_roofline.memo_occupancy(
+                index,
+                (id(lens_cached), q_obs, int(n_probes), kf_occ,
+                 res.workspace_bytes),
+                lambda: occupancy_stats(
+                    lens_cached, index.max_list_size, q_obs, n_probes,
+                    dim=rot_dim_obs, workspace_bytes=res.workspace_bytes,
+                    kf=kf_occ))
+        obs_roofline.note_dispatch(
+            "ivf_pq.search",
+            {"q": q_obs, "dim": index.dim, "n_lists": index.n_lists,
+             "max_list_size": index.max_list_size,
+             "pq_dim": index.pq_dim, "pq_bits": index.pq_bits,
+             "n_probes": int(n_probes), "k": int(k),
+             "rot_dim": rot_dim_obs},
+            occupancy=occ)
     from raft_tpu.resilience import faultpoint
 
     faultpoint("ivf_pq.search.scan")
@@ -1768,6 +1795,15 @@ def search_paged(
         obs.add("ivf_pq.search_paged.probes", q_obs * n_probes)
         scan_attrs = {"queries": q_obs, "probes": int(n_probes),
                       "k": int(k), "table_width": width}
+        # roofline note (round 15): LUT-scan cost over the capacity-padded
+        # page chains (no cross-query sharing on the gather path)
+        obs_roofline.note_dispatch(
+            "ivf_pq.paged_scan",
+            {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+             "page_rows": store.page_rows, "table_width": width,
+             "pq_dim": store.pq_dim, "pq_bits": store.pq_bits,
+             "n_probes": int(n_probes), "k": int(k),
+             "rot_dim": int(store.rotation.shape[0])})
     # the (qt, p, W, R, s) unpacked-code gather dominates the working set
     per_query = max(1, n_probes * width * store.page_rows
                     * (store.pq_dim * 5 + 8))

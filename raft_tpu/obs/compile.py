@@ -313,7 +313,9 @@ def summary(recent: int = 5) -> dict:
     included) — what ``obs.report.collect`` folds in, so a status snapshot
     answers "did anything retrace, and why" directly."""
     with record_span("obs.compile::summary"), _LOCK:
-        recs = list(_LEDGER)[-max(0, int(recent)):]
+        # recent <= 0 means NO records ([-0:] would invert to ALL of them)
+        recent = int(recent)
+        recs = list(_LEDGER)[-recent:] if recent > 0 else []
         return {
             "total_traces": sum(_COUNTS.values()),
             "entries": dict(_COUNTS),

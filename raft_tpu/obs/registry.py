@@ -36,6 +36,7 @@ from typing import Optional
 from raft_tpu.obs import tracing as _tracing
 
 __all__ = [
+    "DISPATCH_HIST_PREFIX",
     "EXEMPLAR_CAP",
     "MetricsRegistry",
     "NOOP_SPAN",
@@ -48,6 +49,7 @@ __all__ = [
     "observe",
     "record_span",
     "record_timing",
+    "register_dispatch_span",
     "registry",
     "reset",
     "set_gauge",
@@ -58,6 +60,25 @@ __all__ = [
 #: percentile bucket of a live latency histogram to a recent trace id
 #: without growing the snapshot unboundedly
 EXEMPLAR_CAP = 8
+
+#: histogram namespace for sync-mode committed span durations (round 15):
+#: ``dispatch.<span name>`` — the per-entry device-time fold obs/roofline
+#: pairs with its static FLOP/byte model (the ONE definition; roofline
+#: reads histograms back through it)
+DISPATCH_HIST_PREFIX = "dispatch."
+
+#: spans whose sync-mode committed durations are worth a dispatch
+#: histogram — ONLY registered device-dispatch spans fold (obs/roofline
+#: registers its entry spans at import). Folding every span would double
+#: histogram cardinality and label host-only telemetry spans as device
+#: dispatches.
+_DISPATCH_SPANS: set = set()
+
+
+def register_dispatch_span(name: str) -> None:
+    """Opt a span name into the sync-mode ``dispatch.*`` histogram fold
+    (obs/roofline does this for every entry it models)."""
+    _DISPATCH_SPANS.add(name)
 
 _enabled = os.environ.get("RAFT_TPU_OBS", "").strip().lower() in (
     "1", "true", "on", "yes",
@@ -385,6 +406,16 @@ class _Span:
             error = _classify_error(exc)
             self._reg.add(f"span.errors.{error}")
         self._reg.record_timing(self._name, dt)
+        if dispatch_s is not None and self._name in _DISPATCH_SPANS:
+            # sync-mode device-time attribution (round 15): fold the
+            # COMMITTED duration into a per-entry histogram — until now
+            # it lived only as a span attr, so nothing could aggregate
+            # measured device time per dispatch entry. Exemplar-linked to
+            # this span's trace (the request-latency convention), so a
+            # percentile bucket dereferences to a concrete dispatch.
+            # Registered dispatch spans only (see _DISPATCH_SPANS).
+            self._reg.observe(f"{DISPATCH_HIST_PREFIX}{self._name}", dt,
+                              trace_id=self._ids[0])
         _tracing.exit_span(self._ids, self._token, name=self._name,
                            t0=self._t0_epoch, dur_s=dt, attrs=self._attrs,
                            error=error, dispatch_s=dispatch_s)

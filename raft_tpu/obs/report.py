@@ -58,6 +58,15 @@ def _admission_counts(counters: dict) -> dict:
     return costmodel.admission_counts(counters)
 
 
+def _roofline_summary(snapshot: dict) -> dict:
+    """The roofline plane's report section (round 15; lazy import like the
+    rest of the SLO plane): per-entry static FLOP/byte model + measured
+    fold + occupancy, against the platform peak table."""
+    from raft_tpu.obs import roofline
+
+    return roofline.summary(snapshot=snapshot)
+
+
 def _classified(fn, label: str, out_errors: dict):
     """Run one provider; a failure degrades its section to None and lands
     classified in ``errors`` — a status report must report, not raise."""
@@ -111,6 +120,11 @@ def collect(engine=None, sampler=None, queue=None,
             # controller consumes
             "admission": _classified(
                 lambda: _admission_counts(counters), "admission", errors),
+            # roofline plane (round 15): per-dispatch FLOP/byte model vs
+            # platform peaks + sync-mode measured durations — "is the
+            # hardware actually being used" straight from the snapshot
+            "roofline": _classified(
+                lambda: _roofline_summary(snap), "roofline", errors),
             "shard_health": _classified(
                 lambda: resilience.shard_health().snapshot(),
                 "shard_health", errors),
@@ -200,6 +214,35 @@ def validate(report: dict,
         problems.append(
             f"{comp['unexplained_retraces']} unexplained retrace(s) "
             f"in the compile ledger")
+    # roofline plane (round 15): every noted entry must carry a finite
+    # positive byte model, a sane bound verdict, and FLOPs consistent
+    # with its own intensity; peaks must state their provenance (a
+    # made-up denominator is worse than an unknown one). Lenient on
+    # absence (pre-round-15 report streams have no section).
+    roof = report.get("roofline")
+    if isinstance(roof, dict):
+        peaks = roof.get("peaks") or {}
+        if peaks.get("source") not in ("env", "table", "unknown"):
+            problems.append(
+                f"roofline peaks carry no provenance: {peaks!r}")
+        for name, row in (roof.get("entries") or {}).items():
+            if not isinstance(row, dict):
+                problems.append(f"roofline[{name}] is not a record")
+                continue
+            if not (_finite(row.get("flops")) and row["flops"] >= 0):
+                problems.append(f"roofline[{name}].flops not finite: "
+                                f"{row.get('flops')!r}")
+            if not (_finite(row.get("bytes")) and row["bytes"] > 0):
+                problems.append(f"roofline[{name}].bytes not positive: "
+                                f"{row.get('bytes')!r}")
+            if row.get("bound") not in ("compute", "memory", "unknown"):
+                problems.append(f"roofline[{name}].bound invalid: "
+                                f"{row.get('bound')!r}")
+            if peaks.get("source") == "unknown" and \
+                    row.get("bound") != "unknown":
+                problems.append(
+                    f"roofline[{name}] claims bound={row['bound']!r} "
+                    f"with unknown peaks")
     return problems
 
 

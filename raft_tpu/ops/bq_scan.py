@@ -320,6 +320,19 @@ def bq_strip_search_traced(queries_rot, probes, list_codes, scale, bias,
     return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
 
 
+def occupancy_stats(lens, m: int, q: int, p: int, rot_dim: int,
+                    workspace_bytes: int = 1 << 30, kf: int = 10) -> dict:
+    """Static occupancy diagnostics of one packed-scan dispatch: the strip
+    planner's numbers (:func:`strip_scan.occupancy_stats`) at the scan's
+    REAL planning width (the bf16 unpacked block is ``rot_dim`` wide —
+    the width ivf_bq's ``_ragged_plan_static`` plans with), plus the
+    packed-code byte width the DMAs actually move."""
+    out = ss.occupancy_stats(lens, m, q, p, dim=rot_dim,
+                             workspace_bytes=workspace_bytes, kf=kf)
+    out["code_bytes_per_entry"] = packed_width(rot_dim)
+    return out
+
+
 def bq_dense_scan(queries_rot, probes, list_codes, scale, bias, list_ids,
                   k: int, alpha: float, pair_const=None):
     """Jittable dense packed scan — the distributed layer's off-TPU / small-

@@ -207,6 +207,33 @@ def fused_hop(buf_ids, buf_d, buf_vis, parents, qp, graph, nbr_codes,
     )(parents, parents, qp, buf_ids, buf_d, buf_vis, graph, nbr_codes)
 
 
+def occupancy_stats(q: int, q_block: int, width: int, degree: int,
+                    proj_dim: int, itopk: int) -> dict:
+    """Static occupancy diagnostics of one fused-hop dispatch (round 15:
+    the "does the fused hop underfill the MXU" question as numbers).
+    ``q`` is the REAL query count; the caller pads to a ``q_block``
+    multiple, and the padded rows ride every hop with ids=-1/vis=1 —
+    pure overhead the grid still executes. ``block`` is the per-grid-step
+    distance contraction shape (q_block × width·degree × proj_dim);
+    ``mxu_m_fill`` is how much of the 128-row MXU M-tile the q_block
+    occupies — the knob ``RAFT_TPU_CAGRA_QBLOCK`` re-tuning moves."""
+    q_block = max(1, int(q_block))
+    q_pad = -(-int(q) // q_block) * q_block
+    b = int(width) * int(degree)
+    return {
+        "grid": [int(q_pad // q_block)],
+        "q": int(q),
+        "q_pad": int(q_pad),
+        "q_block": int(q_block),
+        "padded_row_fraction": round(1.0 - q / q_pad, 4) if q_pad else 0.0,
+        "tile_fill": round(q / q_pad, 4) if q_pad else 0.0,
+        "block": [int(q_block), b, int(proj_dim)],
+        "candidates_per_query": b,
+        "merge_width": int(itopk) + b,
+        "mxu_m_fill": round(min(1.0, q_block / 128.0), 4),
+    }
+
+
 def fused_hop_reference(buf_ids, buf_d, buf_vis, parents, qp, graph,
                         nbr_codes) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pure-jnp oracle with the exact fused_hop contract (kernel tests):

@@ -762,6 +762,66 @@ def strip_search_traced(queries_mat, probes, list_data, bias, list_ids,
     return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
 
 
+def occupancy_stats(lens, m: int, q: int, p: int, dim: int = 0,
+                    workspace_bytes: int = 1 << 30, kf: int = 10) -> dict:
+    """Static occupancy diagnostics of one strip-scan dispatch, from the
+    SAME planning code the dispatch uses (class_info / fit_q_tile /
+    static_layout) — "the kernel underfills the MXU" as numbers, not a
+    hunch (obs/roofline, round 15):
+
+    * ``grid`` — per length-class ``[padded_strips, n_sub, w_blocks]``
+      (the compiled kernel grids);
+    * ``padded_strip_fraction`` — static-layout padding strips over the
+      padded total, with the REAL strip count taken at the planner's
+      best case (full ``C``-slot packing, ``ceil(q·p / C)`` — the bench
+      regime; skewed probe distributions only add real strips, so this
+      is the floor of the padding, not an estimate of it);
+    * ``tile_fill`` — real (query, probe) pairs over the slots those
+      best-case strips provide (how full the MXU M-dimension runs);
+    * ``padded_row_fraction`` — scan-relative row padding: real entries
+      over the pow2-block-padded widths the kernel actually fetches per
+      list (every probed pair pays its list's padded width);
+    * ``storage_padded_fraction`` — index-relative padding against the
+      global ``m``-wide list storage (what residency pays).
+
+    ``lens`` are per-list REAL entry counts, ``m`` the padded list width,
+    ``(q, p)`` the dispatch's query/probe shape. Pure numpy."""
+    lens_np = np.maximum(np.asarray(lens, np.int64), 0)
+    n_lists = int(lens_np.shape[0])
+    classes, cls_ord = class_info(lens_np, dim=dim)
+    class_counts = class_counts_of(cls_ord, len(classes))
+    q_tile = fit_q_tile(q, p, n_lists, len(classes), kf, workspace_bytes,
+                        dim=dim, class_counts=class_counts)
+    qt = min(q_tile, q)
+    tiles = _ceil_div(q, qt) if qt else 0
+    _, s_tot, layout = static_layout(classes, class_counts, qt, p)
+    strips_best = _ceil_div(qt * p, C)
+    n_mc = np.maximum(_ceil_div(lens_np, MC), 1)
+    scanned = (1 << np.ceil(np.log2(n_mc)).astype(np.int64)) * MC
+    real_rows = int(lens_np.sum())
+    scanned_sum = int(scanned.sum())
+    return {
+        "grid": [[int(cnt), int(n_sub), int(w_blocks)]
+                 for (w_blocks, n_sub, _start, cnt) in layout],
+        "strips_padded": int(s_tot),
+        "strips_real_bestcase": int(strips_best),
+        "padded_strip_fraction": round(
+            max(0.0, 1.0 - strips_best / s_tot), 4) if s_tot else 0.0,
+        "tile_fill": round(min(1.0, qt * p / (strips_best * C)), 4)
+        if strips_best else 0.0,
+        "padded_row_fraction": round(
+            max(0.0, 1.0 - real_rows / scanned_sum), 4)
+        if scanned_sum else 0.0,
+        "storage_padded_fraction": round(
+            max(0.0, 1.0 - real_rows / (n_lists * m)), 4)
+        if n_lists * m else 0.0,
+        "q_tile": int(qt),
+        "tiles": int(tiles),
+        "c": C,
+        "mc": MC,
+    }
+
+
 def strip_search(
     queries_mat,
     probes,

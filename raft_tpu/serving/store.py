@@ -510,6 +510,16 @@ class PagedListStore:
             obs.add("serving.store.upserts", n)
             if replaced:
                 obs.add("serving.store.replaced", replaced)
+            # roofline note (round 15): the scatter is pure data movement
+            # (flops=0 → memory-bound by construction); the model prices
+            # the pow2 bucket the dispatch actually pays
+            from raft_tpu.obs import roofline as obs_roofline
+
+            obs_roofline.note_dispatch(
+                "serving.scatter",
+                {"n_rows": n, "dim": self.dim,
+                 "payload_width": int(self.pages.shape[2]),
+                 "payload_dtype": str(self.pages.dtype)})
         return {"upserts": n, "replaced": replaced, "growths": growths}
 
     def _append(self, payload, ids_np, aux, labels_np) -> None:

@@ -99,6 +99,13 @@ def load_run(path):
     return label, None, "unrecognized JSON shape"
 
 
+#: string-valued extras worth a (purely informational) row: the roofline
+#: bound verdict and its peak provenance — a compute→memory flip is a
+#: real signal worth seeing in the delta table, but never a "regression"
+#: (round 15; numbers still carry all the gating)
+_STRING_METRIC_TAILS = {"bound", "peaks_source"}
+
+
 def _flatten(prefix, obj, out):
     for key, val in obj.items():
         name = f"{prefix}.{key}" if prefix else str(key)
@@ -108,6 +115,8 @@ def _flatten(prefix, obj, out):
             out[name] = 1.0 if val else 0.0
         elif isinstance(val, (int, float)):
             out[name] = float(val)
+        elif isinstance(val, str) and key in _STRING_METRIC_TAILS:
+            out[name] = val
     return out
 
 
@@ -180,6 +189,17 @@ def direction(metric: str) -> str:
         return "one"
     if tail == "unexplained_retraces":
         return "down"
+    # roofline plane (round 15): utilizations and achieved throughput
+    # grow toward good (model_to_measured = bound/measured ≤ 1, bigger =
+    # closer to the roofline); padding fractions shrink toward good;
+    # `bound` flips are handled as string info rows, never regressions
+    if tail in ("mxu_utilization", "hbm_bw_utilization",
+                "achieved_gflops", "model_to_measured", "tile_fill"):
+        return "up"
+    if tail.endswith("padded_fraction") or \
+            tail.endswith("padded_row_fraction") or \
+            tail.endswith("padded_strip_fraction"):
+        return "down"
     if "qps" in tail or tail in ("value", "vs_baseline", "recall",
                                  "recall_gate_met", "ann_beats_brute",
                                  "per_chip_measured", "per_chip_recall"):
@@ -225,6 +245,12 @@ def compare(a: dict, b: dict, threshold: float, per_metric: dict):
         if vb is None:
             rows.append((metric, va, None, None, "gone"))
             continue
+        if isinstance(va, str) or isinstance(vb, str):
+            # string metric (roofline `bound` verdicts): a flip is
+            # information worth a row, never a regression — the numeric
+            # utilizations around it carry the gating
+            rows.append((metric, va, vb, None, "·"))
+            continue
         delta = (vb - va) / abs(va) if va else (0.0 if vb == va else None)
         dirn = direction(metric)
         thr = per_metric.get(metric, threshold)
@@ -254,6 +280,8 @@ def compare(a: dict, b: dict, threshold: float, per_metric: dict):
 def _fmt(v):
     if v is None:
         return "—"
+    if isinstance(v, str):
+        return v
     if abs(v) >= 1000:
         return f"{v:,.1f}"
     return f"{v:.4g}"

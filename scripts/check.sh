@@ -132,6 +132,16 @@ echo "== costmodel + compile-ledger smoke (HBM prediction + retrace attribution,
 JAX_PLATFORMS=cpu python scripts/costmodel_smoke.py || fail=1
 
 echo
+echo "== roofline smoke (FLOP model oracle + utilization stamps + report, ISSUE 12) =="
+# The compute twin of the costmodel smoke: every registered entry's FLOP
+# model must match a hand-counted tiny-shape oracle EXACTLY (zero
+# tolerance), a tiny bench with synthetic peak overrides must stamp
+# finite mxu_utilization/bound/padded_fraction on every section that
+# stamps predicted_index_bytes, and the obs.report snapshot (now carrying
+# the roofline section) must validate through the CLI.
+JAX_PLATFORMS=cpu python scripts/roofline_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1
@@ -172,6 +182,18 @@ assert bq.get("per_chip_measured"), bq
 # measured watermark
 assert bq["predicted_index_bytes"] == bq["index_bytes"], bq
 assert 0.75 <= bq["hbm_predicted_to_measured"] <= 1.25, bq
+# ISSUE 12: every predicted_index_bytes stamper also carries a roofline
+# record — finite achieved throughput + a padding fraction; on a platform
+# off the peak table the bound verdict must be an honest "unknown", never
+# an invented utilization
+import math
+assert math.isfinite(bq.get("achieved_gflops", float("nan"))), bq
+assert 0.0 <= bq.get("padded_fraction", -1) <= 1.0, bq
+assert bq.get("bound") in ("compute", "memory", "unknown"), bq
+if bq.get("peaks_source") == "unknown":
+    assert bq["bound"] == "unknown" and "mxu_utilization" not in bq, bq
+else:
+    assert math.isfinite(bq.get("mxu_utilization", float("nan"))), bq
 print("tiny ivf_bq smoke: OK (qps=%s recall=%s code_bytes/row=%s "
       "compression=%sx)" % (bq["qps"], bq["recall"],
                             bq["code_bytes_per_row"],

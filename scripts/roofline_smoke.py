@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Roofline-plane CPU smoke (ISSUE 12, wired into check.sh).
+
+Three legs, matching the acceptance gates:
+
+* **FLOP-model oracle, zero tolerance** — every registered entry's
+  ``estimate_flops`` must EXACTLY match a hand-counted tiny-shape oracle
+  (python loops, independent of the closed forms — the same counting the
+  tier-1 property tests draw randomly; here one fixed shape per entry so
+  the gate reads as arithmetic);
+* **tiny bench run** — ``RAFT_TPU_BENCH_TINY=1`` with synthetic peak
+  overrides (``RAFT_TPU_OBS_PEAK_FLOPS``/``_BW`` — the unlisted-platform
+  knob, which is exactly what a CPU smoke is): every stamped section must
+  carry a FINITE roofline record (``mxu_utilization`` /
+  ``achieved_gflops`` / ``bound`` / ``padded_fraction``);
+* **report CLI** — a tiny serving run's ``obs.report.collect()`` must
+  carry the new ``roofline`` section and still pass
+  ``python -m raft_tpu.obs.report --validate``.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu import obs, serving  # noqa: E402
+from raft_tpu.neighbors import ivf_flat  # noqa: E402
+from raft_tpu.obs import memory as obs_memory  # noqa: E402
+from raft_tpu.obs import report as obs_report  # noqa: E402
+from raft_tpu.obs import roofline  # noqa: E402
+from raft_tpu.obs import shadow as obs_shadow  # noqa: E402
+from raft_tpu.obs import slo as obs_slo  # noqa: E402
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _mm(m, n, k):
+    """2 FLOPs per MAC, counted one output element at a time."""
+    total = 0
+    for _ in range(m):
+        for _ in range(n):
+            total += 2 * k
+    return total
+
+
+def check_oracles():
+    """One fixed tiny shape per registered entry, counted by hand."""
+    C = roofline.STRIP_C
+    q, dim, nl, mls, p, k = 3, 6, 4, 5, 2, 2
+
+    cases = {}
+    # brute_force: gemm + one bias add per (q, n) cell
+    n = 7
+    cases["brute_force.search"] = (
+        dict(q=q, n=n, dim=dim, k=k), _mm(q, n, dim) + q * n)
+    # ivf_flat: coarse gemm + per probed entry (2·dim + bias)
+    cases["ivf_flat.search"] = (
+        dict(q=q, dim=dim, n_lists=nl, max_list_size=mls, n_probes=p, k=k),
+        _mm(q, nl, dim) + q * p * mls * (2 * dim + 1))
+    # ivf_pq (decoded int8 strip): + rotation, scan at rot_dim width
+    pq_dim = 3
+    rd = pq_dim * math.ceil(dim / pq_dim)
+    cases["ivf_pq.search"] = (
+        dict(q=q, dim=dim, n_lists=nl, max_list_size=mls, pq_dim=pq_dim,
+             n_probes=p, k=k),
+        _mm(q, nl, dim) + _mm(q, rd, dim)
+        + q * p * mls * (2 * rd + 1))
+    # ivf_bq (±1 packed strip): + rotation, scale AND bias per entry
+    rdb = math.ceil(dim / 8) * 8
+    cases["ivf_bq.search"] = (
+        dict(q=q, dim=dim, n_lists=nl, max_list_size=mls, n_probes=p, k=k),
+        _mm(q, nl, dim) + _mm(q, rdb, dim)
+        + q * p * mls * (2 * rdb + 2))
+    # paged flat: capacity-padded chains, per-query gather
+    pr, tw = 3, 2
+    cases["ivf_flat.paged_scan"] = (
+        dict(q=q, dim=dim, n_lists=nl, page_rows=pr, table_width=tw,
+             n_probes=p, k=k),
+        _mm(q, nl, dim) + q * p * tw * pr * (2 * dim + 1))
+    # paged pq: + rotation + per-query LUT build + lookup-adds
+    cases["ivf_pq.paged_scan"] = (
+        dict(q=q, dim=dim, n_lists=nl, page_rows=pr, table_width=tw,
+             pq_dim=pq_dim, n_probes=p, k=k),
+        _mm(q, nl, dim) + _mm(q, rd, dim) + _mm(q, 256, rd)
+        + q * p * tw * pr * 2 * pq_dim)
+    # fused hop: ip + norm contractions + two one-hot extractions
+    w, deg, pdim, itopk, hops = 2, 4, 5, 3, 2
+    b = w * deg
+    cases["cagra.fused_hop"] = (
+        dict(q=q, width=w, degree=deg, proj_dim=pdim, itopk=itopk,
+             hops=hops),
+        hops * (2 * _mm(q, b, pdim) + 2 * _mm(q, itopk, itopk + b)))
+    # scatter: pure data movement
+    cases["serving.scatter"] = (
+        dict(n_rows=5, dim=dim, payload_width=dim), 0)
+
+    for entry, (shapes, expect) in cases.items():
+        got = roofline.estimate_flops(entry, **shapes)["flops"]
+        assert got == expect, (entry, got, expect)
+    # and the strip-traffic closed form, once, by hand
+    est = roofline.estimate_flops(
+        "ivf_flat.search", q=q, dim=dim, n_lists=nl, max_list_size=mls,
+        n_probes=p, k=k)
+    strips = math.ceil(q * p / C)
+    assert est["bytes_read"] == (q * dim * 4 + nl * dim * 4
+                                 + strips * mls * (dim * 4 + 8)), est
+    print(f"  oracle: {len(cases)} entries exact")
+
+
+def check_tiny_bench():
+    """Tiny bench with synthetic peaks: every section that stamps
+    predicted_index_bytes must carry a finite roofline record."""
+    env = {**os.environ,
+           "RAFT_TPU_BENCH_CHILD": "cpu", "RAFT_TPU_BENCH_TINY": "1",
+           "RAFT_TPU_BENCH_SECTIONS": "ivf_flat",
+           "RAFT_TPU_BENCH_HEARTBEAT": os.path.join(
+               tempfile.mkdtemp(), "hb.jsonl"),
+           roofline.PEAK_FLOPS_ENV: "1e12",
+           roofline.PEAK_BW_ENV: "1e11"}
+    proc = subprocess.run([sys.executable, "bench.py"], env=env, cwd=_REPO,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    extras = json.loads(line)["extras"]
+    checked = 0
+    for name, row in extras.items():
+        if not (isinstance(row, dict) and "predicted_index_bytes" in row):
+            continue
+        checked += 1
+        assert "roofline_error" not in row, (name, row["roofline_error"])
+        for key in ("mxu_utilization", "achieved_gflops",
+                    "padded_fraction"):
+            v = row.get(key)
+            assert isinstance(v, (int, float)) and math.isfinite(v), \
+                (name, key, row)
+        assert row.get("bound") in ("compute", "memory"), (name, row)
+        assert 0.0 <= row["padded_fraction"] <= 1.0, (name, row)
+    assert checked >= 1, sorted(extras)
+    row = extras["ivf_flat"]
+    print(f"  tiny bench: {checked} section(s) stamped "
+          f"(ivf_flat: bound={row['bound']} "
+          f"mxu={row['mxu_utilization']:.2e} "
+          f"padded={row['padded_fraction']})")
+
+
+def check_report_cli():
+    """Tiny serving plane → the report carries a validating roofline
+    section in-process AND through the CLI."""
+    obs.enable()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((1500, 16)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8,
+                                                   list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=32)
+    K, NPROBE = 5, 4
+    sampler = obs_shadow.ShadowSampler(
+        lambda qq: serving.search(store, qq, K, n_probes=store.n_lists),
+        k=K, rate=0.5, seed=3, max_pending=128)
+    engine = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(0.5, sampler=sampler))
+    queue = serving.QueryQueue(serving.searcher(store, K, n_probes=NPROBE),
+                               slo_s=0.5, max_batch=8, shadow=sampler)
+    handles = [queue.submit(rng.standard_normal(16), timeout_s=10.0)
+               for _ in range(24)]
+    while queue.depth:
+        queue.pump()
+    sampler.drain(timeout_s=30.0)
+    assert all(h.verdict == "ok" for h in handles)
+    obs_memory.sample("roofline_smoke")  # populate the memory gauges
+    report = obs_report.collect(engine=engine, sampler=sampler, queue=queue)
+    roof = report["roofline"]
+    assert roof is not None, report.get("errors")
+    assert "ivf_flat.paged_scan" in roof["entries"], sorted(roof["entries"])
+    problems = obs_report.validate(report)
+    assert not problems, problems
+    path = os.path.join(tempfile.mkdtemp(), "roofline_smoke.jsonl")
+    obs_report.export(path, report)
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.report", path, "--validate"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rendered = json.loads(proc.stdout)
+    assert rendered["roofline"]["entries"], rendered.get("roofline")
+    print(f"  report CLI: roofline section validates "
+          f"({len(roof['entries'])} entries, "
+          f"peaks={roof['peaks']['source']})")
+
+
+def main():
+    check_oracles()
+    check_report_cli()
+    check_tiny_bench()
+    print("roofline smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

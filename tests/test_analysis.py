@@ -413,6 +413,39 @@ def paged_scan_estimator(store, k, n_probes):
     return lambda batch: 0
 """,
     ),
+    # ISSUE 12 extension: the roofline plane's entry points
+    # (obs/roofline.py) feed the autotuner's per-config efficiency record
+    # — estimate_flops/utilization/summary must be span-covered; the
+    # hot-path note_dispatch (gated by callers) stays exempt
+    (
+        "obs-coverage",
+        "raft_tpu/obs/roofline.py",
+        """
+def estimate_flops(entry, **shapes):
+    return {"flops": 0}
+""",
+        """
+from raft_tpu import obs
+
+def estimate_flops(entry, **shapes):
+    with obs.record_span("obs.roofline::estimate_flops"):
+        return {"flops": 0}
+
+def utilization(entry, measured_s=None, **shapes):
+    with obs.record_span("obs.roofline::utilization"):
+        return {"bound": "unknown"}
+
+def summary(snapshot=None):
+    with obs.record_span("obs.roofline::summary"):
+        return {"entries": {}}
+
+def note_dispatch(entry, shapes, occupancy=None):
+    return None
+
+def platform_peaks():
+    return {"source": "unknown"}
+""",
+    ),
     # ISSUE 10 extension: shadow-sampler (and the rest of obs/) exception
     # paths must route through resilience.classify — a swallowed shadow
     # failure would leave the recall estimate silently stale-free
