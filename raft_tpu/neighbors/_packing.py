@@ -24,7 +24,9 @@ _N_ALT = 4
 #: in tier-1/bench/smoke) AND names the operand whose shape caused each
 #: one (obs/compile.py — the round-11 replacement for the ad-hoc
 #: PAGED_TRACES counter dict)
-PAGED_ENTRIES = ("ivf_flat.paged_scan", "ivf_pq.paged_scan")
+PAGED_ENTRIES = ("ivf_flat.paged_scan", "ivf_pq.paged_scan",
+                 "ivf_flat.paged_pallas", "ivf_pq.paged_pallas",
+                 "ivf_bq.paged_pallas")
 
 
 def paged_trace_count() -> int:

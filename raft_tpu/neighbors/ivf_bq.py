@@ -61,7 +61,6 @@ from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors.ivf_pq import _pad_rot, make_rotation_matrix
 from raft_tpu.ops import distance as dist_mod
 from raft_tpu.ops.bq_scan import pack_sign_bits
-from raft_tpu.ops.select_k import select_k
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
 
@@ -390,22 +389,18 @@ def extend(index: IvfBqIndex, new_vectors, new_ids=None,
 def _bq_search_prep(queries, centers, rotation, list_bias, list_ids, filter,
                     n_probes, metric, select_algo, compute_dtype, l2):
     """Stage 1 + operand prep: ONE coarse gemm feeds both the probe ranking
-    and the exact per-pair center term (the ivf_pq._pq_search_prep
-    protocol); the rotated query is the scan's A operand."""
-    ip_c = dist_mod.matmul_t(queries, centers, None, "highest")
-    if l2:
-        coarse = (dist_mod.sqnorm(queries)[:, None]
-                  + dist_mod.sqnorm(centers)[None, :] - 2.0 * ip_c)
-    else:
-        coarse = -ip_c
-    _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
-    rot_dim = rotation.shape[0]
-    qr = _pad_rot(queries, rot_dim) @ rotation.T
+    and the exact per-pair center term (ivf_pq's shared ``_pq_probe_prep``
+    — one copy of the math, so the packed and paged engines cannot
+    drift); the rotated query is the scan's A operand. ``list_bias`` /
+    ``list_ids`` may equally be a paged store's (capacity, page_rows)
+    pools — the masking is shape-agnostic."""
+    from raft_tpu.neighbors.ivf_pq import _pq_probe_prep
+
+    probes, qr, pair_const = _pq_probe_prep(
+        queries, centers, rotation, n_probes, select_algo, l2)
     bias = list_bias
     if filter is not None:
         bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
-    alpha = -2.0 if l2 else -1.0
-    pair_const = alpha * jnp.take_along_axis(ip_c, probes, axis=1)
     return probes, qr, bias, pair_const
 
 
@@ -568,6 +563,140 @@ def search(
                                         q_tile=q_tile)
                 continue
             raise
+
+
+# ---------------------------------------------------------------------------
+# Paged search (serving layer): scan a PagedListStore's packed sign pages
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "q_tile", "interpret", "impl"),
+)
+def _paged_fused_bq(queries, centers, rotation, codes_pool, scale_pool,
+                    bias_pool, page_ids, table, chain_pages, filter,
+                    k, n_probes, metric, select_algo, compute_dtype,
+                    q_tile, interpret, impl):
+    """The ENTIRE paged BQ search as one jit: coarse gemm + rotation,
+    device strip planning, the page-table DMA ±1 kernel, merge, finalize —
+    the ``_bq_fused`` shape over page chains. Capacity-shaped operands
+    (zero-recompile serving contract); the exact −2⟨q, c_l⟩ term rides
+    pair_const exactly like the packed path."""
+    from raft_tpu.ops.bq_scan import paged_bq_search_traced
+
+    obs_compile.trace_event(
+        "ivf_bq.paged_pallas", queries=queries, centers=centers,
+        rotation=rotation, codes_pool=codes_pool, scale_pool=scale_pool,
+        bias_pool=bias_pool, page_ids=page_ids, table=table,
+        chain_pages=chain_pages, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "q_tile": q_tile, "interpret": interpret, "impl": impl})
+    l2 = metric in ("sqeuclidean", "euclidean")
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
+    # THE packed path's prep (one copy — probes/rotation/pair_const are
+    # bitwise parity by construction); the bias/ids operands are simply
+    # the store's pools instead of the packed arrays
+    probes, qr, bias, pair_const = _bq_search_prep(
+        queries, centers, rotation, bias_pool, page_ids, filter,
+        n_probes, metric, sa, compute_dtype, l2,
+    )
+    alpha = -2.0 if l2 else -1.0
+    vals, ids = paged_bq_search_traced(
+        qr, probes, codes_pool, scale_pool, bias, page_ids, table,
+        chain_pages, int(k), int(k), alpha, q_tile, interpret,
+        pair_const=pair_const, impl=impl)
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
+@traced("ivf_bq::search_paged")
+def search_paged(
+    store,
+    queries,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    select_algo: str = "exact",
+    backend: str = "auto",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN over a mutable paged 1-bit code store
+    (:class:`raft_tpu.serving.PagedListStore`, kind ``"ivf_bq"``): same
+    estimator contract as :func:`search`, over a store that keeps serving
+    while rows stream in/out — no repack, zero recompiles on steady-state
+    mutations.
+
+    ``backend``: "paged_pallas" (page-table DMA ±1 kernel — the TPU
+    engine, interpret-mode elsewhere), "paged_jnp" (its bit-parity jnp
+    reference — the CPU default), or "auto"."""
+    if store.kind != "ivf_bq":
+        raise ValueError(f"expected an ivf_bq store, got {store.kind!r}")
+    res = res or current_resources()
+    queries = jnp.asarray(queries).astype(jnp.float32)
+    if queries.ndim != 2 or queries.shape[1] != store.dim:
+        raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
+    n_probes = int(min(n_probes, store.n_lists))
+    from raft_tpu.neighbors.ivf_flat import (_paged_plan_static,
+                                             paged_backend_auto)
+
+    if backend == "auto":
+        backend = paged_backend_auto(store, k)
+    if backend not in ("paged_pallas", "paged_jnp"):
+        raise ValueError(f"unknown backend {backend!r}")
+    # one ATOMIC store snapshot (the scan_state contract)
+    codes_pool, bias_pool, scale_pool, page_ids, table, chain_pages = \
+        store.paged_scan_state()
+    width = int(table.shape[1])
+    if not 0 < k <= min(n_probes * width * store.page_rows, 512):
+        raise ValueError(f"k={k} out of range")
+    if store.metric == "cosine":
+        queries = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
+    rot_dim = int(store.rotation.shape[0])
+    scan_attrs = None
+    if obs.enabled():
+        q_obs = int(queries.shape[0])
+        obs.add("ivf_bq.search_paged.queries", q_obs)
+        obs.add("ivf_bq.search_paged.probes", q_obs * n_probes)
+        obs.add(f"ivf_bq.search_paged.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k),
+                      "table_width": width}
+        from raft_tpu.ops.strip_scan import paged_occupancy_stats
+        occ = obs_roofline.memo_occupancy(
+            store,
+            (store.pages_used, store.size, store.tombstones, width,
+             q_obs, int(n_probes), int(k), res.workspace_bytes),
+            lambda: paged_occupancy_stats(
+                width, store.page_rows, store._list_pages, store.size,
+                store.tombstones, q_obs, int(n_probes), int(k),
+                int(codes_pool.shape[-1]),
+                workspace_bytes=res.workspace_bytes, dim=rot_dim))
+        obs_roofline.note_dispatch(
+            "ivf_bq.paged_pallas",
+            {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+             "page_rows": store.page_rows, "table_width": width,
+             "n_probes": int(n_probes), "k": int(k), "rot_dim": rot_dim},
+            occupancy=occ)
+    from raft_tpu.resilience import faultpoint
+
+    interpret = jax.default_backend() != "tpu"
+    q_tile = min(_paged_plan_static(store, n_probes, k, res, rot_dim),
+                 queries.shape[0])
+    impl = "pallas" if backend == "paged_pallas" else "jnp"
+    faultpoint("ivf_bq.search_paged.scan")
+    with obs.record_span("ivf_bq::paged_pallas", attrs=scan_attrs):
+        with obs_compile.watch():
+            return _paged_fused_bq(
+                queries, store.centers, store.rotation, codes_pool,
+                scale_pool, bias_pool, page_ids, table, chain_pages,
+                filter, int(k), n_probes, store.metric, select_algo,
+                res.compute_dtype, int(q_tile), interpret, impl)
 
 
 @traced("ivf_bq::search_refined")

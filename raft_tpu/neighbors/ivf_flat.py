@@ -733,6 +733,84 @@ def _paged_impl(
     return map_row_tiles(scan_tile, (queries, qn, probes), q_tile)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "q_tile", "interpret", "impl"),
+)
+def _paged_fused(queries, centers, pages, bias_pool, page_ids, table,
+                 chain_pages, filter, k, n_probes, metric, select_algo,
+                 compute_dtype, q_tile, interpret, impl):
+    """The ENTIRE paged Pallas search — coarse gemm, device strip
+    planning, page-table DMA kernel, merge, finalize — as one jit (the
+    ``_ragged_fused`` shape over page chains): mutable paged storage
+    scanned in place at strip-kernel throughput. Every operand is
+    CAPACITY-shaped, so steady-state upserts/deletes re-dispatch this
+    same program (zero-recompile serving contract)."""
+    from raft_tpu.ops.strip_scan import paged_strip_search_traced
+
+    # ledger registration (trace time only): a growth retrace lands
+    # attributed to the pool/table operand that grew (obs/compile.py)
+    obs_compile.trace_event(
+        "ivf_flat.paged_pallas", queries=queries, centers=centers,
+        pages=pages, bias_pool=bias_pool, page_ids=page_ids, table=table,
+        chain_pages=chain_pages, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "q_tile": q_tile, "interpret": interpret, "impl": impl})
+    # same coarse select as the packed ragged path (parity: probe choice
+    # decides the candidate set — see ivf_flat._ragged_fused's bound note)
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
+    probes = _coarse_probes(queries, centers, n_probes, metric, sa,
+                            compute_dtype)
+    bias = bias_pool
+    if filter is not None:
+        # the store's bias pool is already +inf at dead slots; the filter
+        # masks live rows by their source id (the _ragged_bias protocol)
+        bias = jnp.where(filter.test(jnp.maximum(page_ids, 0)), bias,
+                         jnp.inf)
+    l2 = metric in ("sqeuclidean", "euclidean")
+    vals, ids = paged_strip_search_traced(
+        queries, probes, pages, bias, page_ids, table, chain_pages,
+        int(k), int(k), -2.0 if l2 else -1.0, q_tile, interpret, impl=impl)
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
+def paged_backend_auto(store, k: int) -> str:
+    """Engine selection for a paged search: the Pallas page-table scan on
+    TPU when the store's layout can feed it, the jnp gather scan
+    otherwise (and on CPU, where gather is the exact-fp32 oracle path)."""
+    from raft_tpu.ops.strip_scan import paged_eligible
+
+    if jax.default_backend() != "tpu":
+        return "gather" if store.kind != "ivf_bq" else "paged_jnp"
+    if store.kind == "ivf_pq":
+        row_bytes = getattr(store, "_cache_dim", 0)
+    else:
+        row_bytes = int(store.pages.shape[-1]) * store.pages.dtype.itemsize
+    # compiled-mode DMA alignment: lane-offset bias copies want whole
+    # 128-lane tiles per page (the default page height); narrower pages
+    # stay on the gather path outside interpret mode
+    if store.page_rows % 128 != 0:
+        return "gather" if store.kind != "ivf_bq" else "paged_jnp"
+    if not paged_eligible(store.table_width, store.page_rows, row_bytes,
+                          int(k)):
+        return "gather" if store.kind != "ivf_bq" else "paged_jnp"
+    return "paged_pallas"
+
+
+def _paged_plan_static(store, n_probes: int, k: int, res, dim: int):
+    """Query-tile sizing for the paged strip engines — the
+    ``_ragged_plan_static`` twin over the capacity layout (one length
+    class, ``class_counts = (n_lists,)``)."""
+    from raft_tpu.ops import strip_scan as ss
+
+    return ss.fit_q_tile(1 << 30, n_probes, store.n_lists, 1, int(k),
+                         res.workspace_bytes, dim=dim,
+                         class_counts=(store.n_lists,))
+
+
 @traced("ivf_flat::search_paged")
 def search_paged(
     store,
@@ -741,13 +819,19 @@ def search_paged(
     n_probes: int = 20,
     filter: Optional[Bitset] = None,
     select_algo: str = "exact",
+    backend: str = "auto",
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """k-NN over a mutable paged vector store
     (:class:`raft_tpu.serving.PagedListStore`, kind ``"ivf_flat"``): same
     contract as :func:`search`, but the store keeps serving while rows
     stream in/out — no repack, and steady-state mutations never recompile
-    this scan (its shapes depend only on store capacity)."""
+    this scan (its shapes depend only on store capacity).
+
+    ``backend``: "paged_pallas" (page-table DMA strip kernel — the TPU
+    engine, interpret-mode elsewhere), "paged_jnp" (its pure-jnp
+    bit-parity reference), "gather" (jnp gather scan — the exact-fp32
+    oracle, CPU default), or "auto"."""
     if store.kind != "ivf_flat":
         raise ValueError(f"expected an ivf_flat store, got {store.kind!r}")
     res = res or current_resources()
@@ -755,9 +839,17 @@ def search_paged(
     if queries.ndim != 2 or queries.shape[1] != store.dim:
         raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, store.n_lists))
+    if backend == "auto":
+        backend = paged_backend_auto(store, k)
+    if backend not in ("gather", "paged_pallas", "paged_jnp"):
+        raise ValueError(f"unknown backend {backend!r}")
     # one ATOMIC store snapshot: pool/table read separately could tear
     # against a concurrent upsert's capacity growth
-    pages, page_ids, page_aux, table = store.scan_state()
+    if backend == "gather":
+        pages, page_ids, page_aux, table = store.scan_state()
+    else:
+        pages, bias_pool, _, page_ids, table, chain_pages = \
+            store.paged_scan_state()
     width = int(table.shape[1])
     if not 0 < k <= n_probes * width * store.page_rows:
         raise ValueError(f"k={k} out of range")
@@ -769,21 +861,63 @@ def search_paged(
         q_obs = int(queries.shape[0])
         obs.add("ivf_flat.search_paged.queries", q_obs)
         obs.add("ivf_flat.search_paged.probes", q_obs * n_probes)
-        scan_attrs = {"queries": q_obs, "probes": int(n_probes),
-                      "k": int(k), "table_width": width}
-        # roofline note (round 15): the gather scan's per-(query, probe)
-        # capacity-padded chain cost — no cross-query sharing, which is
-        # exactly what this model makes visible vs the packed kernel
-        obs_roofline.note_dispatch(
-            "ivf_flat.paged_scan",
-            {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
-             "page_rows": store.page_rows, "table_width": width,
-             "n_probes": int(n_probes), "k": int(k),
-             "dtype": str(pages.dtype)})
+        obs.add(f"ivf_flat.search_paged.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k),
+                      "table_width": width}
+        if backend == "gather":
+            # roofline note (round 15): the gather scan's per-(query,
+            # probe) capacity-padded chain cost — no cross-query sharing,
+            # which is exactly what the paged Pallas engine buys back
+            obs_roofline.note_dispatch(
+                "ivf_flat.paged_scan",
+                {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+                 "page_rows": store.page_rows, "table_width": width,
+                 "n_probes": int(n_probes), "k": int(k),
+                 "dtype": str(pages.dtype)})
+        else:
+            # paged-Pallas roofline + planner occupancy (round-15 standing
+            # gate: new hot-path kernels ship with their model). The
+            # planner stats come from host state the store already holds —
+            # no device sync (memoized until the layout/fill moves).
+            from raft_tpu.ops.strip_scan import paged_occupancy_stats
+            row_bytes = int(pages.shape[-1]) * pages.dtype.itemsize
+            occ = obs_roofline.memo_occupancy(
+                store,
+                (store.pages_used, store.size, store.tombstones, width,
+                 q_obs, int(n_probes), int(k), res.workspace_bytes),
+                lambda: paged_occupancy_stats(
+                    width, store.page_rows, store._list_pages, store.size,
+                    store.tombstones, q_obs, int(n_probes), int(k),
+                    row_bytes, workspace_bytes=res.workspace_bytes,
+                    dim=store.dim))
+            obs_roofline.note_dispatch(
+                "ivf_flat.paged_pallas",
+                {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+                 "page_rows": store.page_rows, "table_width": width,
+                 "n_probes": int(n_probes), "k": int(k),
+                 "dtype": str(pages.dtype)},
+                occupancy=occ)
+    from raft_tpu.resilience import faultpoint
+
+    if backend != "gather":
+        interpret = jax.default_backend() != "tpu"
+        q_tile = min(_paged_plan_static(store, n_probes, k, res, store.dim),
+                     queries.shape[0])
+        impl = "pallas" if backend == "paged_pallas" else "jnp"
+        faultpoint("ivf_flat.search_paged.scan")
+        with obs.record_span("ivf_flat::paged_pallas", attrs=scan_attrs):
+            with obs_compile.watch():
+                return _paged_fused(
+                    queries, store.centers, pages, bias_pool, page_ids,
+                    table, chain_pages, filter, int(k), n_probes,
+                    store.metric, select_algo, res.compute_dtype,
+                    int(q_tile), interpret, impl)
     # the (qt, p, W, R, d) page gather is the big intermediate
     per_query = max(1, n_probes * width * store.page_rows * (store.dim + 2) * 4)
     q_tile = int(max(1, min(queries.shape[0],
                             res.workspace_bytes // per_query)))
+    faultpoint("ivf_flat.search_paged.scan")
     with obs.record_span("ivf_flat::paged_scan", attrs=scan_attrs):
         # ledger watch: a dispatch that (re)traces gets its wall-clock
         # stamped onto the ledger record (steady state stamps nothing)

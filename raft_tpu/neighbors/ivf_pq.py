@@ -1211,14 +1211,11 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_probes", "metric", "select_algo", "compute_dtype",
-                     "l2"),
-)
-def _pq_search_prep(queries, centers, rotation, b_sum, list_ids,
-                    decoded_scale, filter, n_probes, metric, select_algo,
-                    compute_dtype, l2):
+def _pq_probe_prep(queries, centers, rotation, n_probes, select_algo, l2):
+    """Probe selection + query rotation + the exact per-pair center term —
+    THE one copy of the op sequence both the packed strip path and the
+    paged Pallas path consume (bitwise parity between them is the paged
+    plane's acceptance contract, so this math must not fork)."""
     ip_c = dist_mod.matmul_t(queries, centers, None, "highest")
     if l2:
         # expanded L2 from the single gemm (review: _expanded_distance would
@@ -1230,9 +1227,22 @@ def _pq_search_prep(queries, centers, rotation, b_sum, list_ids,
     _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
     rot_dim = rotation.shape[0]
     qr = _pad_rot(queries, rot_dim) @ rotation.T
-    bias = _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2)
     alpha = -2.0 if l2 else -1.0
     pair_const = alpha * jnp.take_along_axis(ip_c, probes, axis=1)
+    return probes, qr, pair_const
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "metric", "select_algo", "compute_dtype",
+                     "l2"),
+)
+def _pq_search_prep(queries, centers, rotation, b_sum, list_ids,
+                    decoded_scale, filter, n_probes, metric, select_algo,
+                    compute_dtype, l2):
+    probes, qr, pair_const = _pq_probe_prep(
+        queries, centers, rotation, n_probes, select_algo, l2)
+    bias = _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2)
     return probes, qr * decoded_scale, bias, pair_const
 
 
@@ -1681,6 +1691,32 @@ def _row_b_sum(centers, rotation, codebooks, codes, labels, pq_dim, pq_bits):
     return jnp.sum(picked, axis=1)
 
 
+@jax.jit
+def _center_rot_sqnorm(centers, rotation):
+    """‖R·c̃_l‖² per list — the per-list constant of the decoded-cache
+    scan bias (:func:`_ragged_bias_pq`'s ``rc2``), shared with the paged
+    store so its per-row bias pool stays bitwise-parity with the packed
+    formula."""
+    return dist_mod.sqnorm(_pad_rot(centers, rotation.shape[0]) @ rotation.T)
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits"))
+def _decode_code_rows(codebooks, codes, scale, pq_dim, pq_bits):
+    """int8 decoded-residual rows for freshly encoded codes — the per-row
+    twin of :func:`_decode_lists_scaled` (same quantized codebook, same
+    flat gather), so a paged store's incremental cache rows are bitwise
+    identical to the packed decode of the same codes. Subspace codebooks
+    only (the serving store's constraint)."""
+    n_codes, dsub = codebooks.shape[1], codebooks.shape[2]
+    rot_dim = pq_dim * dsub
+    cb_q = jnp.clip(jnp.round(codebooks / scale), -127, 127).astype(jnp.int8)
+    cb_flat = cb_q.reshape(pq_dim * n_codes, dsub)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+    cv = _codes_view(codes, pq_dim, pq_bits)
+    resid = jnp.take(cb_flat, cv.astype(jnp.int32) + s_off, axis=0)
+    return resid.reshape(codes.shape[0], rot_dim)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "n_probes", "metric", "q_tile", "select_algo",
@@ -1757,6 +1793,54 @@ def _paged_impl(
     return vals, ids
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_probes", "metric", "select_algo",
+                     "compute_dtype", "q_tile", "interpret", "impl"),
+)
+def _paged_fused_pq(queries, centers, rotation, cache_pool, bias_pool,
+                    page_ids, table, chain_pages, decoded_scale, filter,
+                    k, n_probes, metric, select_algo, compute_dtype,
+                    q_tile, interpret, impl):
+    """The ENTIRE paged PQ Pallas search as one jit: coarse gemm + query
+    rotation, device strip planning, the page-table DMA kernel over the
+    int8 decoded-residual cache pool, merge, finalize — the
+    ``_ragged_fused_pq`` shape over page chains. All operands are
+    capacity-shaped (zero-recompile serving contract); the exact
+    −2⟨q, R·c_l⟩ term rides the merge's pair_const exactly like the
+    packed path."""
+    from raft_tpu.ops.strip_scan import paged_strip_search_traced
+
+    obs_compile.trace_event(
+        "ivf_pq.paged_pallas", queries=queries, centers=centers,
+        rotation=rotation, cache_pool=cache_pool, bias_pool=bias_pool,
+        page_ids=page_ids, table=table, chain_pages=chain_pages,
+        decoded_scale=decoded_scale, filter=filter,
+        static={"k": k, "n_probes": n_probes, "metric": metric,
+                "select_algo": select_algo, "compute_dtype": compute_dtype,
+                "q_tile": q_tile, "interpret": interpret, "impl": impl})
+    l2 = metric in ("sqeuclidean", "euclidean")
+    sa = ("packed" if select_algo == "exact" and not interpret
+          and centers.shape[0] <= 4096 else select_algo)
+    # the packed path's shared probe prep (bitwise parity by
+    # construction); the bias comes from the store-maintained pool —
+    # already rc2 + b_sum per row — instead of _ragged_bias_pq
+    probes, qr, pair_const = _pq_probe_prep(
+        queries, centers, rotation, n_probes, sa, l2)
+    alpha = -2.0 if l2 else -1.0
+    bias = bias_pool
+    if filter is not None:
+        bias = jnp.where(filter.test(jnp.maximum(page_ids, 0)), bias,
+                         jnp.inf)
+    vals, ids = paged_strip_search_traced(
+        qr * decoded_scale, probes, cache_pool, bias, page_ids, table,
+        chain_pages, int(k), int(k), alpha, q_tile, interpret,
+        pair_const=pair_const, impl=impl)
+    from raft_tpu.neighbors.ivf_flat import _finalize_ragged
+
+    return _finalize_ragged(vals, ids, queries, metric)
+
+
 @traced("ivf_pq::search_paged")
 def search_paged(
     store,
@@ -1765,13 +1849,19 @@ def search_paged(
     n_probes: int = 20,
     filter: Optional[Bitset] = None,
     select_algo: str = "exact",
+    backend: str = "auto",
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Approximate k-NN over a mutable paged code store
     (:class:`raft_tpu.serving.PagedListStore`, kind ``"ivf_pq"``): same
     contract as :func:`search`, but the store keeps serving while rows
     stream in/out — no repack, and steady-state mutations never recompile
-    this scan (its shapes depend only on store capacity)."""
+    this scan (its shapes depend only on store capacity).
+
+    ``backend``: "paged_pallas" (page-table DMA strip kernel over the
+    int8 decoded cache pool — the TPU engine, interpret-mode elsewhere),
+    "paged_jnp" (its bit-parity jnp reference), "gather" (LUT gather scan
+    — CPU default), or "auto"."""
     if store.kind != "ivf_pq":
         raise ValueError(f"expected an ivf_pq store, got {store.kind!r}")
     res = res or current_resources()
@@ -1779,9 +1869,20 @@ def search_paged(
     if queries.ndim != 2 or queries.shape[1] != store.dim:
         raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, store.n_lists))
+    from raft_tpu.neighbors.ivf_flat import (_paged_plan_static,
+                                             paged_backend_auto)
+
+    if backend == "auto":
+        backend = paged_backend_auto(store, k)
+    if backend not in ("gather", "paged_pallas", "paged_jnp"):
+        raise ValueError(f"unknown backend {backend!r}")
     # one ATOMIC store snapshot: pool/table read separately could tear
     # against a concurrent upsert's capacity growth
-    pages, page_ids, page_aux, table = store.scan_state()
+    if backend == "gather":
+        pages, page_ids, page_aux, table = store.scan_state()
+    else:
+        cache_pool, bias_pool, _, page_ids, table, chain_pages = \
+            store.paged_scan_state()
     width = int(table.shape[1])
     if not 0 < k <= n_probes * width * store.page_rows:
         raise ValueError(f"k={k} out of range")
@@ -1793,22 +1894,64 @@ def search_paged(
         q_obs = int(queries.shape[0])
         obs.add("ivf_pq.search_paged.queries", q_obs)
         obs.add("ivf_pq.search_paged.probes", q_obs * n_probes)
-        scan_attrs = {"queries": q_obs, "probes": int(n_probes),
-                      "k": int(k), "table_width": width}
-        # roofline note (round 15): LUT-scan cost over the capacity-padded
-        # page chains (no cross-query sharing on the gather path)
-        obs_roofline.note_dispatch(
-            "ivf_pq.paged_scan",
-            {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
-             "page_rows": store.page_rows, "table_width": width,
-             "pq_dim": store.pq_dim, "pq_bits": store.pq_bits,
-             "n_probes": int(n_probes), "k": int(k),
-             "rot_dim": int(store.rotation.shape[0])})
+        obs.add(f"ivf_pq.search_paged.backend.{backend}", 1)
+        scan_attrs = {"backend": backend, "queries": q_obs,
+                      "probes": int(n_probes), "k": int(k),
+                      "table_width": width}
+        if backend == "gather":
+            # roofline note (round 15): LUT-scan cost over the capacity-
+            # padded page chains (no cross-query sharing on this path)
+            obs_roofline.note_dispatch(
+                "ivf_pq.paged_scan",
+                {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+                 "page_rows": store.page_rows, "table_width": width,
+                 "pq_dim": store.pq_dim, "pq_bits": store.pq_bits,
+                 "n_probes": int(n_probes), "k": int(k),
+                 "rot_dim": int(store.rotation.shape[0])})
+        else:
+            from raft_tpu.ops.strip_scan import paged_occupancy_stats
+            occ = obs_roofline.memo_occupancy(
+                store,
+                (store.pages_used, store.size, store.tombstones, width,
+                 q_obs, int(n_probes), int(k), res.workspace_bytes),
+                lambda: paged_occupancy_stats(
+                    width, store.page_rows, store._list_pages, store.size,
+                    store.tombstones, q_obs, int(n_probes), int(k),
+                    store._cache_dim, workspace_bytes=res.workspace_bytes,
+                    dim=store._cache_dim))
+            obs_roofline.note_dispatch(
+                "ivf_pq.paged_pallas",
+                {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
+                 "page_rows": store.page_rows, "table_width": width,
+                 "pq_dim": store.pq_dim, "pq_bits": store.pq_bits,
+                 "n_probes": int(n_probes), "k": int(k),
+                 "rot_dim": int(store.rotation.shape[0])},
+                occupancy=occ)
+    from raft_tpu.resilience import faultpoint
+
+    if backend != "gather":
+        interpret = jax.default_backend() != "tpu"
+        q_tile = min(_paged_plan_static(store, n_probes, k, res,
+                                        store._cache_dim),
+                     queries.shape[0])
+        impl = "pallas" if backend == "paged_pallas" else "jnp"
+        faultpoint("ivf_pq.search_paged.scan")
+        with obs.record_span("ivf_pq::paged_pallas", attrs=scan_attrs):
+            with obs_compile.watch():
+                # cosine is already folded by _finalize_ragged inside the
+                # fused dispatch (the packed ragged path's convention)
+                return _paged_fused_pq(
+                    queries, store.centers, store.rotation, cache_pool,
+                    bias_pool, page_ids, table, chain_pages,
+                    store.decoded_scale, filter, int(k), n_probes,
+                    store.metric, select_algo, res.compute_dtype,
+                    int(q_tile), interpret, impl)
     # the (qt, p, W, R, s) unpacked-code gather dominates the working set
     per_query = max(1, n_probes * width * store.page_rows
                     * (store.pq_dim * 5 + 8))
     q_tile = int(max(1, min(queries.shape[0],
                             res.workspace_bytes // per_query)))
+    faultpoint("ivf_pq.search_paged.scan")
     with obs.record_span("ivf_pq::paged_scan", attrs=scan_attrs):
         # ledger watch: a dispatch that (re)traces gets its wall-clock
         # stamped onto the ledger record (steady state stamps nothing)

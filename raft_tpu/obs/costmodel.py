@@ -180,11 +180,13 @@ def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
                          page_rows: int, table_width: int, payload_width: int,
                          payload_dtype="float32", store_kind: str = "ivf_flat",
                          pq_dim: int = 0, pq_bits: int = 8,
-                         rot_dim: Optional[int] = None) -> int:
+                         rot_dim: Optional[int] = None,
+                         paged_plan_cache: bool = False) -> int:
     total = n_lists * dim * 4                                         # centers
     total += capacity_pages * page_rows * payload_width * _isize(payload_dtype)
     total += capacity_pages * page_rows * 4                           # page_ids
     total += capacity_pages * page_rows * 4                           # page_aux
+    total += capacity_pages * page_rows * 4           # page_bias (round 16)
     total += n_lists * table_width * 4                        # device table
     # host bookkeeping (counted by index_bytes too — numpy arrays carry
     # nbytes): page table + per-list chain lengths + per-page fill counts
@@ -193,11 +195,22 @@ def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
     total += n_lists * 4                                        # _list_pages
     total += capacity_pages * 4                                 # _fill
     total += capacity_pages * 4                                 # _page_list
+    if paged_plan_cache:
+        # the paged Pallas path's device chain-length mirror (_dev_lens),
+        # materialized on its first search
+        total += n_lists * 4
     if store_kind == "ivf_pq":
         if rot_dim is None:
             rot_dim = pq_dim * (-(-dim // pq_dim))
         total += rot_dim * rot_dim * 4                                # rotation
         total += pq_dim * (1 << pq_bits) * (rot_dim // pq_dim) * 4    # codebooks
+        total += capacity_pages * page_rows * rot_dim       # page_cache int8
+        total += 4                                  # decoded_scale (0-d fp32)
+    elif store_kind == "ivf_bq":
+        if rot_dim is None:
+            rot_dim = -(-dim // 8) * 8
+        total += rot_dim * rot_dim * 4                                # rotation
+        total += capacity_pages * page_rows * 4             # page_scale
     return total
 
 
@@ -282,7 +295,10 @@ def index_layout(index) -> dict:
                 "payload_dtype": str(index.pages.dtype),
                 "pq_dim": index.pq_dim, "pq_bits": index.pq_bits,
                 "rot_dim": (None if index.rotation is None
-                            else int(index.rotation.shape[0]))}
+                            else int(index.rotation.shape[0])),
+                # the paged Pallas path's lazily-built device mirror
+                "paged_plan_cache": getattr(index, "_dev_lens", None)
+                is not None}
     raise TypeError(f"unsupported index type {type(index).__name__}")
 
 
@@ -382,6 +398,25 @@ def _est_ivf_bq_search(*, q, dim, n_lists, max_list_size, n_probes, k,
     return operands, outputs, workspace
 
 
+def _est_ivf_bq_paged(*, q, dim, n_lists, capacity_pages, page_rows,
+                      table_width, n_probes, k, rot_dim=None,
+                      workspace_bytes=None):
+    ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
+    if rot_dim is None:
+        rot_dim = -(-dim // 8) * 8
+    operands = q * dim * 4 + _predict_paged_store(
+        n_lists=n_lists, dim=dim, capacity_pages=capacity_pages,
+        page_rows=page_rows, table_width=table_width,
+        payload_width=rot_dim // 8, payload_dtype="uint8",
+        store_kind="ivf_bq", rot_dim=rot_dim)
+    # the unpacked ±1 strip block per probed chain row + score/merge rows
+    per_query = max(1, n_probes * table_width * page_rows * (rot_dim * 2 + 8))
+    qt = _ws_tile(q, per_query, ws)
+    workspace = qt * per_query + q * rot_dim * 4 + q * n_lists * 8
+    outputs = q * k * 8
+    return operands, outputs, workspace
+
+
 def _est_brute_force_search(*, q, n, dim, k, tile_rows=65536,
                             dtype="float32", workspace_bytes=None):
     operands = q * dim * 4 + _predict_brute_force(n=n, dim=dim, dtype=dtype)
@@ -392,10 +427,13 @@ def _est_brute_force_search(*, q, n, dim, k, tile_rows=65536,
 
 
 def _est_serving_upsert(*, n_rows, payload_width, dim,
-                        payload_dtype="float32", workspace_bytes=None):
+                        payload_dtype="float32", extra_row_bytes=0,
+                        workspace_bytes=None):
     batch = 1 << max(0, int(n_rows - 1).bit_length())  # pow2 scatter bucket
     operands = n_rows * dim * 4                        # incoming vectors
-    workspace = batch * (payload_width * _isize(payload_dtype) + 4 + 4 + 16)
+    # payload + id + aux + scan bias + kind-specific extra pool row
+    workspace = batch * (payload_width * _isize(payload_dtype) + 4 + 4 + 4
+                         + int(extra_row_bytes) + 16)
     outputs = 0                                        # in-place pool update
     return operands, outputs, workspace
 
@@ -406,6 +444,7 @@ _ESTIMATORS = {
     "ivf_pq.search": _est_ivf_pq_search,
     "ivf_pq.paged_scan": _est_ivf_pq_paged,
     "ivf_bq.search": _est_ivf_bq_search,
+    "ivf_bq.paged_scan": _est_ivf_bq_paged,
     "brute_force.search": _est_brute_force_search,
     "serving.upsert": _est_serving_upsert,
 }
@@ -466,8 +505,10 @@ def estimate_search(index, q: int, k: int, n_probes: int = 0,
         return estimate("brute_force.search", q=q, k=k, n=layout["n"],
                         dim=layout["dim"], dtype=layout["dtype"], **ws)
     if kind == "paged_store":
-        entry = ("ivf_pq.paged_scan" if layout.get("store_kind") == "ivf_pq"
-                 else "ivf_flat.paged_scan")
+        sk = layout.get("store_kind")
+        entry = {"ivf_pq": "ivf_pq.paged_scan",
+                 "ivf_bq": "ivf_bq.paged_scan"}.get(sk,
+                                                    "ivf_flat.paged_scan")
         kw = dict(q=q, k=k, n_probes=n_probes, dim=layout["dim"],
                   n_lists=layout["n_lists"],
                   capacity_pages=layout["capacity_pages"],
@@ -476,6 +517,8 @@ def estimate_search(index, q: int, k: int, n_probes: int = 0,
         if entry == "ivf_pq.paged_scan":
             kw.update(pq_dim=layout["pq_dim"], pq_bits=layout["pq_bits"],
                       rot_dim=layout["rot_dim"])
+        elif entry == "ivf_bq.paged_scan":
+            kw.update(rot_dim=layout["rot_dim"])
         return estimate(entry, **kw)
     raise ValueError(f"no dispatch estimator for index family {kind!r}")
 

@@ -320,6 +320,258 @@ def bq_strip_search_traced(queries_rot, probes, list_codes, scale, bias,
     return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
 
 
+# ---------------------------------------------------------------------------
+# Paged packed scan (serving): the ±1 engine over PagedListStore page chains
+# ---------------------------------------------------------------------------
+
+
+def _paged_bq_score_topk(a, packed_block, scale_row, bias_row, live_rows,
+                         alpha: float, kf: int, w: int, approx_ok: bool):
+    """One paged packed block's scores + fused top-kf — shared by the
+    kernel and the jnp reference (bit parity by construction, the
+    :func:`_score_topk` pattern with the paged live-lane mask)."""
+    b = _unpack_pm1(packed_block).astype(jnp.bfloat16)
+    s = lax.dot_general(a.astype(jnp.bfloat16), b, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = alpha * s * scale_row + bias_row
+    lanes = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(lanes < live_rows, s, jnp.inf)
+    return ss._topk_block(s, kf, w, approx_ok)
+
+
+def _paged_bq_kernel(sl_ref, tbl_ref, chain_ref, a_ref, codes_hbm,
+                     scale_hbm, bias_hbm, outv_ref, oute_ref, code_s,
+                     scale_s, bias_s, csem, ssem, bsem, *, alpha, kf, w,
+                     n_sub, ppf, page_rows, table_width, approx_ok):
+    """One (strip × page sub-block) of the paged ±1 scan: DMA the live
+    code/scale/bias pages HBM→VMEM, unpack to ±1 in VMEM, one MXU matmul +
+    fused top-kf (strip_scan._paged_strip_kernel with the packed B operand
+    and the per-row scale)."""
+    i = pl.program_id(0)
+    slv = sl_ref[i]
+    j = pl.program_id(1) if n_sub > 1 else 0
+    l = jnp.maximum(slv, 0)
+    chain = jnp.where(slv >= 0, chain_ref[l], 0)
+    base = j * ppf
+    nv = jnp.clip(chain - base, 0, ppf)
+    R = page_rows
+
+    def issue(t, _):
+        pid = tbl_ref[l * table_width + base + t]
+        pltpu.make_async_copy(codes_hbm.at[pid],
+                              code_s.at[pl.ds(t * R, R)], csem).start()
+        pltpu.make_async_copy(scale_hbm.at[pid],
+                              scale_s.at[0, pl.ds(t * R, R)], ssem).start()
+        pltpu.make_async_copy(bias_hbm.at[pid],
+                              bias_s.at[0, pl.ds(t * R, R)], bsem).start()
+        return 0
+
+    def drain(t, _):
+        pid = tbl_ref[l * table_width + base + t]
+        pltpu.make_async_copy(codes_hbm.at[pid],
+                              code_s.at[pl.ds(t * R, R)], csem).wait()
+        pltpu.make_async_copy(scale_hbm.at[pid],
+                              scale_s.at[0, pl.ds(t * R, R)], ssem).wait()
+        pltpu.make_async_copy(bias_hbm.at[pid],
+                              bias_s.at[0, pl.ds(t * R, R)], bsem).wait()
+        return 0
+
+    lax.fori_loop(0, nv, issue, 0)
+    lax.fori_loop(0, nv, drain, 0)
+
+    @pl.when((slv >= 0) & ((j == 0) | (base < chain)))
+    def _compute():
+        bv, be = _paged_bq_score_topk(a_ref[0], code_s[...], scale_s[...],
+                                      bias_s[...], nv * R, alpha, kf, w,
+                                      approx_ok)
+        be = be + j * w
+
+        if n_sub == 1:
+            outv_ref[0] = bv
+            oute_ref[0] = be
+            return
+
+        @pl.when(j == 0)
+        def _():
+            outv_ref[0] = bv
+            oute_ref[0] = be
+
+        @pl.when(j > 0)
+        def _():
+            cv = jnp.concatenate([outv_ref[0], bv], axis=1)
+            ce = jnp.concatenate([oute_ref[0], be], axis=1)
+            mv, me = ss._extract_topk(cv, ce, kf)
+            outv_ref[0] = mv
+            oute_ref[0] = me
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
+                     "kf", "interpret", "approx_ok"),
+)
+def _paged_bq_class_call(strip_list, table_flat, chain_pages, a_grouped,
+                         codes, scale_pool, bias_pool, ppf: int, n_sub: int,
+                         page_rows: int, table_width: int, alpha: float,
+                         kf: int, interpret: bool, approx_ok: bool = False):
+    s_pad, c, rot_dim = a_grouped.shape
+    w = ppf * page_rows
+
+    if n_sub > 1:
+        grid = (s_pad, n_sub)
+        a_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i),
+                                          0, 0)
+    else:
+        grid = (s_pad,)
+        a_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, c, rot_dim), a_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[pl.BlockSpec((1, c, kf), o_map)] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((w, codes.shape[-1]), codes.dtype),
+            pltpu.VMEM((1, w), jnp.float32),
+            pltpu.VMEM((1, w), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    ov, oe = pl.pallas_call(
+        functools.partial(_paged_bq_kernel, alpha=alpha, kf=kf, w=w,
+                          n_sub=n_sub, ppf=ppf, page_rows=page_rows,
+                          table_width=table_width, approx_ok=approx_ok),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.float32),
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
+        ),
+        interpret=interpret,
+    )(strip_list, table_flat, chain_pages, a_grouped, codes, scale_pool,
+      bias_pool)
+    return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
+            lax.slice_in_dim(oe, 0, s_pad, axis=0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
+                     "kf", "approx_ok"),
+)
+def _paged_bq_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
+                        codes, scale_pool, bias_pool, ppf: int, n_sub: int,
+                        page_rows: int, table_width: int, alpha: float,
+                        kf: int, approx_ok: bool = False):
+    """jnp reference of the paged packed scan (shared
+    :func:`_paged_bq_score_topk`; the bit-parity oracle)."""
+    w = ppf * page_rows
+    table2 = table_flat.reshape(-1, table_width)
+
+    def one_strip(args):
+        sl, a = args
+        l = jnp.maximum(sl, 0)
+        chain = jnp.where(sl >= 0, chain_pages[l], 0)
+        trow = table2[l]
+
+        def sub(j, carry):
+            ov, oe = carry
+            pidx = jnp.maximum(
+                lax.dynamic_slice_in_dim(trow, j * ppf, ppf), 0)
+            blk = codes[pidx].reshape(w, codes.shape[-1])
+            srow = scale_pool[pidx].reshape(1, w)
+            brow = bias_pool[pidx].reshape(1, w)
+            live = jnp.clip(chain - j * ppf, 0, ppf) * page_rows
+            bv, be = _paged_bq_score_topk(a, blk, srow, brow, live, alpha,
+                                          kf, w, approx_ok)
+            be = be + j * w
+            if n_sub == 1:
+                return bv, be
+            cv = jnp.concatenate([ov, bv], axis=1)
+            ce = jnp.concatenate([oe, be], axis=1)
+            mv, me = ss._extract_topk(cv, ce, kf)
+            first = j == 0
+            dead = jnp.logical_and(jnp.logical_not(first),
+                                   j * ppf >= chain)
+            out_v = jnp.where(first, bv, jnp.where(dead, ov, mv))
+            out_e = jnp.where(first, be, jnp.where(dead, oe, me))
+            return out_v, out_e
+
+        init = (jnp.full((C, kf), jnp.inf, jnp.float32),
+                jnp.zeros((C, kf), jnp.int32))
+        return lax.fori_loop(0, n_sub, sub, init)
+
+    return lax.map(one_strip, (strip_list, a_grouped))
+
+
+def paged_bq_search_traced(queries_rot, probes, codes, scale_pool,
+                           bias_pool, page_ids, table, chain_pages, k: int,
+                           kf: int, alpha: float, q_tile: int,
+                           interpret: bool, pair_const=None,
+                           approx_ok: bool = False, impl: str = "pallas"):
+    """Sync-free paged packed strip search — the
+    :func:`strip_scan.paged_strip_search_traced` protocol with the packed
+    B operand and the per-row RaBitQ scale pool. All operands are
+    capacity-shaped (zero-recompile serving contract)."""
+    from raft_tpu.ops.strip_scan import (PagedIds, _plan_device, paged_plan,
+                                         static_layout)
+
+    q, p = probes.shape
+    n_lists, table_width = table.shape
+    page_rows = codes.shape[1]
+    ppf, n_sub, w = paged_plan(table_width, page_rows,
+                               int(codes.shape[-1]), kf)
+    if kf > w:
+        raise ValueError(
+            f"paged packed scan needs kf <= fetch block ({w} rows), got "
+            f"{kf}")
+    classes = ((ppf, n_sub),)
+    class_counts = (n_lists,)
+    cls_ord = jnp.zeros((n_lists,), jnp.int32)
+    table_flat = table.reshape(-1)
+    translator = PagedIds(page_ids, table, page_rows)
+
+    out_v, out_i = [], []
+    for start in range(0, q, q_tile):
+        qt = min(q_tile, q - start)
+        region_starts, s_tot, layout = static_layout(
+            classes, class_counts, qt, p)
+        qids, strip_list, pair_strip, pair_slot, _ = _plan_device(
+            lax.slice_in_dim(probes, start, start + qt, axis=0),
+            cls_ord, n_lists, region_starts, s_tot,
+        )
+        a_grouped = jnp.where(
+            (qids >= 0)[:, :, None],
+            lax.slice_in_dim(queries_rot, start, start + qt,
+                             axis=0)[jnp.clip(qids, 0), :],
+            0,
+        ).astype(jnp.bfloat16)
+        fn = (_paged_bq_class_call if impl == "pallas"
+              else _paged_bq_class_jnp)
+        kwargs = {"interpret": interpret} if impl == "pallas" else {}
+        ov, oe = fn(strip_list, table_flat, chain_pages, a_grouped, codes,
+                    scale_pool, bias_pool, ppf, n_sub, page_rows,
+                    table_width, alpha, kf, approx_ok=approx_ok, **kwargs)
+        v, i = ss.merge_strip_candidates(
+            ov, oe, strip_list, pair_strip, pair_slot, translator, layout,
+            k, kf, interpret,
+            None if pair_const is None
+            else lax.slice_in_dim(pair_const, start, start + qt, axis=0))
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
+
+
 def occupancy_stats(lens, m: int, q: int, p: int, rot_dim: int,
                     workspace_bytes: int = 1 << 30, kf: int = 10) -> dict:
     """Static occupancy diagnostics of one packed-scan dispatch: the strip

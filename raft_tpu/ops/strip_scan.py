@@ -822,6 +822,421 @@ def occupancy_stats(lens, m: int, q: int, p: int, dim: int = 0,
     }
 
 
+# ---------------------------------------------------------------------------
+# Paged strip scan (serving): the SAME strip engine over a PagedListStore's
+# page chains — HBM→VMEM page DMAs instead of contiguous list blocks
+# ---------------------------------------------------------------------------
+#
+# The Ragged Paged Attention pattern (PAPERS.md): the kernel takes the
+# store's page table + chain lengths as scalar-prefetch operands and
+# issues one ``make_async_copy`` per live page (the ops/cagra_hop.py
+# double-semaphore machinery), so mutable paged storage is scanned IN
+# PLACE at strip-kernel throughput — no gather materialization, no
+# repack. Every list is planned at its CAPACITY length (table_width ×
+# page_rows rows — one length class, so the compiled layout depends only
+# on capacity and the zero-recompile serving contract holds), but the
+# kernel only moves a chain's LIVE pages: dead sub-blocks skip both the
+# DMAs and the compute, costing grid bookkeeping like padding strips.
+# Tombstoned rows and tail fills self-mask through the store-maintained
+# ``page_bias`` pool (+inf at dead slots — the packed kernels' trash-row
+# convention); rows past the live page count are masked in-kernel by a
+# lane iota against the chain length, so stale VMEM scratch never scores.
+#
+# Two implementations, bit-identical by construction (the ops/bq_scan.py
+# precedent): ``impl="pallas"`` (the kernel; interpret-mode on CPU) and
+# ``impl="jnp"`` (a lax.map reference driving the SAME per-block compute,
+# :func:`_paged_score_topk`) — the parity oracle tier-1 pins.
+
+
+def paged_plan(table_width: int, page_rows: int, row_bytes: int,
+               kf: int) -> Tuple[int, int, int]:
+    """Static fetch plan for one paged scan: ``(pages_per_fetch, n_sub,
+    w)`` with ``w = pages_per_fetch · page_rows`` rows per grid step.
+
+    The block must cover ``kf`` rows (the running per-pair top-kf can
+    never recover candidates a narrower block dropped), aims for the
+    packed kernel's ``MC`` granule, and stays inside the mantissa-packing
+    bound (w ≤ 4096, ops/strip_scan._PACK_BITS) and a ~4 MB VMEM payload
+    budget. ``table_width`` is a power of two (the store grows it
+    geometrically), so ``pages_per_fetch`` always divides it."""
+    W, R = int(table_width), int(page_rows)
+
+    def _ok(p_):
+        w_ = p_ * R
+        return w_ <= (1 << _PACK_BITS) and w_ * max(1, row_bytes) <= (4 << 20)
+
+    ppf = 1
+    while ppf < W and ppf * R < min(max(kf, MC), 1 << _PACK_BITS):
+        ppf *= 2
+    while ppf < W and _ok(ppf * 2):
+        ppf *= 2
+    while ppf > 1 and not _ok(ppf):
+        ppf //= 2
+    return ppf, max(1, W // ppf), ppf * R
+
+
+def paged_eligible(table_width: int, page_rows: int, row_bytes: int,
+                   k: int) -> bool:
+    """True when the paged Pallas engine can serve this store/k: the plan's
+    block covers k (pack-bits + VMEM budget permitting) and the page
+    height is sane. Callers fall back to the gather scan otherwise."""
+    if page_rows < 8 or k > 512:
+        return False
+    _, _, w = paged_plan(table_width, page_rows, row_bytes, int(k))
+    return int(k) <= min(w, table_width * page_rows, 1 << _PACK_BITS)
+
+
+def _paged_score_topk(a, block, bias_row, live_rows, alpha: float, kf: int,
+                      w: int, approx_ok: bool):
+    """One paged block's scores + fused top-kf — THE shared compute of the
+    kernel and the jnp reference (both feed it the same operands, which is
+    what makes the two paths bit-identical).
+
+    a: (C, dim) query block; block: (w, dim) payload rows (any fetch
+    order-stable dtype — fp32/bf16/int8 upcast like the packed kernel);
+    bias_row: (1, w) per-row additive term; live_rows: scalar — rows at
+    lane >= live_rows are DEAD (absent pages / stale scratch) and masked
+    to +inf AFTER the add, so garbage payload (even NaN) never ranks."""
+    b = block.astype(jnp.bfloat16)
+    s = lax.dot_general(a.astype(jnp.bfloat16), b, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    s = alpha * s + bias_row
+    lanes = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(lanes < live_rows, s, jnp.inf)
+    return _topk_block(s, kf, w, approx_ok)
+
+
+def _paged_strip_kernel(sl_ref, tbl_ref, chain_ref, a_ref, pages_hbm,
+                        bias_hbm, outv_ref, oute_ref, pay_s, bias_s,
+                        psem, bsem, *, alpha, kf, w, n_sub, ppf,
+                        page_rows, table_width, approx_ok):
+    """One (strip × page sub-block): DMA the live pages HBM→VMEM, then the
+    shared matmul + fused top-kf. Scalar prefetch carries the strip table
+    (``sl``), the flattened page table and the per-list chain lengths;
+    only live pages are copied (a dynamic-trip fori_loop — the Ragged
+    Paged Attention fetch shape), dead sub-blocks and padding strips skip
+    the body entirely."""
+    i = pl.program_id(0)
+    slv = sl_ref[i]
+    j = pl.program_id(1) if n_sub > 1 else 0
+    l = jnp.maximum(slv, 0)
+    chain = jnp.where(slv >= 0, chain_ref[l], 0)   # live pages in the list
+    base = j * ppf
+    nv = jnp.clip(chain - base, 0, ppf)            # live pages this block
+    R = page_rows
+
+    # issue every copy before draining any: latencies overlap; the two
+    # semaphores drain exactly the issued bytes (ops/cagra_hop pattern)
+    def issue(t, _):
+        pid = tbl_ref[l * table_width + base + t]
+        pltpu.make_async_copy(pages_hbm.at[pid],
+                              pay_s.at[pl.ds(t * R, R)], psem).start()
+        pltpu.make_async_copy(bias_hbm.at[pid],
+                              bias_s.at[0, pl.ds(t * R, R)], bsem).start()
+        return 0
+
+    def drain(t, _):
+        pid = tbl_ref[l * table_width + base + t]
+        pltpu.make_async_copy(pages_hbm.at[pid],
+                              pay_s.at[pl.ds(t * R, R)], psem).wait()
+        pltpu.make_async_copy(bias_hbm.at[pid],
+                              bias_s.at[0, pl.ds(t * R, R)], bsem).wait()
+        return 0
+
+    lax.fori_loop(0, nv, issue, 0)
+    lax.fori_loop(0, nv, drain, 0)
+
+    # j == 0 always writes (a strip's outputs must be defined even for an
+    # empty list — all-+inf, which the merge translates to id -1); later
+    # sub-blocks past the chain end keep the running top-kf untouched
+    @pl.when((slv >= 0) & ((j == 0) | (base < chain)))
+    def _compute():
+        bv, be = _paged_score_topk(a_ref[0], pay_s[...], bias_s[...],
+                                   nv * R, alpha, kf, w, approx_ok)
+        be = be + j * w
+
+        if n_sub == 1:
+            outv_ref[0] = bv
+            oute_ref[0] = be
+            return
+
+        @pl.when(j == 0)
+        def _():
+            outv_ref[0] = bv
+            oute_ref[0] = be
+
+        @pl.when(j > 0)
+        def _():
+            cv = jnp.concatenate([outv_ref[0], bv], axis=1)   # (C, 2kf)
+            ce = jnp.concatenate([oute_ref[0], be], axis=1)
+            mv, me = _extract_topk(cv, ce, kf)
+            outv_ref[0] = mv
+            oute_ref[0] = me
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
+                     "kf", "interpret", "approx_ok"),
+)
+def _paged_class_call(strip_list, table_flat, chain_pages, a_grouped,
+                      pages, bias_pool, ppf: int, n_sub: int,
+                      page_rows: int, table_width: int, alpha: float,
+                      kf: int, interpret: bool, approx_ok: bool = False):
+    """Run the (single) paged length class through the Pallas kernel:
+    grid (S,) or (S, n_sub); pages/bias stay HBM-resident (memory_space
+    ANY) and are fetched per grid step by the kernel's own DMAs."""
+    s_pad, c, dim = a_grouped.shape
+    w = ppf * page_rows
+
+    if n_sub > 1:
+        grid = (s_pad, n_sub)
+        a_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i),
+                                          0, 0)
+    else:
+        grid = (s_pad,)
+        a_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, c, dim), a_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[pl.BlockSpec((1, c, kf), o_map)] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((w, pages.shape[-1]), pages.dtype),
+            pltpu.VMEM((1, w), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    ov, oe = pl.pallas_call(
+        functools.partial(_paged_strip_kernel, alpha=alpha, kf=kf, w=w,
+                          n_sub=n_sub, ppf=ppf, page_rows=page_rows,
+                          table_width=table_width, approx_ok=approx_ok),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.float32),
+            jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
+        ),
+        interpret=interpret,
+    )(strip_list, table_flat, chain_pages, a_grouped, pages, bias_pool)
+    return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
+            lax.slice_in_dim(oe, 0, s_pad, axis=0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
+                     "kf", "approx_ok"),
+)
+def _paged_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
+                     pages, bias_pool, ppf: int, n_sub: int,
+                     page_rows: int, table_width: int, alpha: float,
+                     kf: int, approx_ok: bool = False):
+    """Pure-jnp reference for the paged class: the SAME per-(strip,
+    sub-block) op sequence as the kernel — shared :func:`_paged_score_topk`,
+    same ``_extract_topk`` sub-block merge, same skip predicate for dead
+    sub-blocks — driven by a sequential ``lax.map`` over strips. This IS
+    the jnp gather path of the paged engine: pages are fetched with jnp
+    advanced indexing and scored identically, so tier-1 pins bitwise
+    (ids + values) parity against the kernel."""
+    w = ppf * page_rows
+    table2 = table_flat.reshape(-1, table_width)
+
+    def one_strip(args):
+        sl, a = args
+        l = jnp.maximum(sl, 0)
+        chain = jnp.where(sl >= 0, chain_pages[l], 0)
+        trow = table2[l]
+
+        def sub(j, carry):
+            ov, oe = carry
+            pidx = jnp.maximum(
+                lax.dynamic_slice_in_dim(trow, j * ppf, ppf), 0)
+            blk = pages[pidx].reshape(w, pages.shape[-1])
+            brow = bias_pool[pidx].reshape(1, w)
+            live = jnp.clip(chain - j * ppf, 0, ppf) * page_rows
+            bv, be = _paged_score_topk(a, blk, brow, live, alpha, kf, w,
+                                       approx_ok)
+            be = be + j * w
+            if n_sub == 1:
+                return bv, be
+            cv = jnp.concatenate([ov, bv], axis=1)
+            ce = jnp.concatenate([oe, be], axis=1)
+            mv, me = _extract_topk(cv, ce, kf)
+            # j == 0 initializes exactly like the kernel's first write;
+            # dead sub-blocks keep the running top-kf (kernel skip path)
+            first = j == 0
+            dead = jnp.logical_and(jnp.logical_not(first),
+                                   j * ppf >= chain)
+            out_v = jnp.where(first, bv, jnp.where(dead, ov, mv))
+            out_e = jnp.where(first, be, jnp.where(dead, oe, me))
+            return out_v, out_e
+
+        init = (jnp.full((C, kf), jnp.inf, jnp.float32),
+                jnp.zeros((C, kf), jnp.int32))
+        return lax.fori_loop(0, n_sub, sub, init)
+
+    return lax.map(one_strip, (strip_list, a_grouped))
+
+
+class PagedIds:
+    """Lazy (list, in-list offset) → source-id translator with the 2-D
+    advanced-indexing surface :func:`merge_strip_candidates` expects, so
+    the merge is reused UNCHANGED: offset ``o`` of list ``l`` dereferences
+    through the page table to ``page_ids[table[l, o // R], o % R]``; absent
+    pages answer -1 (their candidates are +inf and already masked)."""
+
+    __slots__ = ("page_ids", "table", "page_rows")
+
+    def __init__(self, page_ids, table, page_rows: int):
+        self.page_ids = page_ids
+        self.table = table
+        self.page_rows = int(page_rows)
+
+    def __getitem__(self, idx):
+        win_list, win_off = idx
+        pg = self.table[win_list, win_off // self.page_rows]
+        ids = self.page_ids[jnp.maximum(pg, 0), win_off % self.page_rows]
+        return jnp.where(pg >= 0, ids, -1)
+
+
+def paged_strip_search_traced(queries_mat, probes, pages, bias_pool,
+                              page_ids, table, chain_pages, k: int, kf: int,
+                              alpha: float, q_tile: int, interpret: bool,
+                              pair_const=None, approx_ok: bool = False,
+                              impl: str = "pallas"):
+    """Sync-free paged strip search — fully traceable, so family callers
+    fuse coarse quantizer + device planning + paged kernel + merge +
+    finalize into ONE dispatch (the ``strip_search_traced`` protocol over
+    page chains).
+
+    pages: (capacity_pages, page_rows, row_width) payload pool.
+    bias_pool: (capacity_pages, page_rows) fp32 — the store-maintained
+    per-row additive term, +inf at tombstones/tail fills. table:
+    (n_lists, table_width) int32 page table, -1 at absent slots.
+    chain_pages: (n_lists,) int32 live pages per list. Every operand is
+    CAPACITY-shaped: steady-state upserts/deletes re-dispatch this same
+    compiled program (the zero-recompile serving contract)."""
+    q, p = probes.shape
+    n_lists, table_width = table.shape
+    page_rows = pages.shape[1]
+    ppf, n_sub, w = paged_plan(
+        table_width, page_rows,
+        int(pages.shape[-1]) * pages.dtype.itemsize, kf)
+    if kf > w:
+        # the running per-pair top-kf can never recover candidates a
+        # narrower fetch block dropped — refuse instead of silently
+        # truncating (callers route ineligible stores to the gather path)
+        raise ValueError(
+            f"paged strip scan needs kf <= fetch block ({w} rows), got "
+            f"{kf}; use the gather backend")
+    # one capacity length class: the layout depends only on capacity
+    classes = ((ppf, n_sub),)
+    class_counts = (n_lists,)
+    cls_ord = jnp.zeros((n_lists,), jnp.int32)
+    table_flat = table.reshape(-1)
+    translator = PagedIds(page_ids, table, page_rows)
+
+    out_v, out_i = [], []
+    for start in range(0, q, q_tile):
+        qt = min(q_tile, q - start)
+        region_starts, s_tot, layout = static_layout(
+            classes, class_counts, qt, p)
+        qids, strip_list, pair_strip, pair_slot, _ = _plan_device(
+            lax.slice_in_dim(probes, start, start + qt, axis=0),
+            cls_ord, n_lists, region_starts, s_tot,
+        )
+        a_grouped = jnp.where(
+            (qids >= 0)[:, :, None],
+            lax.slice_in_dim(queries_mat, start, start + qt,
+                             axis=0)[jnp.clip(qids, 0), :],
+            0,
+        ).astype(jnp.bfloat16)
+        fn = _paged_class_call if impl == "pallas" else _paged_class_jnp
+        kwargs = {"interpret": interpret} if impl == "pallas" else {}
+        ov, oe = fn(strip_list, table_flat, chain_pages, a_grouped, pages,
+                    bias_pool, ppf, n_sub, page_rows, table_width, alpha,
+                    kf, approx_ok=approx_ok, **kwargs)
+        v, i = merge_strip_candidates(
+            ov, oe, strip_list, pair_strip, pair_slot, translator, layout,
+            k, kf, interpret,
+            None if pair_const is None
+            else lax.slice_in_dim(pair_const, start, start + qt, axis=0))
+        out_v.append(v)
+        out_i.append(i)
+    if len(out_v) == 1:
+        return out_v[0], out_i[0]
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)
+
+
+def paged_occupancy_stats(table_width: int, page_rows: int, chain_pages,
+                          live_rows: int, tombstones: int, q: int, p: int,
+                          k: int, row_bytes: int,
+                          workspace_bytes: int = 1 << 30,
+                          dim: int = 0) -> dict:
+    """Static occupancy diagnostics of one paged-Pallas dispatch, from the
+    SAME planning code the dispatch uses (:func:`paged_plan` +
+    ``static_layout``) — the round-15 standing gate for new hot-path
+    kernels. Beyond the strip numbers, the paged plane's own wastes:
+
+    * ``page_fill`` — live rows over the slots of the pages actually
+      chained (tail-fill waste the DMA still moves);
+    * ``tombstone_fraction`` — tombstoned slots over chained-page slots
+      (the waste background compaction reclaims);
+    * ``chain_fill`` — chained pages over table capacity (how much of the
+      capacity-planned grid the skip path prunes).
+
+    ``chain_pages`` is the per-list live page count (numpy)."""
+    chain_np = np.maximum(np.asarray(chain_pages, np.int64), 0)
+    n_lists = int(chain_np.shape[0])
+    kf = min(int(k), 512)
+    ppf, n_sub, w = paged_plan(table_width, page_rows, row_bytes, kf)
+    classes = ((ppf, n_sub),)
+    class_counts = (n_lists,)
+    q_tile = fit_q_tile(q, p, n_lists, 1, kf, workspace_bytes, dim=dim,
+                        class_counts=class_counts)
+    qt = min(q_tile, q) or 1
+    _, s_tot, layout = static_layout(classes, class_counts, qt, p)
+    strips_best = _ceil_div(qt * p, C)
+    chained = int(chain_np.sum())
+    chained_slots = chained * int(page_rows)
+    cap_slots = n_lists * int(table_width) * int(page_rows)
+    live = max(0, int(live_rows))
+    dead = max(0, int(tombstones))
+    return {
+        "grid": [[int(cnt), int(ns), int(wb)]
+                 for (wb, ns, _s, cnt) in layout],
+        "pages_per_fetch": int(ppf),
+        "n_sub": int(n_sub),
+        "w": int(w),
+        "strips_padded": int(s_tot),
+        "strips_real_bestcase": int(strips_best),
+        "padded_strip_fraction": round(
+            max(0.0, 1.0 - strips_best / s_tot), 4) if s_tot else 0.0,
+        "tile_fill": round(min(1.0, qt * p / (strips_best * C)), 4)
+        if strips_best else 0.0,
+        "page_fill": round(live / chained_slots, 4) if chained_slots
+        else 0.0,
+        "tombstone_fraction": round(dead / chained_slots, 4)
+        if chained_slots else 0.0,
+        "chain_fill": round(chained / (n_lists * table_width), 4)
+        if n_lists * table_width else 0.0,
+        "padded_row_fraction": round(
+            max(0.0, 1.0 - live / chained_slots), 4) if chained_slots
+        else 0.0,
+        "capacity_slots": cap_slots,
+        "q_tile": int(qt),
+        "c": C,
+    }
+
+
 def strip_search(
     queries_mat,
     probes,

@@ -2,13 +2,16 @@
 
 The subsystem that turns the repo's build-once/search-once bench shape
 into a system that serves streaming traffic (ROADMAP item 2): a
-:class:`PagedListStore` gives ivf_flat / ivf_pq indexes an online mutable
-storage layout — fixed-size pages per list, appended on
+:class:`PagedListStore` gives ivf_flat / ivf_pq / ivf_bq indexes an
+online mutable storage layout — fixed-size pages per list, appended on
 :meth:`~PagedListStore.upsert`, tombstoned on
-:meth:`~PagedListStore.delete`, scanned without recompile, folded back to
-the packed snapshot layout by :meth:`~PagedListStore.compact` — and a
-:class:`QueryQueue` coalesces one-at-a-time requests with per-request
-deadlines into dynamically sized device batches under a latency SLO.
+:meth:`~PagedListStore.delete`, scanned without recompile (the paged
+Pallas strip engines on TPU, the jnp gather scans elsewhere), folded
+back to the packed snapshot layout by :meth:`~PagedListStore.compact` —
+a :class:`QueryQueue` coalesces one-at-a-time requests with per-request
+deadlines into dynamically sized device batches under a latency SLO, and
+a :class:`CompactionManager` reclaims tombstones off the hot path when
+the tombstone ratio crosses ``RAFT_TPU_SERVING_COMPACT_RATIO``.
 
 Usage::
 
@@ -32,25 +35,43 @@ Usage::
 from raft_tpu import obs
 from raft_tpu.core.trace import traced
 from raft_tpu.neighbors import _packing
+from raft_tpu.neighbors import ivf_bq as _ivf_bq
 from raft_tpu.neighbors import ivf_flat as _ivf_flat
 from raft_tpu.neighbors import ivf_pq as _ivf_pq
 from raft_tpu.serving.batching import QueryQueue, RequestHandle
+from raft_tpu.serving.compaction import (
+    COMPACT_DEADLINE_ENV,
+    COMPACT_INTERVAL_ENV,
+    COMPACT_RATIO_ENV,
+    CompactionManager,
+    default_compact_deadline,
+    default_compact_ratio,
+)
 from raft_tpu.serving.store import (
     PAGE_ROWS_ENV,
     PagedListStore,
     default_page_rows,
 )
 
+_FAMILY = {"ivf_flat": _ivf_flat, "ivf_pq": _ivf_pq, "ivf_bq": _ivf_bq}
+
 
 @traced("serving::search")
 def search(store: PagedListStore, queries, k: int, n_probes: int = 20,
            **kwargs):
     """Search a paged store through its kind's paged scan path
-    (``ivf_flat.search_paged`` / ``ivf_pq.search_paged``)."""
-    mod = _ivf_flat if store.kind == "ivf_flat" else _ivf_pq
+    (``ivf_flat.search_paged`` / ``ivf_pq.search_paged`` /
+    ``ivf_bq.search_paged``)."""
     if obs.enabled():
         obs.add("serving.searches")
-    return mod.search_paged(store, queries, k, n_probes=n_probes, **kwargs)
+    return _FAMILY[store.kind].search_paged(store, queries, k,
+                                            n_probes=n_probes, **kwargs)
+
+
+def paged_engine(store: PagedListStore, k: int) -> str:
+    """The engine ``backend="auto"`` resolves to for this store/k on the
+    current jax backend — what the bench stamps as ``paged_engine``."""
+    return _ivf_flat.paged_backend_auto(store, k)
 
 
 def searcher(store: PagedListStore, k: int, n_probes: int = 20, **kwargs):
@@ -74,11 +95,18 @@ def scan_trace_count() -> int:
 
 
 __all__ = [
+    "COMPACT_DEADLINE_ENV",
+    "COMPACT_INTERVAL_ENV",
+    "COMPACT_RATIO_ENV",
+    "CompactionManager",
     "PAGE_ROWS_ENV",
     "PagedListStore",
     "QueryQueue",
     "RequestHandle",
+    "default_compact_deadline",
+    "default_compact_ratio",
     "default_page_rows",
+    "paged_engine",
     "scan_trace_count",
     "search",
     "searcher",

@@ -24,14 +24,30 @@ paged scan's working set is bounded by the static ``(n_probes ×
 table_width × page_rows)`` gather, sized against the Resources workspace
 budget exactly like the packed gather scan.
 
-Two page payloads, one mechanism:
+Three page payloads, one mechanism:
 
 * ``kind="ivf_flat"`` — pages hold raw vectors (same dtype as the
   template index's ``list_data``); per-row aux is the cached L2 norm.
 * ``kind="ivf_pq"`` — pages hold packed PQ codes encoded with the
   template index's frozen quantizers (centers/rotation/codebooks); per-row
   aux is the list-side LUT half (``b_sum``), bit-identical to the packed
-  build's (the same ``_compute_b_sum`` formula, gathered per row).
+  build's (the same ``_compute_b_sum`` formula, gathered per row). A
+  second ``page_cache`` pool carries the int8 decoded-residual rows the
+  paged Pallas scan contracts on the MXU (the packed path's
+  ``IvfPqIndex.decoded`` cache, paged).
+* ``kind="ivf_bq"`` — pages hold packed 1-bit sign codes (rot_dim/8
+  bytes/row, ops/bq_scan layout); per-row aux is the estimator's additive
+  term and a ``page_scale`` pool carries the RaBitQ unbiasing factor
+  ``f = ‖u‖²/‖u‖₁`` — both produced by the SAME ``_encode_chunk`` the
+  packed build uses, so paged↔packed parity holds bitwise.
+
+Round 16 (paged Pallas data plane): every store also maintains a
+``page_bias`` pool — the per-row additive bias the strip kernels consume
+directly (+inf at tombstones and never-filled tail slots, the packed
+kernels' padding convention). Appends write it through the same scatter
+that lands the payload; ``delete`` re-stamps +inf in the same dispatch
+that tombstones ``page_ids`` — so the paged Pallas scans read the pools
+IN PLACE with no per-search bias materialization.
 
 ``compact()`` folds the live rows back into the packed representation
 (an :class:`~raft_tpu.neighbors.ivf_flat.IvfFlatIndex` /
@@ -60,6 +76,7 @@ from raft_tpu.obs import compile as obs_compile
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.trace import traced
+from raft_tpu.neighbors import ivf_bq as ivf_bq_mod
 from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors._packing import pack_lists
@@ -81,29 +98,42 @@ def _pow2_at_least(v: int) -> int:
 
 
 @jax.jit
-def _tombstone(page_ids, pp, rr):
-    """Scatter -1 into (pp, rr) slots; sentinel coords >= capacity drop."""
+def _tombstone(page_ids, page_bias, pp, rr):
+    """Scatter -1 ids and +inf bias into (pp, rr) slots in ONE dispatch;
+    sentinel coords >= capacity drop. The bias stamp is what makes a
+    tombstone invisible to the paged Pallas scans (they read the bias pool
+    in place instead of masking on ids)."""
     # ledger registration: pow2-bucketed coords compile O(log) programs —
-    # each one lands attributed (obs/compile.py; trace time only)
+    # each one lands attributed (obs/compile.py; trace time only). The
+    # event runs at TRACE time, so a delete-heavy burst of an already-
+    # compiled bucket does zero ledger host work (tier-1 pins the count).
     obs_compile.trace_event("serving.tombstone", page_ids=page_ids,
-                            pp=pp, rr=rr)
-    return page_ids.at[pp, rr].set(-1, mode="drop")
+                            page_bias=page_bias, pp=pp, rr=rr)
+    return (page_ids.at[pp, rr].set(-1, mode="drop"),
+            page_bias.at[pp, rr].set(jnp.inf, mode="drop"))
 
 
-def _scatter_rows(pages, page_ids, page_aux, payload, ids, aux, pp, rr):
+def _scatter_rows(pages, page_ids, page_aux, page_bias, extra_pool,
+                  payload, ids, aux, bias, extra_rows, pp, rr):
     """Append scatter: one dispatch per (bucketed) chunk. Padded entries
     carry ``pp == capacity`` which ``mode="drop"`` discards. jit'd below —
     kept un-donated: on a failed dispatch the caller's arrays must stay
-    valid (upsert commits host metadata only after the scatter lands)."""
+    valid (upsert commits host metadata only after the scatter lands).
+    ``extra_pool`` is the kind-specific second pool (PQ decoded cache /
+    BQ scale) or None."""
     # ledger registration: a capacity-growth retrace lands attributed to
     # the pool operand that grew (obs/compile.py; trace time only)
     obs_compile.trace_event("serving.scatter", pages=pages,
                             page_ids=page_ids, page_aux=page_aux,
+                            page_bias=page_bias, extra_pool=extra_pool,
                             payload=payload, ids=ids, aux=aux, pp=pp, rr=rr)
     pages = pages.at[pp, rr].set(payload, mode="drop")
     page_ids = page_ids.at[pp, rr].set(ids, mode="drop")
     page_aux = page_aux.at[pp, rr].set(aux, mode="drop")
-    return pages, page_ids, page_aux
+    page_bias = page_bias.at[pp, rr].set(bias, mode="drop")
+    if extra_pool is not None:
+        extra_pool = extra_pool.at[pp, rr].set(extra_rows, mode="drop")
+    return pages, page_ids, page_aux, page_bias, extra_pool
 
 
 _scatter_rows = jax.jit(_scatter_rows)
@@ -138,12 +168,14 @@ class PagedListStore:
                  pq_dim: int = 0, codebook_kind: str = "subspace",
                  initial_pages: int = 0,
                  res: Optional[Resources] = None):
-        if kind not in ("ivf_flat", "ivf_pq"):
+        if kind not in ("ivf_flat", "ivf_pq", "ivf_bq"):
             raise ValueError(f"unknown store kind {kind!r}")
         if kind == "ivf_pq" and codebook_kind != "subspace":
             raise ValueError(
                 "paged ivf_pq serving supports codebook_kind='subspace' "
                 "only (the per-cluster LUT scan has no paged path yet)")
+        if kind == "ivf_bq" and rotation is None:
+            raise ValueError("ivf_bq stores need the index rotation")
         self.kind = kind
         self.metric = metric
         self.centers = jnp.asarray(centers)
@@ -164,6 +196,23 @@ class PagedListStore:
         # aux init +inf: matches the packed b_sum's +inf-at-padding
         # convention (the flat scan masks on ids, so +inf is inert there)
         self.page_aux = jnp.full((cap, R), jnp.inf, jnp.float32)
+        # scan-bias pool for the paged Pallas engines: +inf everywhere a
+        # row is absent/dead, the per-row additive term where live
+        self.page_bias = jnp.full((cap, R), jnp.inf, jnp.float32)
+        # kind-specific second pool: PQ int8 decoded-residual cache rows
+        # (the strip kernel's MXU operand), BQ per-row RaBitQ scale
+        self.page_cache = None
+        self.page_scale = None
+        if kind == "ivf_pq":
+            dsub = int(self.codebooks.shape[2])
+            self._cache_dim = self.pq_dim * dsub
+            self.page_cache = jnp.zeros((cap, R, self._cache_dim), jnp.int8)
+            # the packed path's data-independent dequant scale
+            # (ivf_pq._decode_lists: max|codebooks|/127)
+            self.decoded_scale = jnp.maximum(
+                jnp.max(jnp.abs(self.codebooks)), 1e-30) / 127.0
+        elif kind == "ivf_bq":
+            self.page_scale = jnp.zeros((cap, R), jnp.float32)
 
         self._table = np.full((n_lists, 4), -1, np.int32)
         self._list_pages = np.zeros(n_lists, np.int32)  # chain length
@@ -173,6 +222,8 @@ class PagedListStore:
         self._id_loc: Dict[int, Tuple[int, int]] = {}
         self._tombstones = 0
         self._dev_table = None  # device mirror, invalidated on table change
+        self._dev_lens = None   # device chain-length mirror (paged Pallas)
+        self._version = 0       # bumped on every committed mutation
         self._growths = 0
 
     # -- construction -------------------------------------------------------
@@ -198,6 +249,12 @@ class PagedListStore:
                 rotation=index.rotation, codebooks=index.codebooks,
                 pq_bits=index.pq_bits, pq_dim=index.pq_dim,
                 codebook_kind=index.codebook_kind, res=res)
+        elif isinstance(index, ivf_bq_mod.IvfBqIndex):
+            store = cls(
+                "ivf_bq", index.centers, index.metric, page_rows=page_rows,
+                payload_width=int(index.list_codes.shape[2]),
+                payload_dtype=index.list_codes.dtype,
+                rotation=index.rotation, res=res)
         else:
             raise TypeError(f"unsupported index type {type(index).__name__}")
         if include_rows:
@@ -207,15 +264,41 @@ class PagedListStore:
     def _ingest_packed(self, index) -> None:
         """Bulk-append the packed index's live rows, per-list in slot
         order (the arrival order a from-scratch upsert stream would have
-        produced). Payloads and aux are copied, not recomputed: the packed
-        build's values ARE the parity reference."""
+        produced). Payloads, aux, scan bias and the kind-specific extra
+        pool rows are copied (or derived exactly the way the packed scan
+        derives them), not recomputed: the packed build's values ARE the
+        parity reference."""
+        extra2 = None
         if self.kind == "ivf_flat":
             payload3, ids2 = index.list_data, index.list_ids
             aux2 = index.list_norms
             if aux2 is None:
                 aux2 = jnp.zeros_like(ids2, jnp.float32)
-        else:
+            bias2 = aux2  # _ragged_bias: norms (L2) / zeros (ip) at valid
+        elif self.kind == "ivf_pq":
             payload3, ids2, aux2 = index.list_codes, index.list_ids, index.b_sum
+            # scan bias = ‖R·c_l‖² + b_sum for L2 (the _ragged_bias_pq
+            # formula), b_sum alone for ip metrics
+            if self.metric in ("sqeuclidean", "euclidean"):
+                rc2 = ivf_pq_mod._center_rot_sqnorm(self.centers,
+                                                    self.rotation)
+                bias2 = rc2[:, None] + aux2
+            else:
+                bias2 = aux2
+            if index.decoded is None:
+                # lazy decode-cache fill (the _search_ragged_pq pattern) —
+                # cached back on the index so a later packed strip search
+                # reuses it
+                index.decoded, index.decoded_scale = ivf_pq_mod._decode_lists(
+                    index.codebooks, index.list_codes, pq_dim=index.pq_dim,
+                    pq_bits=index.pq_bits,
+                    cluster=index.codebook_kind == "cluster")
+            extra2 = index.decoded
+        else:  # ivf_bq: aux carries the additive term, extra the scale
+            payload3, ids2 = index.list_codes, index.list_ids
+            aux2 = jnp.where(index.list_ids >= 0, index.list_bias, 0.0)
+            bias2 = index.list_bias
+            extra2 = index.list_scale
         ids_np = np.asarray(ids2)
         n_lists, max_size = ids_np.shape
         flat_valid = ids_np.reshape(-1) >= 0
@@ -223,7 +306,12 @@ class PagedListStore:
         sel = np.nonzero(flat_valid)[0]
         payload = jnp.reshape(payload3, (-1,) + payload3.shape[2:])[sel]
         aux = jnp.reshape(aux2, (-1,))[sel]
-        self._append(payload, ids_np.reshape(-1)[sel], aux, labels_np[sel])
+        bias = jnp.reshape(bias2, (-1,))[sel]
+        extra = None
+        if extra2 is not None:
+            extra = jnp.reshape(extra2, (-1,) + extra2.shape[2:])[sel]
+        self._append(payload, ids_np.reshape(-1)[sel], aux, labels_np[sel],
+                     bias, extra)
 
     # -- introspection ------------------------------------------------------
     @property
@@ -261,6 +349,21 @@ class PagedListStore:
         each one retraces the scan; steady-state serving should hold at 0."""
         return self._growths
 
+    @property
+    def mutation_version(self) -> int:
+        """Monotonic counter bumped on every committed mutation (append,
+        tombstone, growth, compaction swap) — the optimistic-concurrency
+        token background compaction validates its snapshot against."""
+        with self._lock:
+            return self._version
+
+    @property
+    def tombstone_ratio(self) -> float:
+        """``tombstones / live rows`` — the background-compaction trigger
+        signal (``RAFT_TPU_SERVING_COMPACT_RATIO``)."""
+        with self._lock:
+            return self._tombstones / max(1, len(self._id_loc))
+
     def stats(self) -> dict:
         with self._lock:
             used = self.pages_used
@@ -271,7 +374,10 @@ class PagedListStore:
                 "page_rows": self.page_rows,
                 "table_width": self.table_width,
                 "fill_fraction": (self.size / max(1, used * self.page_rows)),
+                "tombstone_ratio": (self._tombstones
+                                    / max(1, len(self._id_loc))),
                 "growth_events": self._growths,
+                "mutation_version": self._version,
             }
 
     def device_table(self):
@@ -285,15 +391,31 @@ class PagedListStore:
 
     def scan_state(self):
         """One ATOMIC ``(pages, page_ids, page_aux, table)`` snapshot for
-        the paged scans. Mutators reassign these arrays under the lock;
-        reading them as separate unlocked attribute accesses could pair a
-        post-growth table with a pre-growth page pool (a torn snapshot
-        that scores candidates against the wrong payload), so searches
-        must come through here."""
+        the paged gather scans. Mutators reassign these arrays under the
+        lock; reading them as separate unlocked attribute accesses could
+        pair a post-growth table with a pre-growth page pool (a torn
+        snapshot that scores candidates against the wrong payload), so
+        searches must come through here."""
         with self._lock:
             if self._dev_table is None:
                 self._dev_table = jnp.asarray(self._table)
             return self.pages, self.page_ids, self.page_aux, self._dev_table
+
+    def paged_scan_state(self):
+        """One ATOMIC snapshot for the paged PALLAS scans:
+        ``(payload_pool, bias_pool, scale_pool_or_None, page_ids, table,
+        chain_pages)`` — the payload pool is the raw page pool for
+        flat/bq and the int8 decoded-residual cache for pq; chain_pages
+        is the device mirror of per-list live page counts (a
+        scalar-prefetch operand of the kernels)."""
+        with self._lock:
+            if self._dev_table is None:
+                self._dev_table = jnp.asarray(self._table)
+            if self._dev_lens is None:
+                self._dev_lens = jnp.asarray(self._list_pages)
+            payload = self.page_cache if self.kind == "ivf_pq" else self.pages
+            return (payload, self.page_bias, self.page_scale, self.page_ids,
+                    self._dev_table, self._dev_lens)
 
     # -- capacity -----------------------------------------------------------
     def _grow_pages(self, min_pages: int) -> None:
@@ -312,11 +434,23 @@ class PagedListStore:
         self.page_aux = jnp.concatenate(
             [self.page_aux, jnp.full((pad, self.page_rows), jnp.inf,
                                      jnp.float32)])
+        self.page_bias = jnp.concatenate(
+            [self.page_bias, jnp.full((pad, self.page_rows), jnp.inf,
+                                      jnp.float32)])
+        if self.page_cache is not None:
+            self.page_cache = jnp.concatenate(
+                [self.page_cache,
+                 jnp.zeros((pad,) + self.page_cache.shape[1:], jnp.int8)])
+        if self.page_scale is not None:
+            self.page_scale = jnp.concatenate(
+                [self.page_scale, jnp.zeros((pad, self.page_rows),
+                                            jnp.float32)])
         self._fill = np.concatenate([self._fill, np.zeros(pad, np.int32)])
         self._page_list = np.concatenate(
             [self._page_list, np.full(pad, -1, np.int32)])
         self._free.extend(range(old, new))
         self._growths += 1
+        self._version += 1
         obs.add("serving.store.capacity_growth")
         resilience.record_event("serving_capacity_growth",
                                 pages_from=old, pages_to=new)
@@ -329,6 +463,7 @@ class PagedListStore:
         self._table = grown
         self._dev_table = None
         self._growths += 1
+        self._version += 1
         obs.add("serving.store.table_growth")
 
     def reserve(self, n_rows: int, skew_factor: int = 4) -> None:
@@ -390,6 +525,7 @@ class PagedListStore:
                     self._list_pages[lab] = count + 1
                     self._page_list[tail] = lab
                     self._dev_table = None
+                    self._dev_lens = None
                 take = min(cnt - pos, page_rows - int(self._fill[tail]))
                 sel = idxs[pos:pos + take]
                 pp[sel] = tail
@@ -410,8 +546,12 @@ class PagedListStore:
         return np.asarray(labels)
 
     def _prepare_payload(self, work, labels_np):
-        """(payload, aux) rows for the store's page dtype — the same math
-        the packed build applies, so compact()/parity hold bitwise."""
+        """(payload, aux, bias, extra) rows for the store's page pools —
+        the same math the packed build applies, so compact()/parity hold
+        bitwise. ``bias`` is the scan-bias pool row (the packed kernels'
+        per-entry additive term), ``extra`` the kind-specific second pool
+        row (PQ decoded cache / BQ scale) or None."""
+        l2 = self.metric in ("sqeuclidean", "euclidean")
         if self.kind == "ivf_flat":
             if jnp.issubdtype(self.pages.dtype, jnp.integer):
                 info = jnp.iinfo(self.pages.dtype)
@@ -419,11 +559,19 @@ class PagedListStore:
                     .astype(self.pages.dtype)
             else:
                 payload = work.astype(self.pages.dtype)
-            if self.metric in ("sqeuclidean", "euclidean"):
+            if l2:
                 aux = _flat_row_aux(payload)
             else:
                 aux = jnp.zeros((work.shape[0],), jnp.float32)
-            return payload, aux
+            return payload, aux, aux, None
+        if self.kind == "ivf_bq":
+            labels = jnp.asarray(labels_np)
+            rot_dim = self.rotation.shape[0]
+            rc = ivf_pq_mod._pad_rot(self.centers, rot_dim) @ self.rotation.T
+            c2 = dist_mod.sqnorm(self.centers)
+            payload, scale, bias = ivf_bq_mod._encode_chunk(
+                work, labels, self.centers, self.rotation, rc, c2, l2)
+            return payload, bias, bias, scale
         labels = jnp.asarray(labels_np)
         resid = ivf_pq_mod._pad_rot(work - self.centers[labels],
                                     self.rotation.shape[0]) @ self.rotation.T
@@ -431,15 +579,25 @@ class PagedListStore:
         resid3 = resid.reshape(work.shape[0], self.pq_dim, dsub)
         codes = ivf_pq_mod._encode(resid3, self.codebooks)
         payload = ivf_pq_mod.pack_codes(codes, self.pq_bits)
-        if self.metric in ("sqeuclidean", "euclidean"):
+        if l2:
             aux = ivf_pq_mod._row_b_sum(
                 self.centers, self.rotation, self.codebooks, payload, labels,
                 self.pq_dim, self.pq_bits)
+            # scan bias = ‖R·c_l‖² + b_sum — the _ragged_bias_pq formula,
+            # applied per row at its label
+            rc2 = ivf_pq_mod._center_rot_sqnorm(self.centers, self.rotation)
+            bias = rc2[labels] + aux
         else:
             # inner-product metrics carry no list-side term (the packed
             # b_sum is zeros at valid entries)
             aux = jnp.zeros((work.shape[0],), jnp.float32)
-        return payload, aux
+            bias = aux
+        # the paged Pallas scan's MXU operand: int8 decoded-residual rows,
+        # bit-identical to the packed decode of the same codes
+        extra = ivf_pq_mod._decode_code_rows(
+            self.codebooks, payload, self.decoded_scale, self.pq_dim,
+            self.pq_bits)
+        return payload, aux, bias, extra
 
     @traced("serving::upsert")
     def upsert(self, vectors, ids=None) -> dict:
@@ -473,7 +631,7 @@ class PagedListStore:
             raise ValueError("ids must fit int32 and be >= 0")
 
         labels_np = self._assign_labels(work)
-        payload, aux = self._prepare_payload(work, labels_np)
+        payload, aux, bias, extra = self._prepare_payload(work, labels_np)
 
         with self._lock:
             # replaced ids: capture the OLD slots now, tombstone them only
@@ -493,7 +651,8 @@ class PagedListStore:
                     s = done[0]
                     e = min(n, s + chunk_rows)
                     self._append(payload[s:e], ids_np[s:e], aux[s:e],
-                                 labels_np[s:e])
+                                 labels_np[s:e], bias[s:e],
+                                 None if extra is None else extra[s:e])
                     done[0] = e
                 return n
 
@@ -515,14 +674,20 @@ class PagedListStore:
             # the pow2 bucket the dispatch actually pays
             from raft_tpu.obs import roofline as obs_roofline
 
+            extra_bytes = 0
+            if self.kind == "ivf_pq":
+                extra_bytes = self._cache_dim     # int8 decoded-cache row
+            elif self.kind == "ivf_bq":
+                extra_bytes = 4                   # fp32 scale row
             obs_roofline.note_dispatch(
                 "serving.scatter",
                 {"n_rows": n, "dim": self.dim,
                  "payload_width": int(self.pages.shape[2]),
-                 "payload_dtype": str(self.pages.dtype)})
+                 "payload_dtype": str(self.pages.dtype),
+                 "extra_row_bytes": extra_bytes})
         return {"upserts": n, "replaced": replaced, "growths": growths}
 
-    def _append(self, payload, ids_np, aux, labels_np) -> None:
+    def _append(self, payload, ids_np, aux, labels_np, bias, extra) -> None:
         """Allocate slots and scatter one chunk (lock held). The scatter
         is padded to a power-of-two row count so a lifetime of arbitrary
         upsert batch sizes compiles O(log max_batch) programs, not one
@@ -544,19 +709,33 @@ class PagedListStore:
             ids_dev = jnp.concatenate(
                 [ids_dev, jnp.zeros((pad,), ids_dev.dtype)])
             aux = jnp.concatenate([aux, jnp.zeros((pad,), aux.dtype)])
-        pages, page_ids, page_aux = _scatter_rows(
-            self.pages, self.page_ids, self.page_aux,
-            payload, ids_dev.astype(jnp.int32), aux.astype(jnp.float32),
-            jnp.asarray(pp), jnp.asarray(rr))
+            bias = jnp.concatenate([bias, jnp.zeros((pad,), bias.dtype)])
+            if extra is not None:
+                extra = jnp.concatenate([extra, jnp.zeros(
+                    (pad,) + extra.shape[1:], extra.dtype)])
+        extra_pool = (self.page_cache if self.kind == "ivf_pq"
+                      else self.page_scale)
+        pages, page_ids, page_aux, page_bias, extra_pool = _scatter_rows(
+            self.pages, self.page_ids, self.page_aux, self.page_bias,
+            extra_pool, payload, ids_dev.astype(jnp.int32),
+            aux.astype(jnp.float32), bias.astype(jnp.float32),
+            extra, jnp.asarray(pp), jnp.asarray(rr))
         # commit device state first, host map second: a raise above leaves
         # the store exactly as it was (slots burned in _fill are padding)
         self.pages, self.page_ids, self.page_aux = pages, page_ids, page_aux
+        self.page_bias = page_bias
+        if self.kind == "ivf_pq":
+            self.page_cache = extra_pool
+        elif self.kind == "ivf_bq":
+            self.page_scale = extra_pool
         for i in range(m):
             self._id_loc[int(ids_np[i])] = (int(pp[i]), int(rr[i]))
+        self._version += 1
 
     def _tombstone_slots(self, locs: List[Tuple[int, int]]) -> None:
         """Mark (page, row) slots dead in place (lock held): ``page_ids``
-        -1 there. Slots are never reused — compact() reclaims them."""
+        -1 and ``page_bias`` +inf there, in one dispatch. Slots are never
+        reused — compact() reclaims them."""
         pp = np.array([p for p, _ in locs], np.int64)
         rr = np.array([r for _, r in locs], np.int64)
         bucket = _pow2_at_least(len(locs))
@@ -564,9 +743,10 @@ class PagedListStore:
             pad = bucket - len(locs)
             pp = np.concatenate([pp, np.full(pad, self.capacity_pages)])
             rr = np.concatenate([rr, np.zeros(pad, np.int64)])
-        self.page_ids = _tombstone(self.page_ids, jnp.asarray(pp),
-                                   jnp.asarray(rr))
+        self.page_ids, self.page_bias = _tombstone(
+            self.page_ids, self.page_bias, jnp.asarray(pp), jnp.asarray(rr))
         self._tombstones += len(locs)
+        self._version += 1
 
     def _tombstone_ids(self, present: List[int]) -> int:
         """Tombstone rows by id and drop them from the id map (lock held)."""
@@ -591,17 +771,29 @@ class PagedListStore:
 
     # -- compaction ---------------------------------------------------------
     def _live_rows(self):
-        """(payload, aux, ids, labels) of live rows in per-list chain
-        order — the arrival order, which is what a from-scratch pack over
-        the same rows produces (pack_lists' label argsort is stable)."""
+        """(payload, aux, extra, ids, labels) of live rows in per-list
+        chain order — the arrival order, which is what a from-scratch pack
+        over the same rows produces (pack_lists' label argsort is stable).
+
+        Only the SNAPSHOT is taken under the lock (host tables copied,
+        immutable device arrays referenced); the gathers run on the
+        snapshot outside it, so a long compaction never stalls the
+        upsert/delete hot path (round-16 off-hot-path contract)."""
+        with self._lock:
+            table = self._table.copy()
+            list_pages = self._list_pages.copy()
+            fill = self._fill.copy()
+            page_list = self._page_list.copy()
+            pages, page_ids = self.pages, self.page_ids
+            page_aux, page_scale = self.page_aux, self.page_scale
         perm = []
         for lab in range(self.n_lists):
-            for p in self._table[lab, :self._list_pages[lab]]:
+            for p in table[lab, :list_pages[lab]]:
                 base = int(p) * self.page_rows
-                perm.extend(range(base, base + int(self._fill[p])))
+                perm.extend(range(base, base + int(fill[p])))
         perm = np.asarray(perm, np.int64)
-        ids_flat = np.asarray(self.page_ids).reshape(-1)
-        labels_flat = np.repeat(self._page_list, self.page_rows)
+        ids_flat = np.asarray(page_ids).reshape(-1)
+        labels_flat = np.repeat(page_list, self.page_rows)
         if perm.size:
             ids_sel = ids_flat[perm]
             live = ids_sel >= 0
@@ -611,32 +803,58 @@ class PagedListStore:
         else:
             ids_sel = np.empty(0, np.int32)
             labels_sel = np.empty(0, np.int32)
-        payload_flat = jnp.reshape(self.pages, (-1,) + self.pages.shape[2:])
+        payload_flat = jnp.reshape(pages, (-1,) + pages.shape[2:])
         payload = jnp.take(payload_flat, jnp.asarray(perm), axis=0)
-        aux = jnp.take(jnp.reshape(self.page_aux, (-1,)),
+        aux = jnp.take(jnp.reshape(page_aux, (-1,)),
                        jnp.asarray(perm), axis=0)
-        return payload, aux, ids_sel.astype(np.int32), labels_sel.astype(np.int32)
+        extra = None
+        if page_scale is not None:
+            extra = jnp.take(jnp.reshape(page_scale, (-1,)),
+                             jnp.asarray(perm), axis=0)
+        return (payload, aux, extra, ids_sel.astype(np.int32),
+                labels_sel.astype(np.int32))
 
     @traced("serving::compact")
     def compact(self):
         """Fold the live rows back into the packed representation: an
-        ``IvfFlatIndex`` / ``IvfPqIndex`` over exactly the surviving rows,
-        with the store's frozen quantizers. The result serializes through
-        the v2 snapshot container (``index.save``) — that is the paged
-        store's durable form. The per-row aux (norms / b_sum) is CARRIED,
-        not recomputed: recomputing over the packed shape can flip low
-        mantissa bits (different reduction tiling) and break the
-        compacted-scan ↔ paged-scan value parity the tier-1 tests pin."""
-        with self._lock:
-            payload, aux, ids_np, labels_np = self._live_rows()
-            group = 64 if self.kind == "ivf_flat" else 128
-            ids_dev = jnp.asarray(ids_np)
-            labels_dev = jnp.asarray(labels_np)
-            list_payload, list_ids = pack_lists(
-                payload, ids_dev, labels_dev, self.n_lists, group)
-            # same stable label-argsort permutation as the payload pack
+        ``IvfFlatIndex`` / ``IvfPqIndex`` / ``IvfBqIndex`` over exactly
+        the surviving rows, with the store's frozen quantizers. The result
+        serializes through the v2 snapshot container (``index.save``) —
+        that is the paged store's durable form. The per-row aux (norms /
+        b_sum / bq bias+scale) is CARRIED, not recomputed: recomputing
+        over the packed shape can flip low mantissa bits (different
+        reduction tiling) and break the compacted-scan ↔ paged-scan value
+        parity the tier-1 tests pin.
+
+        Only the row snapshot holds the store lock; the fold itself runs
+        on immutable snapshot arrays, so compaction is concurrency-safe
+        against (and invisible to) in-flight searches and mutations —
+        :meth:`compact_swap` re-validates against ``mutation_version``
+        before any state is replaced."""
+        payload, aux, extra, ids_np, labels_np = self._live_rows()
+        # strip-eligible granule (round 16): the compacted snapshot feeds
+        # the packed strip/BQ kernels directly (512-pow2 list padding is
+        # what strip_eligible demands); gather consumers are indifferent
+        group = 512
+        ids_dev = jnp.asarray(ids_np)
+        labels_dev = jnp.asarray(labels_np)
+        list_payload, list_ids = pack_lists(
+            payload, ids_dev, labels_dev, self.n_lists, group,
+            pow2_chunks=True)
+        # same stable label-argsort permutation as the payload pack
+        if self.kind == "ivf_bq":
+            aux2, _ = pack_lists(jnp.stack([extra, aux], axis=1), ids_dev,
+                                 labels_dev, self.n_lists, group,
+                                 pow2_chunks=True)
+            out = ivf_bq_mod.IvfBqIndex(
+                self.centers, self.rotation, list_payload, list_ids,
+                aux2[:, :, 0],
+                jnp.where(list_ids >= 0, aux2[:, :, 1], jnp.inf),
+                self.metric)
+        else:
             aux_packed, _ = pack_lists(aux, ids_dev, labels_dev,
-                                       self.n_lists, group)
+                                       self.n_lists, group,
+                                       pow2_chunks=True)
             if self.kind == "ivf_flat":
                 norms = None
                 if self.metric in ("sqeuclidean", "euclidean"):
@@ -655,3 +873,61 @@ class PagedListStore:
         if obs.enabled():
             obs.add("serving.store.compactions")
         return out
+
+    def _empty_clone(self) -> "PagedListStore":
+        """A row-free store with the SAME quantizers, page height, pool
+        capacity and table width — the staging target a background
+        compaction repages into before the atomic swap (same capacity ⇒
+        same operand shapes ⇒ the swap never retraces the scans)."""
+        clone = PagedListStore(
+            self.kind, self.centers, self.metric, page_rows=self.page_rows,
+            payload_width=int(self.pages.shape[2]),
+            payload_dtype=self.pages.dtype, rotation=self.rotation,
+            codebooks=self.codebooks, pq_bits=self.pq_bits,
+            pq_dim=self.pq_dim, codebook_kind=self.codebook_kind,
+            initial_pages=self.capacity_pages, res=self._res)
+        if clone.table_width < self.table_width:
+            clone._table = np.full((self.n_lists, self.table_width), -1,
+                                   np.int32)
+        return clone
+
+    _SWAP_FIELDS = ("pages", "page_ids", "page_aux", "page_bias",
+                    "page_cache", "page_scale", "_table", "_list_pages",
+                    "_fill", "_page_list", "_free", "_id_loc")
+
+    def compact_swap(self, compacted, expected_version: int) -> bool:
+        """Adopt a compacted index as this store's new paged state:
+        live rows re-paged front-to-back (tombstone slots reclaimed into
+        the free list), capacity and table width UNCHANGED (so the paged
+        scans re-dispatch their compiled programs — zero recompiles).
+
+        The repage runs on a staging clone OFF the lock; the final swap is
+        one short critical section that first re-validates
+        ``mutation_version`` against ``expected_version`` — a mutation
+        that landed after the caller's :meth:`compact` snapshot aborts the
+        swap (returns False, nothing changed) rather than losing it.
+        In-flight searches hold their own array snapshots
+        (:meth:`scan_state` / :meth:`paged_scan_state`) and are untouched
+        either way."""
+        clone = self._empty_clone()
+        clone._ingest_packed(compacted)
+        with self._lock:
+            if self._version != int(expected_version):
+                obs.add("serving.store.compact_swap_stale")
+                return False
+            if (clone.capacity_pages != self.capacity_pages
+                    or clone.table_width != self.table_width):
+                # the repage itself grew (a pathological fill pattern):
+                # adopting it would change operand shapes mid-serving, so
+                # refuse — the caller retries after the next compact()
+                obs.add("serving.store.compact_swap_regrown")
+                return False
+            for name in self._SWAP_FIELDS:
+                setattr(self, name, getattr(clone, name))
+            self._tombstones = 0
+            self._dev_table = None
+            self._dev_lens = None
+            self._version += 1
+        if obs.enabled():
+            obs.add("serving.store.compact_swaps")
+        return True

@@ -180,6 +180,15 @@ def direction(metric: str) -> str:
         return "down"
     if tail.endswith("capacity_rows") or tail.endswith("compression_x"):
         return "up"
+    # paged Pallas data plane (round 16): the packed-vs-paged throughput
+    # ratio and completed compaction cycles grow toward good (ratio is
+    # also caught by the qps rule below — kept explicit for the
+    # zero-tolerance threshold's readability); the window's peak
+    # tombstone load shrinks toward good (compaction keeping up)
+    if tail in ("paged_to_packed_qps_ratio", "compaction_cycles"):
+        return "up"
+    if tail == "tombstone_ratio_peak":
+        return "down"
     # cost-model accuracy (round 11): the predicted/measured HBM ratio is
     # best AT 1.0 — drift in either direction is the predictor degrading,
     # so the verdict compares |ratio − 1| across rounds ("one" direction);
@@ -227,6 +236,9 @@ _DEFAULT_METRIC_THRESHOLDS = {
     # violation at ANY count; prediction accuracy gets a 5% band before a
     # drift away from ratio 1.0 becomes a regression row
     "serving.unexplained_retraces": 0.0,
+    # paged Pallas plane (round 16): ANY slip of paged-vs-packed
+    # throughput below the prior round is a regression row
+    "serving.paged_to_packed_qps_ratio": 0.0,
     "serving.hbm_predicted_to_measured": 0.05,
     "ivf_flat.hbm_predicted_to_measured": 0.05,
     "ivf_pq.hbm_predicted_to_measured": 0.05,

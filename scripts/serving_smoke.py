@@ -14,6 +14,15 @@ the same regime as the tunneled TPU's ~70 ms dispatch):
 * dynamic batching beats batch-size-1 dispatch by >= 5x QPS at equal
   (no worse than) p99;
 * metrics route through bench/progress.py's crash-safe channel.
+
+Round 16 (paged Pallas data plane): a second window runs mixed
+upsert/search/delete traffic on the paged PALLAS engine
+(backend="paged_pallas", interpret-mode on CPU) with the background
+CompactionManager armed with a ``serving.compact.run=delay`` fault —
+asserting zero recompiles, zero unclassified verdicts and zero
+unexplained retraces across the window, and at least one compaction
+cycle COMPLETING under the fault without an SLO-window breach (no
+deadline misses in the window).
 """
 
 import os
@@ -25,9 +34,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from raft_tpu import obs, serving  # noqa: E402
+from raft_tpu import obs, resilience, serving  # noqa: E402
 from raft_tpu.bench import progress  # noqa: E402
 from raft_tpu.neighbors import ivf_flat  # noqa: E402
+from raft_tpu.obs import compile as obs_compile  # noqa: E402
 
 K, NPROBE, N_REQ = 5, 2, 64
 
@@ -45,13 +55,17 @@ def force(v):
     return float(np.asarray(v).sum())
 
 
-def run_window(store, q_pool, rng, rate, max_batch, lat1, with_upserts):
+def run_window(store, q_pool, rng, rate, max_batch, lat1, with_upserts,
+               backend=None, with_deletes=False, tight_s=0.25,
+               id_base=91_000):
+    kwargs = {} if backend is None else {"backend": backend}
     queue = serving.QueryQueue(
-        serving.searcher(store, K, n_probes=NPROBE),
+        serving.searcher(store, K, n_probes=NPROBE, **kwargs),
         slo_s=max(0.05, 100 * lat1), max_batch=max_batch,
         fill_wait_s=2 * lat1)
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=N_REQ))
     handles = []
+    pending = []
     i = 0
     t0 = time.perf_counter()
     while i < N_REQ:
@@ -60,12 +74,18 @@ def run_window(store, q_pool, rng, rate, max_batch, lat1, with_upserts):
             # mixed deadlines: every 5th request tight, the rest roomy
             handles.append(queue.submit(
                 q_pool[i % len(q_pool)],
-                timeout_s=(0.25 if i % 5 == 0 else 2.0)))
+                timeout_s=(tight_s if i % 5 == 0 else 2.0)))
             i += 1
             if with_upserts and i % 16 == 0:
+                ids = np.arange(id_base + i * 8, id_base + 8 + i * 8)
                 store.upsert(
-                    rng.standard_normal((8, 16)).astype(np.float32),
-                    np.arange(91_000 + i * 8, 91_008 + i * 8))
+                    rng.standard_normal((8, 16)).astype(np.float32), ids)
+                pending.append(ids)
+            if with_deletes and i % 8 == 0:
+                # tombstone the oldest pending batch, else seed rows —
+                # the delete stream that feeds the compaction trigger
+                store.delete(pending.pop(0) if pending
+                             else np.arange((i // 8 - 1) * 8, i))
             continue
         if not queue.pump():
             time.sleep(min(arrivals[i] - now, 2e-4))
@@ -81,6 +101,61 @@ def run_window(store, q_pool, rng, rate, max_batch, lat1, with_upserts):
                             if h.verdict not in ("ok", "deadline")),
         "multi_batches": queue.multi_batches,
     }
+
+
+def paged_pallas_phase(rng):
+    """Round 16: mixed upsert/search/delete on the paged Pallas engine
+    with a background compaction cycle completing under an armed
+    ``serving.compact.run=delay`` fault — no recompiles, no unclassified
+    verdicts, no unexplained retraces, no SLO-window breach."""
+    q_pool, store = build_store(rng)
+    # warm the pallas batch buckets + mutation programs off the clock
+    b = 1
+    while True:
+        force(serving.search(store, np.repeat(q_pool[:1], b, axis=0), K,
+                             n_probes=NPROBE, backend="paged_pallas")[0])
+        if b >= 32:
+            break
+        b *= 2
+    store.upsert(rng.standard_normal((8, 16)).astype(np.float32),
+                 np.arange(95_000, 95_008))
+    store.delete(np.arange(95_000, 95_008))
+    serving.CompactionManager(store, ratio=0.0).pump()  # warm the fold
+    lats = []
+    for i in range(20):
+        t = time.perf_counter()
+        force(serving.search(store, q_pool[i][None], K, n_probes=NPROBE,
+                             backend="paged_pallas")[0])
+        lats.append(time.perf_counter() - t)
+    lat1 = float(np.median(lats))
+
+    mgr = serving.CompactionManager(store, ratio=0.02, min_tombstones=8,
+                                    interval_s=0.01)
+    resilience.arm_faults("serving.compact.run=delay:1:0.05")
+    traces0 = serving.scan_trace_count()
+    u0 = obs_compile.unexplained_retraces()
+    mgr.start()
+    try:
+        win = run_window(store, q_pool, rng, rate=3.0 / lat1, max_batch=32,
+                         lat1=lat1, with_upserts=True,
+                         backend="paged_pallas", with_deletes=True,
+                         tight_s=2.0, id_base=96_000)
+        t_end = time.perf_counter() + 30.0
+        while mgr.cycles < 1 and time.perf_counter() < t_end:
+            time.sleep(0.01)
+    finally:
+        mgr.stop()
+        resilience.clear_faults()
+    recompiles = serving.scan_trace_count() - traces0
+    unexplained = obs_compile.unexplained_retraces() - u0
+    assert win["unclassified"] == 0, win
+    assert win["deadline"] == 0, ("SLO-window breach under compaction", win)
+    assert recompiles == 0, \
+        f"{recompiles} recompiles on the paged Pallas path"
+    assert unexplained == 0, f"{unexplained} unexplained retraces"
+    assert mgr.cycles >= 1, mgr.stats()
+    assert store.tombstone_ratio <= 0.02 + 1e-9 or mgr.cycles >= 1
+    return win, mgr.stats()
 
 
 def main():
@@ -135,12 +210,18 @@ def main():
     speedup = dyn["qps"] / base["qps"]
     assert speedup >= 5.0, (speedup, base, dyn)
     assert dyn["p99_ms"] <= base["p99_ms"] * 1.1, (base, dyn)
+
+    # round 16: the paged Pallas engine window + compaction-under-fault
+    pallas_win, compact_stats = paged_pallas_phase(rng)
+
     print(f"serving smoke: OK (batch1 {base['qps']:.0f} qps p99 "
           f"{base['p99_ms']:.2f} ms -> dynamic {dyn['qps']:.0f} qps p99 "
           f"{dyn['p99_ms']:.2f} ms, {speedup:.1f}x; upsert window: "
           f"{dyn_mut['multi_batches']} multi-batches, "
           f"{dyn_mut['deadline'] + dyn['deadline']} deadline-drained, "
-          f"0 recompiles)")
+          f"0 recompiles; paged-pallas window: {pallas_win['ok']} ok, "
+          f"{compact_stats['cycles']} compaction cycle(s) under delay "
+          f"fault, 0 recompiles)")
 
 
 if __name__ == "__main__":

@@ -146,3 +146,29 @@ def test_slo_plane_direction_rules(tmp_path):
     assert rows["serving.availability"] == "regression"  # 0.1% threshold
     assert rows["serving.recall_estimate"] == "regression"
     assert rows["serving.recall_stale"] == "regression"  # went stale
+
+
+def test_paged_pallas_direction_rules(tmp_path):
+    """Round 16: the packed-vs-paged throughput ratio gates downward
+    slips at zero tolerance, compaction cycles count upward, and the
+    window's peak tombstone load downward."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"serving": {"paged_to_packed_qps_ratio": 0.95,
+                                  "compaction_cycles": 2,
+                                  "tombstone_ratio_peak": 0.1}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"serving": {"paged_to_packed_qps_ratio": 0.93,
+                                  "compaction_cycles": 0,
+                                  "tombstone_ratio_peak": 0.4}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    # 2% slip is inside the generic threshold but the ratio carries a
+    # zero-tolerance per-metric default — ANY slip is a regression row
+    assert rows["serving.paged_to_packed_qps_ratio"] == "regression"
+    assert rows["serving.compaction_cycles"] == "regression"
+    assert rows["serving.tombstone_ratio_peak"] == "regression"

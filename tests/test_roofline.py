@@ -189,6 +189,65 @@ class TestFlopOracles:
             + q * p * tw * pr * (code_w + 8))
 
     @pytest.mark.parametrize("draw", range(2))
+    def test_paged_pallas_flat(self, rng, draw):
+        q, dim, n_lists = int(rng.integers(1, 5)), 4, 3
+        pr, tw, p, k = int(rng.integers(1, 4)), int(rng.integers(1, 4)), 2, 3
+        est = roofline.estimate_flops(
+            "ivf_flat.paged_pallas", q=q, dim=dim, n_lists=n_lists,
+            page_rows=pr, table_width=tw, n_probes=p, k=k, dtype="float32")
+        flops = _loop_matmul_flops(q, n_lists, dim)
+        for _ in range(q):
+            for _ in range(p * tw * pr):
+                flops += 2 * dim + 1                     # contraction + bias
+        assert est["flops"] == flops
+        # strip-shared page streams: one chain fetch (payload + bias pool
+        # rows) per STRIP, not per query — the win over the gather path
+        strips = math.ceil(q * p / roofline.STRIP_C)
+        assert est["bytes_read"] == (q * dim * 4 + n_lists * dim * 4
+                                     + strips * tw * pr * (dim * 4 + 4))
+        assert est["bytes_written"] == q * k * 8
+
+    @pytest.mark.parametrize("draw", range(2))
+    def test_paged_pallas_pq(self, rng, draw):
+        q, dim, pq_dim = int(rng.integers(1, 4)), 8, 4
+        n_lists, pr, tw, p, k = 3, 2, int(rng.integers(1, 4)), 2, 3
+        rd = pq_dim * math.ceil(dim / pq_dim)
+        est = roofline.estimate_flops(
+            "ivf_pq.paged_pallas", q=q, dim=dim, n_lists=n_lists,
+            page_rows=pr, table_width=tw, pq_dim=pq_dim, n_probes=p, k=k)
+        flops = _loop_matmul_flops(q, n_lists, dim)
+        flops += _loop_matmul_flops(q, rd, dim)          # query rotation
+        for _ in range(q):
+            for _ in range(p * tw * pr):
+                flops += 2 * rd + 1        # int8-cache contraction + bias
+        assert est["flops"] == flops
+        strips = math.ceil(q * p / roofline.STRIP_C)
+        assert est["bytes_read"] == (
+            q * dim * 4 + n_lists * dim * 4 + rd * rd * 4
+            + strips * tw * pr * (rd + 4))               # int8 cache + bias
+        assert est["bytes_written"] == q * k * 8
+
+    @pytest.mark.parametrize("draw", range(2))
+    def test_paged_pallas_bq(self, rng, draw):
+        q, dim = int(rng.integers(1, 4)), 16
+        n_lists, pr, tw, p, k = 3, 2, int(rng.integers(1, 4)), 2, 3
+        rd = math.ceil(dim / 8) * 8
+        est = roofline.estimate_flops(
+            "ivf_bq.paged_pallas", q=q, dim=dim, n_lists=n_lists,
+            page_rows=pr, table_width=tw, n_probes=p, k=k)
+        flops = _loop_matmul_flops(q, n_lists, dim)
+        flops += _loop_matmul_flops(q, rd, dim)          # query rotation
+        for _ in range(q):
+            for _ in range(p * tw * pr):
+                flops += 2 * rd + 2          # ±1 contraction + scale + bias
+        assert est["flops"] == flops
+        strips = math.ceil(q * p / roofline.STRIP_C)
+        assert est["bytes_read"] == (
+            q * dim * 4 + n_lists * dim * 4 + rd * rd * 4
+            + strips * tw * pr * (rd // 8 + 4 + 4))  # codes + scale + bias
+        assert est["bytes_written"] == q * k * 8
+
+    @pytest.mark.parametrize("draw", range(2))
     def test_cagra_fused_hop(self, rng, draw):
         q, w, deg = int(rng.integers(1, 5)), 2, int(rng.integers(2, 5))
         pdim, itopk, hops = int(rng.integers(2, 6)), 4, int(rng.integers(1, 3))
@@ -212,7 +271,13 @@ class TestFlopOracles:
             payload_dtype="float32")
         assert est["flops"] == 0
         assert est["bytes_read"] == 5 * 16 * 4
-        assert est["bytes_written"] == 8 * (16 * 4 + 8)   # pow2 bucket
+        # pow2 bucket × (payload + id + aux + scan bias)
+        assert est["bytes_written"] == 8 * (16 * 4 + 12)
+        # kind-specific extra pool row (PQ decoded cache / BQ scale)
+        est = roofline.estimate_flops(
+            "serving.scatter", n_rows=5, dim=16, payload_width=16,
+            payload_dtype="uint8", extra_row_bytes=24)
+        assert est["bytes_written"] == 8 * (16 + 12 + 24)
 
     def test_unknown_entry_raises(self):
         with pytest.raises(ValueError, match="unknown roofline entry"):
@@ -344,6 +409,27 @@ class TestOccupancy:
         assert occ["code_bytes_per_entry"] == 8
         assert occ["padded_row_fraction"] == base["padded_row_fraction"]
         assert occ["grid"] == base["grid"]
+
+    def test_paged_occupancy_hand_layout(self):
+        """Hand-counted paged planner stats: 4 lists at W=4, R=32, chains
+        [2, 1, 0, 4], 150 live rows, 30 tombstones."""
+        occ = strip_scan.paged_occupancy_stats(
+            table_width=4, page_rows=32, chain_pages=[2, 1, 0, 4],
+            live_rows=150, tombstones=30, q=4, p=2, k=3, row_bytes=64)
+        # plan: kf=3 < MC ⇒ ppf grows to cover min(MC, 4096) or W: ppf=4,
+        # n_sub=1, w=128
+        assert occ["pages_per_fetch"] == 4 and occ["n_sub"] == 1
+        assert occ["w"] == 128
+        chained_slots = (2 + 1 + 0 + 4) * 32
+        assert occ["page_fill"] == pytest.approx(150 / chained_slots,
+                                                 abs=1e-4)
+        assert occ["tombstone_fraction"] == pytest.approx(
+            30 / chained_slots, abs=1e-4)
+        assert occ["chain_fill"] == pytest.approx(7 / 16, abs=1e-4)
+        assert occ["capacity_slots"] == 4 * 4 * 32
+        # all 4·2 pairs fit one strip (C=192): best case 1 real strip
+        assert occ["strips_real_bestcase"] == 1
+        assert 0.0 <= occ["padded_strip_fraction"] < 1.0
 
     def test_cagra_occupancy(self):
         occ = cagra_hop.occupancy_stats(100, 32, 4, 16, 32, 64)

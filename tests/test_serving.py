@@ -323,6 +323,363 @@ class TestNoRecompile:
 
 
 # ---------------------------------------------------------------------------
+# Paged Pallas data plane (round 16): page-table strip/BQ scans
+# ---------------------------------------------------------------------------
+
+
+def _assert_tie_aware_equal(va, ia, vb, ib, label=""):
+    """Bitwise value equality; ids equal except where the row's value is
+    duplicated (the two engines number in-block columns differently, so
+    exact-value ties — incl. the mantissa-packed 12-bit quantization —
+    may legitimately resolve to a different member of the tie)."""
+    va, ia, vb, ib = map(np.asarray, (va, ia, vb, ib))
+    np.testing.assert_array_equal(va, vb, err_msg=f"{label}: values")
+    mism = ia != ib
+    for qi, j in zip(*np.nonzero(mism)):
+        row = va[qi]
+        assert (row == row[j]).sum() > 1, \
+            (label, int(qi), int(j), float(row[j]), ia[qi], ib[qi])
+
+
+def _paged_search(kind, store, Q, k, n_probes, backend):
+    from raft_tpu.neighbors import ivf_bq
+
+    mod = {"ivf_flat": ivf_flat, "ivf_pq": ivf_pq, "ivf_bq": ivf_bq}[kind]
+    return mod.search_paged(store, Q, k, n_probes=n_probes, backend=backend)
+
+
+def _packed_search_512(kind, index, Q, k, n_probes):
+    """Packed strip/BQ search of a compacted (512-granule) snapshot — the
+    engine the acceptance criterion names."""
+    from raft_tpu.neighbors import ivf_bq
+
+    if kind == "ivf_flat":
+        return ivf_flat.search(index, Q, k, n_probes=n_probes,
+                               backend="ragged")
+    if kind == "ivf_pq":
+        return ivf_pq.search(index, Q, k, n_probes=n_probes,
+                             backend="ragged")
+    return ivf_bq.search(index, Q, k, n_probes=n_probes,
+                         backend="reference")
+
+
+class TestPagedPallas:
+    def _build(self, rng, kind, n=900, dim=24, n_lists=8):
+        from raft_tpu.neighbors import ivf_bq
+
+        X = rng.standard_normal((n, dim)).astype(np.float32)
+        Q = rng.standard_normal((7, dim)).astype(np.float32)
+        if kind == "ivf_flat":
+            idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(
+                n_lists=n_lists, list_size_cap=0))
+        elif kind == "ivf_pq":
+            idx = ivf_pq.build(X, ivf_pq.IvfPqParams(
+                n_lists=n_lists, pq_dim=12, list_size_cap=0))
+        else:
+            idx = ivf_bq.build(X, ivf_bq.IvfBqParams(
+                n_lists=n_lists, list_size_cap=0))
+        return X, Q, idx
+
+    @pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq", "ivf_bq"])
+    def test_interleaving_property_paged_pallas(self, rng, kind):
+        """Acceptance: over random upsert/delete/compact interleavings —
+        including tombstone-only pages, emptied lists and mid-traffic
+        page growth — the paged Pallas scan (interpret mode) is
+        BIT-identical (ids + distances) to its jnp reference, and
+        value-bitwise/tie-aware-id identical to packed search of the
+        store's own compact() output."""
+        _, Q, idx = self._build(rng, kind)
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        next_id = 200_000
+        live = set(range(900))
+        for step in range(8):
+            op = rng.integers(0, 10)
+            if op < 5:
+                n_new = int(rng.integers(1, 80))
+                store.upsert(
+                    rng.standard_normal((n_new, 24)).astype(np.float32),
+                    np.arange(next_id, next_id + n_new))
+                live.update(range(next_id, next_id + n_new))
+                next_id += n_new
+            elif op < 8 and live:
+                n_del = int(rng.integers(1, min(80, len(live)) + 1))
+                victims = rng.choice(sorted(live), size=n_del,
+                                     replace=False)
+                store.delete(victims)
+                live.difference_update(int(v) for v in victims)
+            else:
+                v0 = store.mutation_version
+                assert store.compact_swap(store.compact(), v0)
+            vp, ip_ = _paged_search(kind, store, Q, 10, 8, "paged_pallas")
+            vj, ij = _paged_search(kind, store, Q, 10, 8, "paged_jnp")
+            np.testing.assert_array_equal(np.asarray(vp), np.asarray(vj))
+            np.testing.assert_array_equal(np.asarray(ip_), np.asarray(ij))
+        # tombstone-only pages + an emptied list: delete one whole list
+        labels = np.asarray(store.compact().list_ids)
+        one_list = labels[0][labels[0] >= 0]
+        if one_list.size:
+            store.delete(one_list)
+        vp, ip_ = _paged_search(kind, store, Q, 10, 8, "paged_pallas")
+        vj, ij = _paged_search(kind, store, Q, 10, 8, "paged_jnp")
+        np.testing.assert_array_equal(np.asarray(vp), np.asarray(vj))
+        np.testing.assert_array_equal(np.asarray(ip_), np.asarray(ij))
+        comp = store.compact()
+        vr, ir = _packed_search_512(kind, comp, Q, 10, 8)
+        _assert_tie_aware_equal(vp, ip_, vr, ir,
+                                f"{kind} pallas vs packed-of-compact")
+
+    @pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq"])
+    def test_paged_pallas_vs_gather_ids(self, rng, kind):
+        """The Pallas engine's candidate RANKING agrees with the fp32
+        gather scan at bf16 resolution: every disagreement position must
+        be a bf16-scale near-tie (the packed kernels' documented score
+        contract — distances are bf16-accumulated, ~3 significant
+        digits)."""
+        _, Q, idx = self._build(rng, kind)
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        store.delete(np.arange(150))
+        vp, ip_ = _paged_search(kind, store, Q, 10, 8, "paged_pallas")
+        vg, ig = _paged_search(kind, store, Q, 10, 8, "gather")
+        vp, ip_, vg, ig = map(np.asarray, (vp, ip_, vg, ig))
+        finite = np.isfinite(vg)
+        assert np.allclose(vp[finite], vg[finite], rtol=2e-2, atol=2e-2)
+        mism = ip_ != ig
+        for qi, j in zip(*np.nonzero(mism)):
+            gap = abs(vg[qi, j] - vp[qi, j])
+            assert gap <= 2e-2 * max(1.0, abs(vg[qi, j])), \
+                (kind, int(qi), int(j), float(vg[qi, j]), float(vp[qi, j]))
+
+    @pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq", "ivf_bq"])
+    def test_zero_recompiles_paged_pallas(self, rng, kind):
+        """Acceptance: steady-state upsert/delete/search on the paged
+        Pallas path never retraces (capacity-shaped operands), and no
+        retrace is ever unexplained."""
+        from raft_tpu.obs import compile as obs_compile
+
+        _, Q, idx = self._build(rng, kind)
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        store.reserve(4000)
+        _paged_search(kind, store, Q, 10, 8, "paged_pallas")  # warm
+        t0 = serving.scan_trace_count()
+        u0 = obs_compile.unexplained_retraces()
+        for s in range(0, 900, 300):
+            store.upsert(rng.standard_normal((300, 24)).astype(np.float32),
+                         np.arange(70_000 + s, 70_300 + s))
+            store.delete(np.arange(70_000 + s, 70_000 + s + 60))
+            _paged_search(kind, store, Q, 10, 8, "paged_pallas")
+        assert serving.scan_trace_count() == t0, \
+            "steady-state mutations retraced the paged Pallas scan"
+        assert obs_compile.unexplained_retraces() == u0
+
+    def test_paged_pallas_faultpoint_classifies(self, rng):
+        """Standing gate: the new dispatch path carries a faultpoint; an
+        armed OOM propagates CLASSIFIED and the store keeps serving."""
+        _, Q, idx = self._build(rng, "ivf_flat")
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        resilience.arm_faults("ivf_flat.search_paged.scan=oom:1")
+        with pytest.raises(Exception) as ei:
+            ivf_flat.search_paged(store, Q, 10, n_probes=8,
+                                  backend="paged_pallas")
+        assert resilience.classify(ei.value) == resilience.OOM
+        resilience.clear_faults()
+        v, i = ivf_flat.search_paged(store, Q, 10, n_probes=8,
+                                     backend="paged_pallas")
+        assert np.asarray(i).shape == (7, 10)
+
+    def test_bq_serving_roundtrip(self, rng):
+        """serving.search routes kind='ivf_bq'; deletes exclude rows."""
+        from raft_tpu.neighbors import ivf_bq
+
+        X, Q, idx = self._build(rng, "ivf_bq")
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        removed = store.delete(np.arange(100))
+        assert removed == 100
+        v, ids = serving.search(store, Q, 20, n_probes=8)
+        ids = np.asarray(ids)
+        live = ids[ids >= 0]
+        assert live.size and (live >= 100).all()
+
+
+# ---------------------------------------------------------------------------
+# Background compaction (round 16)
+# ---------------------------------------------------------------------------
+
+
+class TestCompaction:
+    @pytest.fixture
+    def store(self, flat_setup):
+        _, _, idx = flat_setup
+        return serving.PagedListStore.from_index(idx, page_rows=32)
+
+    def test_trigger_threshold(self, store):
+        mgr = serving.CompactionManager(store, ratio=0.25)
+        assert mgr.pump() is None                     # no tombstones
+        store.delete(np.arange(200))                  # 200/1300 ≈ 0.154
+        assert mgr.pump() is None
+        store.delete(np.arange(200, 400))             # 400/1100 ≈ 0.36
+        out = mgr.pump()
+        assert out is not None and out["status"] == "ok"
+        assert out["reclaimed"] == 400
+        assert store.tombstones == 0 and mgr.cycles == 1
+        assert mgr.tombstone_ratio_peak > 0.25
+
+    def test_cycle_keeps_results_capacity_and_programs(self, flat_setup,
+                                                       rng):
+        """Acceptance: compaction reclaims tombstones without changing
+        search results, capacity shapes, or compiled programs."""
+        _, Q, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        store.delete(np.arange(300))
+        store.upsert(rng.standard_normal((120, 24)).astype(np.float32),
+                     np.arange(30_000, 30_120))
+        v1, i1 = serving.search(store, Q, 10, n_probes=12,
+                                backend="paged_pallas")
+        cap0, w0 = store.capacity_pages, store.table_width
+        t0 = serving.scan_trace_count()
+        mgr = serving.CompactionManager(store, ratio=0.1)
+        out = mgr.pump()
+        assert out["status"] == "ok"
+        assert (store.capacity_pages, store.table_width) == (cap0, w0)
+        v2, i2 = serving.search(store, Q, 10, n_probes=12,
+                                backend="paged_pallas")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        assert serving.scan_trace_count() == t0, \
+            "compaction swap retraced the paged scan"
+
+    def test_stale_swap_aborts_without_losing_mutations(self, store, rng):
+        store.delete(np.arange(250))
+        v0 = store.mutation_version
+        packed = store.compact()
+        # a mutation races the fold: the swap must abort, keeping it
+        store.upsert(rng.standard_normal((5, 24)).astype(np.float32),
+                     np.arange(40_000, 40_005))
+        assert store.compact_swap(packed, v0) is False
+        assert store.size == 1500 - 250 + 5
+        ids = _ids(serving.search(store, np.asarray(
+            rng.standard_normal((4, 24)), np.float32), 20, n_probes=12))
+        assert store.tombstones == 250  # nothing reclaimed, nothing lost
+
+    def test_faultpoint_recovery(self, store):
+        """Round-7 standing gate: serving.compact.run armed — OOM/FATAL
+        classify into counters+events with the store intact; a delay
+        fault under a generous deadline still completes; a hang under a
+        tight deadline yields a bounded DEADLINE verdict."""
+        store.delete(np.arange(400))
+        size0 = store.size
+        mgr = serving.CompactionManager(store, ratio=0.1)
+        resilience.arm_faults("serving.compact.run=oom:1")
+        out = mgr.pump()
+        assert out["status"] == resilience.OOM
+        assert store.size == size0 and store.tombstones == 400
+        resilience.arm_faults("serving.compact.run=fatal:1")
+        out = mgr.pump()
+        assert out["status"] == resilience.FATAL and mgr.failures == 2
+        # delay: slow but inside the deadline — the cycle completes
+        resilience.arm_faults("serving.compact.run=delay:1:0.02")
+        out = mgr.pump()
+        assert out["status"] == "ok" and store.tombstones == 0
+        # hang under a tight deadline: bounded DEADLINE verdict
+        store.delete(np.arange(400, 700))
+        resilience.arm_faults("serving.compact.run=hang:1:10")
+        tight = serving.CompactionManager(store, ratio=0.1, deadline_s=0.2)
+        out = tight.pump()
+        assert out["status"] == resilience.DEADLINE
+        assert store.tombstones == 300  # untouched
+        resilience.clear_faults()
+        assert tight.pump()["status"] == "ok"
+
+    def test_concurrent_queue_dispatches_stay_correct(self, store, rng):
+        """Acceptance: searches through the QueryQueue during a
+        compaction cycle return correct results (snapshot atomicity)."""
+        store.delete(np.arange(350))
+        qs = rng.standard_normal((12, 24)).astype(np.float32)
+        direct_i = _ids(serving.search(store, qs, 5, n_probes=12))
+        q = serving.QueryQueue(
+            serving.searcher(store, k=5, n_probes=12),
+            slo_s=0.05, max_batch=4)
+        mgr = serving.CompactionManager(store, ratio=0.1)
+        hs = [q.submit(qs[i], timeout_s=30.0) for i in range(12)]
+        pumped_compact = False
+        t_end = time.monotonic() + 30.0
+        while q.depth and time.monotonic() < t_end:
+            q.pump()
+            if not pumped_compact:
+                assert mgr.pump()["status"] == "ok"
+                pumped_compact = True
+        assert not q.depth and pumped_compact
+        assert all(h.verdict == "ok" for h in hs)
+        got_i = np.stack([np.asarray(h.result()[1]) for h in hs])
+        np.testing.assert_array_equal(direct_i, got_i)
+
+    def test_worker_thread_mode(self, store, rng):
+        store.delete(np.arange(400))
+        mgr = serving.CompactionManager(store, ratio=0.1, interval_s=0.01)
+        mgr.start()
+        try:
+            t_end = time.monotonic() + 20.0
+            while store.tombstones and time.monotonic() < t_end:
+                time.sleep(0.01)
+            assert store.tombstones == 0 and mgr.cycles >= 1
+        finally:
+            mgr.stop()
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv(serving.COMPACT_RATIO_ENV, "0.5")
+        monkeypatch.setenv(serving.COMPACT_DEADLINE_ENV, "7.5")
+        assert serving.default_compact_ratio() == 0.5
+        assert serving.default_compact_deadline() == 7.5
+
+
+# ---------------------------------------------------------------------------
+# Compile-ledger bookkeeping stays O(log) across mutation bursts (round 16)
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerBatching:
+    def test_delete_burst_ledger_counts(self, flat_setup):
+        """Regression (satellite): a delete-heavy burst of same-bucket
+        tombstone dispatches does O(distinct buckets) ledger work — the
+        trace_event runs at TRACE time only — and never fabricates an
+        unexplained retrace."""
+        from raft_tpu.obs import compile as obs_compile
+
+        _, _, idx = flat_setup
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        store.delete(np.arange(8))  # warm the 8-wide tombstone bucket
+        t0 = obs_compile.trace_count("serving.tombstone")
+        u0 = obs_compile.unexplained_retraces()
+        for s in range(8, 8 + 50 * 8, 8):
+            store.delete(np.arange(s, s + 8))   # 50 same-size deletes
+        assert obs_compile.trace_count("serving.tombstone") == t0, \
+            "same-bucket delete burst grew the ledger per call"
+        assert obs_compile.unexplained_retraces() == u0
+
+    def test_upsert_burst_roofline_note_cached(self, flat_setup, rng):
+        """The roofline dispatch note reuses its estimate for repeated
+        same-shape scatters (the O(calls) host-work satellite): counts
+        accumulate, the estimate object is shared."""
+        from raft_tpu.obs import roofline as obs_roofline
+
+        _, _, idx = flat_setup
+        obs.enable()
+        try:
+            obs_roofline.reset()
+            store = serving.PagedListStore.from_index(idx, page_rows=32)
+            store.reserve(2000)
+            for s in range(6):
+                store.upsert(
+                    rng.standard_normal((32, 24)).astype(np.float32),
+                    np.arange(60_000 + 32 * s, 60_032 + 32 * s))
+            rec = obs_roofline.entries()["serving.scatter"]
+            assert rec["count"] == 6
+            assert rec["est"]["flops"] == 0  # pure data movement
+        finally:
+            obs.disable()
+            obs_roofline.reset()
+
+
+# ---------------------------------------------------------------------------
 # QueryQueue: dynamic batching under SLO
 # ---------------------------------------------------------------------------
 
