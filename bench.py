@@ -489,6 +489,35 @@ def run_suite():
         index = build()
         return index, round(cold, 1), round(time.perf_counter() - t0, 1)
 
+    def stamp_build(row, entry, cold_s, warm_s, **model_kwargs):
+        """Build-phase trajectory stamp (round 17 — previously only search
+        was stamped): ``build_s``/``build_warm_s``/``build_rows_per_s``
+        plus the static build roofline fields (``build_flops`` /
+        ``build_bytes`` / ``build_bound`` + utilizations where the warm
+        build time and platform peaks exist). ``build_rows_per_s`` comes
+        from the WARM rebuild — the steady-state number XLA compile noise
+        can't pollute; a cache-hit section (0.0/0.0) stamps the times but
+        no throughput (a load is not a build)."""
+        row["build_s"] = cold_s
+        row["build_warm_s"] = warm_s
+        n_rows = model_kwargs["n"]
+        t = warm_s or cold_s
+        if t:
+            row["build_rows_per_s"] = round(n_rows / t, 1)
+        try:
+            util = obs_roofline.utilization(entry,
+                                            measured_s=(warm_s or None),
+                                            **model_kwargs)
+            row["build_flops"] = util["flops"]
+            row["build_bytes"] = util["bytes"]
+            row["build_bound"] = util["bound"]
+            for key in ("achieved_gflops", "mxu_utilization",
+                        "hbm_bw_utilization"):
+                if util.get(key) is not None:
+                    row[f"build_{key}"] = util[key]
+        except Exception as e:
+            row["build_roofline_error"] = section_error(e)
+
     # --- IVF-Flat at BASELINE config (nlist=1024, nprobe=32, escalating) ----
     # Section guards (ISSUE 3): a failed IVF section must not sink the
     # suite — the headline falls back down flat -> brute force, and the
@@ -526,8 +555,9 @@ def run_suite():
                 lambda qs: ivf_flat.search(flat_index, qs, K, n_probes=flat["nprobe"]),
                 queries, REPS, hist="bench.ivf_flat.batch_latency_s"), 1)
             flat.update(latency_percentiles("bench.ivf_flat.batch_latency_s"))
-            flat["build_s"] = cold_s
-            flat["build_warm_s"] = warm_s
+            stamp_build(flat, "ivf_flat.build", cold_s, warm_s,
+                        n=N, dim=DIM, n_lists=NLIST,
+                        train_rows=int(N * 0.2))
             # per-index residency watermark (ISSUE 10): gauge + metric line
             flat["index_bytes"] = obs_memory.record_index(
                 "ivf_flat", flat_index)
@@ -600,8 +630,9 @@ def run_suite():
                 pq_timed, queries, REPS,
                 hist="bench.ivf_pq.batch_latency_s"), 1)
             pq.update(latency_percentiles("bench.ivf_pq.batch_latency_s"))
-            pq["build_s"] = cold_s
-            pq["build_warm_s"] = warm_s
+            stamp_build(pq, "ivf_pq.build", cold_s, warm_s,
+                        n=N, dim=DIM, n_lists=NLIST, pq_dim=DIM // 2,
+                        train_rows=int(N * 0.2))
             pq["index_bytes"] = obs_memory.record_index("ivf_pq", pq_index)
             stamp_cost(pq, "ivf_pq", pq_index, pq["nprobe"], mem0)
             if pq_cache:
@@ -667,8 +698,11 @@ def run_suite():
             bq["recompiles_during_search"] = \
                 ivf_bq.scan_trace_count() - traces0
             bq.update(latency_percentiles("bench.ivf_bq.batch_latency_s"))
-            bq["build_s"] = cold_s
-            bq["build_warm_s"] = warm_s
+            stamp_build(bq, "ivf_bq.build", cold_s, warm_s,
+                        n=N, dim=DIM, n_lists=NLIST,
+                        train_rows=int(N * 0.2),
+                        rot_dim=bq_index.rot_dim, bits=bq_index.bits,
+                        rotation_kind=bq_index.rotation_kind)
             bq["index_bytes"] = obs_memory.record_index("ivf_bq", bq_index)
             stamp_cost(bq, "ivf_bq", bq_index, bq["nprobe"], mem0)
             if bq_cache:
@@ -693,6 +727,125 @@ def run_suite():
             bq = None
             extras["ivf_bq"] = section_error(e)
         hb.section("ivf_bq", extras["ivf_bq"])
+
+    # --- IVF-BQ build fast path (ROADMAP item 5, round 17) -----------------
+    # Three rungs of the billion-scale build story: (a) the dense-vs-SRHT
+    # rotation apply timing pair at d >= 512 (the O(d²)→O(d·log d) claim,
+    # measured); (b) the STREAMED Hadamard build at bench scale — rows/s +
+    # the closed-form peak-residency prediction, restated at the SIFT-1B
+    # 15.6M-row per-chip share (the number that must fit one chip); (c)
+    # the multi-bit no-refine rung: 4-bit extended codes ranked by the
+    # estimate alone (refine_ratio=1), the high-recall regime with no
+    # exact re-rank and no caller-held dataset.
+    if section_on("bq_build"):
+        hb.set_section("bq_build")
+        try:
+            from raft_tpu.ops import linalg as linalg_mod
+
+            bqb = {}
+            # (a) rotation apply pair at d >= 512
+            rot_d = max(512, linalg_mod.hadamard_rot_dim(DIM))
+            rot_rows = 4096 if not tiny else 512
+            kr = jax.random.key(7)
+            rmat = linalg_mod.make_rotation_matrix(kr, rot_d)
+            signs = linalg_mod.make_srht_signs(kr, rot_d)
+            xr = jax.random.normal(jax.random.key(8), (rot_rows, rot_d))
+            dense_fn = jax.jit(lambda x: linalg_mod.rotate_rows(x, rmat, "dense"))
+            had_fn = jax.jit(lambda x: linalg_mod.rotate_rows(x, signs, "hadamard"))
+
+            def _rot_time(fn):
+                _force(fn(xr))  # warm/compile
+                reps = 10
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = fn(xr)
+                _force(out)
+                return (time.perf_counter() - t0) / reps
+
+            td, th = _rot_time(dense_fn), _rot_time(had_fn)
+            bqb["rotation_dim"] = rot_d
+            bqb["rotation_rows"] = rot_rows
+            bqb["rotation_dense_s"] = round(td, 6)
+            bqb["rotation_hadamard_s"] = round(th, 6)
+            bqb["rotation_speedup_x"] = round(td / th, 2) if th else None
+
+            # (b) streamed Hadamard build at bench scale
+            np_ds = np.asarray(dataset, np.float32)
+            bparams = ivf_bq.IvfBqParams(
+                n_lists=NLIST, rotation_kind="hadamard",
+                kmeans_trainset_fraction=0.2)
+            chunk_used = min(N, max(N // 8, 65536)) if not tiny else N
+            t0 = time.perf_counter()
+            sidx = ivf_bq.build_streaming(
+                lambda s, e: np_ds[s:e], N, DIM, bparams,
+                chunk_rows=chunk_used)
+            _force(sidx.list_scale)
+            sb_s = time.perf_counter() - t0
+            bqb["build_s"] = round(sb_s, 1)
+            bqb["build_rows_per_s"] = round(N / sb_s, 1)
+            bqb["build_chunk_rows"] = chunk_used
+            bqb["streamed_dropped"] = int(sidx._streaming_dropped)
+            pb = obs_costmodel.predict_build_streaming_bytes(
+                n=N, dim=DIM, n_lists=NLIST,
+                max_list_size=sidx.max_list_size, chunk_rows=chunk_used,
+                train_rows=int(N * 0.2), rot_dim=sidx.rot_dim,
+                rotation_kind="hadamard")
+            bqb["build_peak_predicted_bytes"] = pb["peak_bytes"]
+            bqb["build_index_predicted_bytes"] = pb["index_bytes"]
+            # the SIFT-1B per-chip share restated with the same formula
+            # (15,625,000 rows — the r09 capacity rung's resident share):
+            # mls at the auto list cap, 512-pow2 rounded
+            from raft_tpu.neighbors import _packing as packing_mod
+
+            share = 15_625_000
+            share_lists = max(NLIST, 4096)
+            share_cap = packing_mod.round_list_size(
+                packing_mod.auto_list_cap(share, share_lists, 512), 512,
+                pow2_chunks=True)
+            pb16 = obs_costmodel.predict_build_streaming_bytes(
+                n=share, dim=DIM, n_lists=share_lists,
+                max_list_size=share_cap, chunk_rows=262_144,
+                train_rows=2_000_000,
+                rot_dim=linalg_mod.hadamard_rot_dim(DIM),
+                rotation_kind="hadamard")
+            bqb["sift1b_share_peak_predicted_bytes"] = pb16["peak_bytes"]
+            stamp_build(bqb, "ivf_bq.build", round(sb_s, 1), 0.0,
+                        n=N, dim=DIM, n_lists=NLIST,
+                        train_rows=int(N * 0.2), rot_dim=sidx.rot_dim,
+                        rotation_kind="hadamard")
+            del sidx
+
+            # (c) multi-bit no-refine rung (recall from the estimate alone)
+            mb_bits = int(os.environ.get("RAFT_TPU_BQ_BITS", "4"))
+            midx = ivf_bq.build(dataset, ivf_bq.IvfBqParams(
+                n_lists=NLIST, bits=mb_bits, rotation_kind="hadamard",
+                kmeans_trainset_fraction=0.2))
+            _force(midx.list_scale)
+            mb = None
+            for nprobe in (NPROBE0, NPROBE0 * 2, NPROBE0 * 4, NPROBE0 * 8,
+                           NPROBE0 * 16):
+                vals, ids = ivf_bq.search(midx, queries, K,
+                                          n_probes=nprobe)
+                rec = float(stats.neighborhood_recall(ids, gt_ids, vals,
+                                                      gt_vals))
+                if mb is None or rec > mb["no_refine_recall"]:
+                    mb = {"no_refine_nprobe": nprobe,
+                          "no_refine_recall": round(rec, 4)}
+                if rec >= 0.95:
+                    break
+            bqb.update(mb)
+            bqb["no_refine_bits"] = mb_bits
+            bqb["no_refine_code_bytes_per_row"] = midx.code_bytes_per_row
+            bqb["no_refine_qps"] = round(_time_qps(
+                lambda qs: ivf_bq.search(
+                    midx, qs, K, n_probes=bqb["no_refine_nprobe"]),
+                queries, REPS, hist="bench.bq_build.batch_latency_s"), 1)
+            bqb.update(latency_percentiles("bench.bq_build.batch_latency_s"))
+            del midx
+            extras["bq_build"] = bqb
+        except Exception as e:
+            extras["bq_build"] = section_error(e)
+        hb.section("bq_build", extras["bq_build"])
 
     # --- Serving: streaming traffic against the paged mutable store --------
     # (ISSUE 8): Poisson arrivals into the SLO-aware QueryQueue over a

@@ -82,15 +82,18 @@ def shard_rows_held(world: int, n_total: int):
 
 
 def probe_shards(algo: str, world: int, n_total: int,
-                 health: Optional[resilience.ShardHealth] = None
-                 ) -> ShardReport:
+                 health: Optional[resilience.ShardHealth] = None,
+                 phase: str = "search") -> ShardReport:
     """Host-side per-shard dispatch gate — the availability layer's entry.
 
     For every shard not already LOST, fires the
-    ``distributed.<algo>.search.shard`` faultpoint (the injectable stand-in
-    for a dead host's dispatch error) and folds the verdict into the
-    health registry: a failing shard is dropped from THIS dispatch (its
-    candidates never enter the merge) and marked SUSPECT/LOST for the next.
+    ``distributed.<algo>.<phase>.shard`` faultpoint (the injectable
+    stand-in for a dead host's dispatch error; ``phase`` defaults to
+    "search" — the five search algos' long-standing sites — and the
+    distributed coarse k-means fit passes "fit") and folds the verdict
+    into the health registry: a failing shard is dropped from THIS
+    dispatch (its candidates never enter the merge) and marked
+    SUSPECT/LOST for the next.
 
     An active hard :class:`~raft_tpu.resilience.Deadline` slices its
     remaining budget evenly across the shards still to be probed — a shard
@@ -102,7 +105,7 @@ def probe_shards(algo: str, world: int, n_total: int,
     surviving coverage falls below the registry's minimum-coverage quorum.
     """
     health = health or resilience.shard_health()
-    site = f"distributed.{algo}.search.shard"
+    site = f"distributed.{algo}.{phase}.shard"
     world = int(world)
     rows = shard_rows_held(world, n_total)
     dl = resilience.active_deadline()
@@ -223,12 +226,12 @@ def assign_phase(work_sh, gids_sh, centers, km_metric, cap, n_lists, comms):
 
 def round_mls(max_count: int, group: int) -> int:
     """Common padded list size: group-aligned; power-of-two 512-chunks when
-    the strip backend's granule is in play (ops/strip_scan.py)."""
-    mls = max(group, -(-max_count // group) * group)
-    if group == 512:
-        chunks = mls // group
-        mls = group * (1 << (chunks - 1).bit_length())
-    return mls
+    the strip backend's granule is in play (ops/strip_scan.py). Delegates
+    to THE shared formula (_packing.round_list_size) so distributed and
+    single-host builds can never disagree on mls."""
+    from raft_tpu.neighbors._packing import round_list_size
+
+    return round_list_size(max_count, group, pow2_chunks=group == 512)
 
 
 def scatter_pack(labels, order_payloads, n_lists: int, mls: int):

@@ -35,6 +35,7 @@ from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors import ivf_bq as sl
 from raft_tpu.neighbors.ivf_bq import IvfBqParams
 from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops import linalg
 
 
 @dataclass
@@ -44,8 +45,8 @@ class ShardedIvfBqIndex:
     dimension."""
 
     centers: jax.Array     # (n_lists, dim) replicated
-    rotation: jax.Array    # (rot_dim, rot_dim) replicated
-    list_codes: jax.Array  # (world, n_lists, mls, rot_dim/8) uint8, P(axis)
+    rotation: jax.Array    # (rot_dim, rot_dim) dense | (rot_dim,) signs
+    list_codes: jax.Array  # (world, n_lists, mls, bits·rot_dim/8), P(axis)
     list_ids: jax.Array    # (world, n_lists, mls) int32, GLOBAL row ids
     list_scale: jax.Array  # (world, n_lists, mls) fp32, P(axis)
     bias: jax.Array        # (world, n_lists, mls) fp32, +inf padding
@@ -53,6 +54,8 @@ class ShardedIvfBqIndex:
     n_total: int
     comms: Comms
     lens_max: np.ndarray   # host (n_lists,) max per-list fill across shards
+    bits: int = 1
+    rotation_kind: str = "dense"
 
     @property
     def n_lists(self) -> int:
@@ -78,9 +81,11 @@ def build(
     comms: Optional[Comms] = None,
     res: Optional[Resources] = None,
 ) -> ShardedIvfBqIndex:
-    """Global centers (distributed k-means) + replicated rotation, then two
-    SPMD phases: assign + spill per shard, sign-encode + pack per shard at
-    a common padded list size."""
+    """Global centers (distributed balanced k-means — the shard-mapped
+    assign + psum centroid scatter-reduce that makes the build's only
+    O(N·d·K) phase SPMD, behind the shard-health gate) + replicated
+    rotation, then two SPMD phases: assign + spill per shard, level-encode
+    + pack per shard at a common padded list size."""
     res = res or current_resources()
     comms = comms or make_comms()
     world = comms.size
@@ -89,8 +94,8 @@ def build(
     n, dim = dataset.shape
     if params.n_lists * world > n:
         raise ValueError(f"n_lists={params.n_lists} x {world} shards > n_rows={n}")
-    rot_dim = sl.auto_rot_dim(dim)
-    nb = rot_dim // 8
+    rot_dim = sl.auto_rot_dim(dim, params.rotation_kind)
+    nb = (params.bits * rot_dim) // 8
 
     work = dataset
     if params.metric == "cosine":
@@ -98,24 +103,22 @@ def build(
     km_metric = ("inner_product" if params.metric in ("cosine", "inner_product")
                  else "sqeuclidean")
 
-    # --- global coarse quantizer: data-sharded k-means (psum over shards) --
-    from raft_tpu.cluster.kmeans import KMeansParams
+    # --- global coarse quantizer: data-sharded BALANCED k-means (psum over
+    # shards, behind the shard-health fit gate — distributed/kmeans) ------
+    from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
     from raft_tpu.distributed import kmeans as dkm
 
-    out, _ = dkm.fit(
-        work, KMeansParams(n_clusters=params.n_lists,
-                           max_iter=params.kmeans_n_iters, seed=params.seed),
+    centers, _, _ = dkm.fit_balanced(
+        work, params.n_lists,
+        KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                             metric=km_metric, seed=params.seed),
         comms=comms,
     )
-    centers = out.centroids
-    if params.metric in ("cosine", "inner_product"):
-        centers = centers / jnp.maximum(
-            jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
-    # replicated rotation: every shard derives the identical matrix from
+    # replicated rotation: every shard derives the identical operand from
     # the shared seed — no collective
     key = jax.random.key(params.seed)
     _, k_rot = jax.random.split(key)
-    rotation = sl.make_rotation_matrix(k_rot, rot_dim)
+    rotation = sl._make_rotation(k_rot, rot_dim, params.rotation_kind)
 
     # --- shard rows + SPMD assign/spill phase (shared helpers) -------------
     from raft_tpu.distributed._sharding import (assign_phase, round_mls,
@@ -130,16 +133,17 @@ def build(
         work_sh, gids_sh, centers, km_metric, cap, n_lists, comms)
     mls = round_mls(int(counts_np.max()), sl._GROUP)
 
-    # --- phase 2 (SPMD): sign-encode + pack at the common padded size ------
+    # --- phase 2 (SPMD): level-encode + pack at the common padded size -----
     l2 = params.metric in ("sqeuclidean", "euclidean")
-    rc = sl._pad_rot(centers, rot_dim) @ rotation.T
+    rc = linalg.rotate_rows(centers, rotation, params.rotation_kind)
     c2 = dist_mod.sqnorm(centers)
 
     def pack_body(rows, ids, labels):
         rows, ids, labels = rows[0], ids[0], labels[0]
         safe = jnp.minimum(labels, n_lists - 1)
-        codes, scale, row_bias = sl._encode_chunk(
-            rows, safe, centers, rotation, rc, c2, l2)
+        codes, scale, row_bias = sl._encode_math(
+            rows, safe, centers, rotation, rc, c2, l2, params.bits,
+            params.rotation_kind)
         lc, li, lscale, lbias = scatter_pack(
             labels,
             [(jnp.zeros((n_lists, mls, nb), jnp.uint8), codes),
@@ -162,6 +166,7 @@ def build(
     return ShardedIvfBqIndex(
         centers, rotation, list_codes, list_ids, list_scale, bias,
         params.metric, n, comms, counts_np.max(axis=0).astype(np.int32),
+        params.bits, params.rotation_kind,
     )
 
 
@@ -196,6 +201,7 @@ def search(
         queries, index.centers, index.rotation,
         jnp.zeros((1, 1), jnp.float32), jnp.full((1, 1), -1, jnp.int32),
         None, n_probes, index.metric, "exact", res.compute_dtype, l2,
+        index.bits, index.rotation_kind,
     )
     # dense packed scan off-TPU: the interpreted kernel serializes
     # virtual-mesh shards (see distributed/ivf_flat.py)

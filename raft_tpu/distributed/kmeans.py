@@ -19,8 +19,11 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from raft_tpu import obs
 
 from raft_tpu.cluster.kmeans import (
     KMeansOutput,
@@ -28,6 +31,7 @@ from raft_tpu.cluster.kmeans import (
     _init_plus_plus,
     _init_random,
 )
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.comms.comms import Comms, make_comms, shard_padded
 from raft_tpu.core.compat import shard_map
 from raft_tpu.core.resources import Resources, current_resources
@@ -146,3 +150,177 @@ def fit(
         if params.init == "array":
             break  # deterministic start: n_init re-runs would be identical
     return best, best_labels[:n]
+
+
+# ---------------------------------------------------------------------------
+# Balanced k-means — the distributed IVF coarse-quantizer trainer
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _make_balanced_fit_fn(mesh, axis, n_clusters, n_iters, metric,
+                          threshold):
+    """One shard_map'd program: the whole balanced EM as a while_loop of
+    shard-local assigns + two ``psum``s (cluster sums, counts) — the
+    O(N·d·K) assignment phase is SPMD, which is the entire point of
+    training the coarse codebook distributed (billion-scale builds pay
+    kmeans, not encode).
+
+    The balancing reseed (cluster/kmeans_balanced.cuh adjust_centers
+    analog, splitting form — see cluster/kmeans_balanced._balanced_em) is
+    made SPMD by electing a GLOBAL random representative per cluster:
+    per-row uniform keys (folded with the shard index so shards draw
+    distinct keys), shard-local segment_max, cross-shard ``pmax``, and a
+    masked ``psum`` to fetch the winning row — deterministic given the
+    seed, no host sync, ties (measure-zero fp uniforms) fold to the
+    representatives' mean."""
+
+    def spmd_fit(shard_X, shard_w, centers0, key):
+        rp = shard_X.shape[0]
+        me = lax.axis_index(axis)
+        n_global = lax.psum(jnp.sum(shard_w), axis)
+        average = n_global / n_clusters
+        max_iters = 5 * n_iters
+
+        def assign(centers):
+            if metric == "inner_product":
+                ip = lax.dot_general(
+                    shard_X, centers, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return -jnp.max(ip, axis=1), \
+                    jnp.argmax(ip, axis=1).astype(jnp.int32)
+            return fused_l2_nn_argmin(shard_X, centers)
+
+        def m_step(labels, centers):
+            """Weighted cross-shard centroid update — the ONE copy the
+            loop body and the final step share; returns (raw centers,
+            global counts). The ip renormalize (:func:`renorm`) applies
+            AFTER any reseed, so reseeded centers are normalized too."""
+            onehot = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+            w = shard_w[:, None]
+            sums = lax.psum(onehot.T @ (shard_X * w), axis)
+            counts = lax.psum((onehot * w).sum(axis=0), axis)
+            centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts, 1e-12)[:, None],
+                                centers)
+            return centers, counts
+
+        def renorm(centers):
+            # IP/cosine EM drifts toward zero centers without
+            # renormalization (detail/kmeans_balanced.cuh:656-668)
+            if metric != "inner_product":
+                return centers
+            return centers / jnp.maximum(
+                jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-30)
+
+        def step(it, centers):
+            _, labels = assign(centers)
+            centers, counts = m_step(labels, centers)
+            small = counts < threshold * average
+            # global random representative per cluster (docstring)
+            u = jax.random.uniform(
+                jax.random.fold_in(jax.random.fold_in(key, it), me),
+                (rp,)) * shard_w
+            maxu_l = jax.ops.segment_max(u, labels,
+                                         num_segments=n_clusters)
+            maxu = lax.pmax(jnp.maximum(maxu_l, 0.0), axis)
+            is_rep = ((u >= maxu[labels]) & (u > 0)).astype(jnp.float32)
+            rep_sum = lax.psum(
+                jax.ops.segment_sum(shard_X * is_rep[:, None], labels,
+                                    num_segments=n_clusters), axis)
+            rep_cnt = lax.psum(
+                jax.ops.segment_sum(is_rep, labels,
+                                    num_segments=n_clusters), axis)
+            rep_pt = rep_sum / jnp.maximum(rep_cnt, 1.0)[:, None]
+            donor_order = jnp.argsort(-counts)
+            rank = jnp.clip(jnp.cumsum(small.astype(jnp.int32)) - 1, 0,
+                            n_clusters - 1)
+            donor = donor_order[rank]
+            c_new = 0.5 * (centers[donor] + rep_pt[donor])
+            reseed = small & (rep_cnt[donor] > 0)
+            centers = jnp.where(reseed[:, None], c_new, centers)
+            return renorm(centers), jnp.any(small)
+
+        def cond(carry):
+            _, it, rebalancing = carry
+            return jnp.logical_or(
+                it < n_iters,
+                jnp.logical_and(rebalancing, it < max_iters))
+
+        def body(carry):
+            centers, it, _ = carry
+            centers, rebalancing = step(it, centers)
+            return centers, it + 1, rebalancing
+
+        centers, _, _ = lax.while_loop(
+            cond, body, (centers0, jnp.int32(0), jnp.bool_(True)))
+        # final M step + re-predict so returned labels match returned
+        # centers (the single-device _balanced_em contract)
+        _, labels = assign(centers)
+        centers, _ = m_step(labels, centers)
+        centers = renorm(centers)
+        score, labels = assign(centers)
+        inertia = lax.psum(jnp.sum(score * shard_w), axis)
+        return centers, labels, inertia
+
+    fn = shard_map(
+        spmd_fit,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(), P()),
+        out_specs=(P(), P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@traced("distributed.kmeans::fit_balanced")
+def fit_balanced(
+    X,
+    n_clusters: int,
+    params: KMeansBalancedParams = KMeansBalancedParams(),
+    comms: Optional[Comms] = None,
+    res: Optional[Resources] = None,
+    health=None,
+):
+    """Data-sharded balanced k-means — the distributed IVF coarse trainer
+    (the ``kmeans_balanced.fit_predict`` analog over the mesh; ivf_bq's
+    distributed build consumes it so the only O(N·d·K) build phase is
+    SPMD). Returns ``(centers, labels, report)`` where ``report`` is the
+    shard-health :class:`~raft_tpu.distributed._sharding.ShardReport`.
+
+    Behind the shard-health gate like the five distributed searches: the
+    dispatch runs through ``probe_shards(..., phase="fit")`` (faultpoint
+    ``distributed.kmeans.fit.shard``) first, and a failing shard's rows
+    get weight 0 in every ``psum`` — training proceeds over the
+    survivors, coverage reported, never a crash. Labels are still
+    computed for every row (the program is SPMD; a masked shard's rows
+    simply never influenced the centers)."""
+    from raft_tpu.distributed._sharding import probe_shards
+
+    res = res or current_resources()
+    comms = comms or make_comms(res)
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    if not 0 < n_clusters <= n:
+        raise ValueError(f"n_clusters={n_clusters} out of range for n={n}")
+    world = comms.size
+    report = probe_shards("kmeans", world, n, health, phase="fit")
+    w = np.ones(n, np.float32)
+    rows_per = -(-n // world)
+    for r in range(world):
+        if not report.ok[r]:
+            w[r * rows_per:(r + 1) * rows_per] = 0.0
+    Xs, _ = shard_padded(X, comms)
+    ws, _ = shard_padded(jnp.asarray(w), comms, fill=0.0)
+    fn = _make_balanced_fit_fn(
+        comms.mesh, comms.axis, int(n_clusters), int(params.n_iters),
+        params.metric, float(params.balancing_threshold))
+    key = jax.random.key(params.seed)
+    k_init, k_adjust = jax.random.split(key)
+    rows = jax.random.randint(k_init, (n_clusters,), 0, n)
+    centers0 = X[rows].astype(jnp.float32)
+    if obs.enabled():
+        obs.add("distributed.kmeans.fit_balanced.rows", n)
+        obs.add("distributed.kmeans.fit_balanced.clusters", int(n_clusters))
+    centers, labels, _ = fn(Xs, ws, centers0, k_adjust)
+    return centers, labels[:n], report

@@ -9,9 +9,12 @@ ivf_pq (codes); both build and extend flows.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 # nearest-alternative rounds the spill runs before its pressure valve
 # (round 3: one alternative was not enough — see _spill_core)
@@ -38,6 +41,23 @@ def paged_trace_count() -> int:
     return sum(obs_compile.trace_count(e) for e in PAGED_ENTRIES)
 
 
+def round_list_size(max_count: int, group_size: int,
+                    pow2_chunks: bool = False) -> int:
+    """THE padded-list-size formula: max cluster size rounded up to
+    ``group_size``, and — under ``pow2_chunks`` (the strip backend's
+    block-divisibility requirement) — to a power-of-two number of
+    group_size chunks. One copy: :func:`pack_lists`, the streamed builds'
+    pre-sized donated blocks, the distributed common-mls computation
+    (_sharding.round_mls) and the bench's share restatement must all
+    agree EXACTLY or scattered rows overwrite/drop and byte predictions
+    drift."""
+    mls = max(group_size, -(-int(max_count) // group_size) * group_size)
+    if pow2_chunks:
+        chunks = mls // group_size
+        mls = group_size * (1 << (chunks - 1).bit_length())
+    return mls
+
+
 def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,
                pow2_chunks: bool = False) -> Tuple:
     """Scatter rows into padded per-list blocks.
@@ -52,11 +72,8 @@ def pack_lists(payload, row_ids, labels, n_lists: int, group_size: int,
     """
     n = payload.shape[0]
     sizes = jnp.bincount(labels, length=n_lists)
-    max_size = int(jnp.max(sizes))
-    max_size = max(group_size, -(-max_size // group_size) * group_size)
-    if pow2_chunks:
-        chunks = max_size // group_size
-        max_size = group_size * (1 << (chunks - 1).bit_length())
+    max_size = round_list_size(int(jnp.max(sizes)), group_size,
+                               pow2_chunks)
 
     order = jnp.argsort(labels)
     sorted_labels = labels[order]
@@ -237,3 +254,97 @@ def unpack_lists(list_payload, list_ids) -> Tuple:
     ids = list_ids.reshape(-1)[valid]
     labels = jnp.repeat(jnp.arange(n_lists, dtype=jnp.int32), max_size)[valid]
     return payload, ids, labels
+
+
+# ---------------------------------------------------------------------------
+# Streamed-build helpers (promoted from ivf_pq round 17 so the ivf_bq
+# streamed build shares ONE copy of the offset/rank/diversion math — the
+# scatter position arithmetic and the capacity check must agree exactly or
+# rows overwrite/drop)
+# ---------------------------------------------------------------------------
+
+
+def chunk_ranks(labels, n_lists: int):
+    """Chunk-local arrival rank of each row within its label, in
+    label-sorted order: returns ``(order, sorted_labels, rank_sorted)``.
+    The ONE definition shared by the streamed-build scatter position math
+    and the capacity diversion's fill check. Sentinel labels (== n_lists)
+    sort last and rank within the sentinel bucket."""
+    m = labels.shape[0]
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    counts = jnp.bincount(labels, length=n_lists + 1)[:n_lists]
+    offsets = jnp.cumsum(counts) - counts
+    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
+    rank_sorted = (jnp.arange(m, dtype=jnp.int32)
+                   - offsets[safe_sl].astype(jnp.int32))
+    return order, sorted_labels, rank_sorted
+
+
+@functools.partial(jax.jit, static_argnames=("block", "metric"))
+def assign_top2(rows, centers, block: int = 4096,
+                metric: str = "sqeuclidean"):
+    """Best and second-best center per row, tiled over center blocks
+    (fused_l2_nn_argmin gives only the argmin; the streamed builds'
+    capacity diversion needs the runner-up as the spill target — the
+    one-pass analog of :func:`spill_to_cap`'s first alternative round).
+    ``metric`` matches kmeans_balanced._assign: "sqeuclidean" ranks by
+    expanded L2, "inner_product" by −⟨row, center⟩."""
+    m, dim = rows.shape
+    n_c = centers.shape[0]
+    nb = -(-n_c // block)
+    cpad = jnp.pad(centers, ((0, nb * block - n_c), (0, 0)))
+    cn = jnp.sum(cpad * cpad, axis=1)
+    cn = jnp.where(jnp.arange(nb * block) < n_c, cn, jnp.inf)
+
+    def step(carry, bi):
+        v1, i1, v2, i2 = carry
+        cb = lax.dynamic_slice_in_dim(cpad, bi * block, block, axis=0)
+        bn = lax.dynamic_slice_in_dim(cn, bi * block, block, axis=0)
+        ip = jnp.einsum("md,cd->mc", rows, cb,
+                        preferred_element_type=jnp.float32)
+        d = -ip if metric == "inner_product" else bn[None, :] - 2.0 * ip
+        d = jnp.where(jnp.isinf(bn)[None, :], jnp.inf, d)
+        bv1 = jnp.min(d, axis=1)
+        ba1 = jnp.argmin(d, axis=1).astype(jnp.int32) + bi * block
+        d2 = jnp.where(jnp.arange(block)[None, :]
+                       == (ba1 - bi * block)[:, None], jnp.inf, d)
+        bv2 = jnp.min(d2, axis=1)
+        ba2 = jnp.argmin(d2, axis=1).astype(jnp.int32) + bi * block
+        # merge two sorted pairs -> global best two
+        cand_v = jnp.stack([v1, v2, bv1, bv2], axis=1)
+        cand_i = jnp.stack([i1, i2, ba1, ba2], axis=1)
+        nv1 = jnp.min(cand_v, axis=1)
+        na1 = jnp.argmin(cand_v, axis=1)
+        ni1 = jnp.take_along_axis(cand_i, na1[:, None], axis=1)[:, 0]
+        cv2 = jnp.where(jnp.arange(4)[None, :] == na1[:, None],
+                        jnp.inf, cand_v)
+        na2 = jnp.argmin(cv2, axis=1)
+        nv2 = jnp.take_along_axis(cv2, na2[:, None], axis=1)[:, 0]
+        ni2 = jnp.take_along_axis(cand_i, na2[:, None], axis=1)[:, 0]
+        return (nv1, ni1, nv2, ni2), None
+
+    init = (jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32),
+            jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32))
+    (v1, i1, v2, i2), _ = lax.scan(step, init,
+                                   jnp.arange(nb, dtype=jnp.int32))
+    return i1, i2
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def divert_to_cap(l1, l2, run_counts, cap, n_lists):
+    """Capacity diversion for one streamed chunk: rows whose nearest list
+    is full (given the running fill) take their second-nearest; rows whose
+    second choice is also full get the drop sentinel ``n_lists``. Ranks are
+    chunk-local arrival order, matching the scatter's position math."""
+    m = l1.shape[0]
+
+    def rank_of(lab):
+        order, _, rank_sorted = chunk_ranks(lab, n_lists)
+        return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
+
+    full1 = run_counts[l1] + rank_of(l1) >= cap
+    lab = jnp.where(full1, l2, l1)
+    # re-rank under the diverted labels; overflow past cap drops
+    full2 = run_counts[jnp.minimum(lab, n_lists - 1)] + rank_of(lab) >= cap
+    return jnp.where(full2, n_lists, lab).astype(jnp.int32)

@@ -58,9 +58,14 @@ from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.neighbors import _packing
-from raft_tpu.neighbors.ivf_pq import _pad_rot, make_rotation_matrix
 from raft_tpu.ops import distance as dist_mod
-from raft_tpu.ops.bq_scan import pack_sign_bits
+from raft_tpu.ops import linalg
+from raft_tpu.ops.bq_scan import (extend_query_planes, multibit_width,
+                                  pack_code_planes, pack_sign_bits)
+
+# legacy alias (pre-round-17 this module imported ivf_pq's private helper;
+# the shared copy now lives in ops/linalg — satellite 1)
+_pad_rot = linalg.pad_rot
 
 SUPPORTED_METRICS = ("sqeuclidean", "euclidean", "inner_product", "cosine")
 
@@ -84,8 +89,14 @@ def scan_trace_count() -> int:
 @dataclass(frozen=True)
 class IvfBqParams:
     """Build params (IvfFlatParams shape — BQ has no codebook knobs; the
-    one new degree of freedom is the rotation width, fixed at
-    ceil(dim/8)·8 so codes pack to whole bytes)."""
+    degrees of freedom are the rotation representation and the code width).
+
+    ``rotation_kind``: "dense" (explicit QR rotation matrix — the legacy
+    representation) or "hadamard" (SRHT: sign diagonal + fast Walsh–
+    Hadamard butterfly, O(d·log d) apply — the billion-scale build
+    default; see ops/linalg). ``bits`` (1–4): bits per rotated dimension —
+    1 is the classic sign code, 2–4 stack extra bit-planes for the
+    high-recall/no-refine regime (module docstring)."""
 
     n_lists: int = 1024
     metric: str = "sqeuclidean"
@@ -93,6 +104,8 @@ class IvfBqParams:
     kmeans_trainset_fraction: float = 0.5
     # per-list occupancy cap: -1 = auto (4× mean, group-aligned), 0 = off
     list_size_cap: int = -1
+    bits: int = 1
+    rotation_kind: str = "dense"
     seed: int = 0
 
     def __post_init__(self):
@@ -100,6 +113,12 @@ class IvfBqParams:
         if m not in SUPPORTED_METRICS:
             raise ValueError(f"ivf_bq supports {SUPPORTED_METRICS}, got {self.metric!r}")
         object.__setattr__(self, "metric", m)
+        if not 1 <= self.bits <= 4:
+            raise ValueError(f"bits must be in [1, 4], got {self.bits}")
+        if self.rotation_kind not in linalg.ROTATION_KINDS:
+            raise ValueError(
+                f"rotation_kind must be one of {linalg.ROTATION_KINDS}, "
+                f"got {self.rotation_kind!r}")
 
 
 #: fixed list granule: code rows are tiny (rot_dim/8 bytes), so the strip
@@ -113,19 +132,26 @@ _GROUP = 512
 class IvfBqIndex:
     """Coarse centers + rotation + packed sign codes + correction scalars.
 
-    ``list_codes[l, j]`` holds row j's rot_dim sign bits (bit-plane-major,
-    ops/bq_scan.pack_sign_bits). ``list_scale`` is the per-row unbiasing
-    factor f = ‖u‖²/‖u‖₁ (0 at padding); ``list_bias`` the per-row additive
-    term of the estimator (module docstring; +inf at padding so the scan
-    self-masks). ``list_ids[l, j] == -1`` marks padding."""
+    ``list_codes[l, j]`` holds row j's ``bits`` packed code planes over
+    rot_dim dimensions (bit-plane-major per plane, ops/bq_scan
+    pack_code_planes; bits=1 is the classic pack_sign_bits layout).
+    ``list_scale`` is the per-row unbiasing factor f = ‖u‖²/⟨L, u⟩ (for
+    bits=1, ⟨b, u⟩ = ‖u‖₁; 0 at padding); ``list_bias`` the per-row
+    additive term of the estimator (module docstring; +inf at padding so
+    the scan self-masks). ``list_ids[l, j] == -1`` marks padding.
+    ``rotation`` is the dense orthogonal matrix for
+    ``rotation_kind="dense"`` or the SRHT (rot_dim,) sign diagonal for
+    ``rotation_kind="hadamard"`` (ops/linalg.rotate_rows applies either)."""
 
     centers: jax.Array     # (n_lists, dim) fp32 — for stage 1, unrotated
-    rotation: jax.Array    # (rot_dim, rot_dim) orthogonal
-    list_codes: jax.Array  # (n_lists, max_list_size, rot_dim/8) uint8
+    rotation: jax.Array    # (rot_dim, rot_dim) dense | (rot_dim,) signs
+    list_codes: jax.Array  # (n_lists, max_list_size, bits·rot_dim/8) uint8
     list_ids: jax.Array    # (n_lists, max_list_size) int32, -1 = padding
     list_scale: jax.Array  # (n_lists, max_list_size) fp32
     list_bias: jax.Array   # (n_lists, max_list_size) fp32, +inf at padding
     metric: str
+    bits: int = 1
+    rotation_kind: str = "dense"
 
     @property
     def n_lists(self) -> int:
@@ -137,6 +163,7 @@ class IvfBqIndex:
 
     @property
     def rot_dim(self) -> int:
+        # dense (rot_dim, rot_dim) and hadamard (rot_dim,) agree on axis 0
         return self.rotation.shape[0]
 
     @property
@@ -156,7 +183,8 @@ class IvfBqIndex:
 
     def tree_flatten(self):
         return (self.centers, self.rotation, self.list_codes, self.list_ids,
-                self.list_scale, self.list_bias), (self.metric,)
+                self.list_scale, self.list_bias), (self.metric, self.bits,
+                                                   self.rotation_kind)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -166,7 +194,8 @@ class IvfBqIndex:
     def save(self, path) -> None:
         save_arrays(
             path,
-            {"kind": "ivf_bq", "metric": self.metric},
+            {"kind": "ivf_bq", "metric": self.metric, "bits": self.bits,
+             "rotation_kind": self.rotation_kind},
             {
                 "centers": self.centers,
                 "rotation": self.rotation,
@@ -182,6 +211,18 @@ class IvfBqIndex:
         meta, arrays = load_arrays(path)
         if meta.get("kind") != "ivf_bq":
             raise ValueError(f"not an ivf_bq index: {meta.get('kind')}")
+        # legacy (pre-rotation_kind) files carry neither field: they were
+        # written by the dense-QR 1-bit build, so the defaults ARE their
+        # true description — old indexes load unchanged
+        rkind = meta.get("rotation_kind", "dense")
+        if rkind not in linalg.ROTATION_KINDS:
+            # classified (resilience.classify → FATAL ValueError): a file
+            # from a NEWER format revision must fail loudly by name, never
+            # decode garbage through the wrong apply
+            raise ValueError(
+                f"unknown ivf_bq rotation_kind {rkind!r} (supported: "
+                f"{linalg.ROTATION_KINDS}); the file may come from a newer "
+                "format revision")
         return cls(
             jnp.asarray(arrays["centers"]),
             jnp.asarray(arrays["rotation"]),
@@ -190,6 +231,8 @@ class IvfBqIndex:
             jnp.asarray(arrays["list_scale"]),
             jnp.asarray(arrays["list_bias"]),
             meta["metric"],
+            int(meta.get("bits", 1)),
+            rkind,
         )
 
 
@@ -198,31 +241,65 @@ class IvfBqIndex:
 # ---------------------------------------------------------------------------
 
 
-def auto_rot_dim(dim: int) -> int:
-    """Rotation width: dim rounded up to whole code bytes."""
+def auto_rot_dim(dim: int, rotation_kind: str = "dense") -> int:
+    """Rotation width: dim rounded up to whole code bytes (dense), or to
+    the next power of two (hadamard — the Walsh–Hadamard butterfly's
+    width, which is also whole bytes at ≥ 8)."""
+    if rotation_kind == "hadamard":
+        return linalg.hadamard_rot_dim(dim)
     return -(-dim // 8) * 8
 
 
-@functools.partial(jax.jit, static_argnames=("l2",))
-def _encode_chunk(rows, labels, centers, rotation, rc, c2, l2: bool):
-    """Encode one row chunk: rotate the residual, take signs, bake the two
-    correction scalars. Returns (packed codes (m, nb) uint8, scale (m,)
-    fp32, bias (m,) fp32). The one definition of the estimator's build
-    side — extend() and the distributed build reuse it so the scalars
-    cannot drift between flows."""
-    rot_dim = rotation.shape[0]
-    u = _pad_rot(rows - centers[labels], rot_dim) @ rotation.T
-    signs = jnp.where(u >= 0, jnp.int8(1), jnp.int8(-1))
-    packed = pack_sign_bits(signs)
+def _make_rotation(key, rot_dim: int, rotation_kind: str) -> jax.Array:
+    """The rotation operand for either representation (the dense QR matrix
+    or the SRHT sign diagonal), from one key — the single derivation
+    build/build_streaming/distributed-build all share."""
+    if rotation_kind == "hadamard":
+        return linalg.make_srht_signs(key, rot_dim)
+    return linalg.make_rotation_matrix(key, rot_dim)
+
+
+def _encode_math(rows, labels, centers, rotation, rc, c2, l2: bool,
+                 bits: int = 1, rotation_kind: str = "dense"):
+    """Encode one row chunk (plain traceable body — :func:`_encode_chunk`
+    is its jitted wrapper; the streamed-build scatter calls this inline):
+    rotate the residual, quantize to ``bits``-bit levels, bake the two
+    correction scalars. Returns (packed codes (m, bits·nb) uint8,
+    scale (m,) fp32, bias (m,) fp32). The one definition of the
+    estimator's build side — extend(), build_streaming() and the
+    distributed build reuse it so the scalars cannot drift between
+    flows."""
+    u = linalg.rotate_rows(rows - centers[labels], rotation, rotation_kind)
     norm2 = jnp.einsum("md,md->m", u, u, preferred_element_type=jnp.float32)
-    norm1 = jnp.sum(jnp.abs(u), axis=1)
-    # f = ‖u‖²/‖u‖₁ — the RaBitQ unbiasing quotient; a zero residual
+    if bits == 1:
+        signs = jnp.where(u >= 0, jnp.int8(1), jnp.int8(-1))
+        packed = pack_sign_bits(signs)
+        # ⟨b, u⟩ = ‖u‖₁ for the sign code — kept as the abs-sum so 1-bit
+        # scalars stay bit-identical with every pre-multi-bit index
+        proj = jnp.sum(jnp.abs(u), axis=1)
+        levels_f = signs.astype(jnp.float32)
+    else:
+        # symmetric uniform quantizer over [−t, t], t = max|u| per row:
+        # code c ∈ [0, 2^bits), dequantized LEVEL L = 2c − (2^bits−1)
+        # (odd integers; bits=1 would reduce to sign). The estimator stays
+        # the RaBitQ quotient f = ‖u‖²/⟨L, u⟩, which makes f·L the exact
+        # projection of u onto its own code direction — unbiased over the
+        # rotation by the same argument as the sign code.
+        t = jnp.maximum(jnp.max(jnp.abs(u), axis=1, keepdims=True), 1e-30)
+        c = jnp.clip(jnp.floor((u / t + 1.0) * (0.5 * (1 << bits))),
+                     0, (1 << bits) - 1).astype(jnp.uint8)
+        packed = pack_code_planes(c, bits)
+        levels_f = 2.0 * c.astype(jnp.float32) - jnp.float32((1 << bits) - 1)
+        proj = jnp.einsum("md,md->m", levels_f, u,
+                          preferred_element_type=jnp.float32)
+    # f = ‖u‖²/⟨L, u⟩ — the RaBitQ unbiasing quotient; a zero residual
     # (row == its center) gets f = 0, which makes the estimate exact
-    scale = norm2 / jnp.maximum(norm1, 1e-30)
+    # (⟨L, u⟩ ≥ 0 always: levels are monotone in u per dimension)
+    scale = norm2 / jnp.maximum(proj, 1e-30)
     if l2:
-        # 2·f·⟨b, R·c̃_l⟩ completes the −2⟨q−c, r⟩ cross term exactly at
+        # 2·f·⟨L, R·c̃_l⟩ completes the −2⟨q−c, r⟩ cross term exactly at
         # the per-row level; ‖c‖² + ‖u‖² are the expanded-L2 constants
-        g = jnp.einsum("md,md->m", signs.astype(jnp.float32), rc[labels],
+        g = jnp.einsum("md,md->m", levels_f, rc[labels],
                        preferred_element_type=jnp.float32)
         bias = c2[labels] + norm2 + 2.0 * scale * g
     else:
@@ -230,15 +307,18 @@ def _encode_chunk(rows, labels, centers, rotation, rc, c2, l2: bool):
     return packed, scale, bias
 
 
-def _encode_rows(work, labels, centers, rotation, metric,
-                 chunk: int = 262_144):
+_encode_chunk = functools.partial(jax.jit, static_argnames=(
+    "l2", "bits", "rotation_kind"))(_encode_math)
+
+
+def _encode_rows(work, labels, centers, rotation, metric, bits: int = 1,
+                 rotation_kind: str = "dense", chunk: int = 262_144):
     """Chunked encode over all rows (the 15M-row resident build must never
     hold an (n, rot_dim) fp32 residual array — the ivf_pq enc_chunk
     lesson)."""
     n = work.shape[0]
     l2 = metric in ("sqeuclidean", "euclidean")
-    rot_dim = rotation.shape[0]
-    rc = _pad_rot(centers, rot_dim) @ rotation.T
+    rc = linalg.rotate_rows(centers, rotation, rotation_kind)
     c2 = dist_mod.sqnorm(centers)
     parts = []
     for s in range(0, n, chunk):
@@ -246,7 +326,7 @@ def _encode_rows(work, labels, centers, rotation, metric,
         parts.append(_encode_chunk(
             lax.slice_in_dim(work, s, e, axis=0),
             lax.slice_in_dim(labels, s, e, axis=0),
-            centers, rotation, rc, c2, l2))
+            centers, rotation, rc, c2, l2, bits, rotation_kind))
     if len(parts) == 1:
         return parts[0]
     return tuple(jnp.concatenate([p[i] for p in parts]) for i in range(3))
@@ -268,7 +348,7 @@ def build(
     n, dim = dataset.shape
     if params.n_lists > n:
         raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
-    rot_dim = auto_rot_dim(dim)
+    rot_dim = auto_rot_dim(dim, params.rotation_kind)
 
     work = dataset.astype(jnp.float32)
     if params.metric == "cosine":
@@ -302,11 +382,14 @@ def build(
     if cap:
         labels = _packing.spill_to_cap(work, centers, labels, km_metric, cap)
 
-    rotation = make_rotation_matrix(k_rot, rot_dim)
-    enc_attrs = {"rows": int(n)} if obs.enabled() else None
+    rotation = _make_rotation(k_rot, rot_dim, params.rotation_kind)
+    enc_attrs = ({"rows": int(n), "bits": int(params.bits),
+                  "rotation_kind": params.rotation_kind}
+                 if obs.enabled() else None)
     with obs.record_span("ivf_bq::encode", attrs=enc_attrs):
         codes, scale, bias = _encode_rows(work, labels, centers, rotation,
-                                          params.metric)
+                                          params.metric, params.bits,
+                                          params.rotation_kind)
     with obs.record_span("ivf_bq::pack"):
         row_ids = jnp.arange(n, dtype=jnp.int32)
         list_codes, list_ids = _packing.pack_lists(
@@ -317,7 +400,8 @@ def build(
         list_scale = aux[:, :, 0]
         list_bias = jnp.where(list_ids >= 0, aux[:, :, 1], jnp.inf)
     return IvfBqIndex(centers, rotation, list_codes, list_ids, list_scale,
-                      list_bias, params.metric)
+                      list_bias, params.metric, params.bits,
+                      params.rotation_kind)
 
 
 @traced("ivf_bq::extend")
@@ -346,7 +430,8 @@ def extend(index: IvfBqIndex, new_vectors, new_ids=None,
         base_counts=index.list_sizes(),
     )
     new_codes, new_scale, new_bias = _encode_rows(
-        new_vectors, labels, index.centers, index.rotation, index.metric)
+        new_vectors, labels, index.centers, index.rotation, index.metric,
+        index.bits, index.rotation_kind)
 
     old_codes, old_ids, old_labels = _packing.unpack_lists(
         index.list_codes, index.list_ids)
@@ -373,7 +458,243 @@ def extend(index: IvfBqIndex, new_vectors, new_ids=None,
                                  index.n_lists, _GROUP, pow2_chunks=True)
     return IvfBqIndex(
         index.centers, index.rotation, list_codes, list_ids, aux[:, :, 0],
-        jnp.where(list_ids >= 0, aux[:, :, 1], jnp.inf), index.metric)
+        jnp.where(list_ids >= 0, aux[:, :, 1], jnp.inf), index.metric,
+        index.bits, index.rotation_kind)
+
+
+# ---------------------------------------------------------------------------
+# Streamed build (the billion-scale fast path)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _scatter_chunk_bq(list_codes, list_ids, list_scale, list_bias,
+                      codes, scale, bias, labels, base, row_start):
+    """One streamed-build chunk's offset-scatter into the DONATED packed
+    blocks (build_streaming pass 2). ``base`` is the per-list write offset
+    accumulated over previous chunks; the in-chunk rank comes from one
+    chunk-local sort (_packing.chunk_ranks — the ONE copy of the position
+    math), so no global position array ever exists. Encode runs OUTSIDE
+    (non-donating :func:`_encode_chunk`), so its OOM-degraded retry can
+    never invalidate a donated block."""
+    m = labels.shape[0]
+    n_lists, mls = list_ids.shape
+    order, sorted_labels, rank_sorted = _packing.chunk_ranks(labels, n_lists)
+    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
+    pos = base[safe_sl].astype(jnp.int32) + rank_sorted
+    # sentinel labels (== n_lists, the diversion drop marker) and overflow
+    # past mls route to row mls, which mode="drop" discards
+    pos = jnp.where((sorted_labels < n_lists) & (pos < mls), pos, mls)
+    list_codes = list_codes.at[safe_sl, pos].set(codes[order], mode="drop")
+    ids = row_start + jnp.arange(m, dtype=jnp.int32)
+    list_ids = list_ids.at[safe_sl, pos].set(ids[order], mode="drop")
+    list_scale = list_scale.at[safe_sl, pos].set(scale[order], mode="drop")
+    list_bias = list_bias.at[safe_sl, pos].set(bias[order], mode="drop")
+    return list_codes, list_ids, list_scale, list_bias
+
+
+def _encode_chunk_degradable(rows, labels, centers, rotation, rc, c2, l2,
+                             bits, rotation_kind, floor: int = 4096):
+    """One chunk through :func:`_encode_chunk` behind the
+    ``ivf_bq.build.encode_chunk`` faultpoint, with the round-7 OOM
+    recovery: an OOM-classified failure halves the encode sub-chunk (down
+    to ``floor``) and re-encodes in parts — per-row math is row-independent
+    so the degraded result is bit-identical, only the dispatch count
+    grows. DEADLINE/FATAL classes propagate classified."""
+    from raft_tpu import resilience
+
+    m = rows.shape[0]
+    # small chunks still get at least one halving before the floor bites
+    # (the floor exists to stop meaningless 64-row dispatch storms, not to
+    # veto recovery outright) — max with 64 AFTER the m//2 clamp, so even
+    # a 256-row chunk halves once instead of dying on its first OOM
+    floor = max(64, min(floor, m // 2))
+    sub = m
+    while True:
+        try:
+            resilience.faultpoint("ivf_bq.build.encode_chunk")
+            if sub >= m:
+                return _encode_chunk(rows, labels, centers, rotation, rc,
+                                     c2, l2, bits, rotation_kind)
+            parts = []
+            for s in range(0, m, sub):
+                e = min(s + sub, m)
+                parts.append(_encode_chunk(
+                    lax.slice_in_dim(rows, s, e, axis=0),
+                    lax.slice_in_dim(labels, s, e, axis=0),
+                    centers, rotation, rc, c2, l2, bits, rotation_kind))
+            return tuple(jnp.concatenate([p[i] for p in parts])
+                         for i in range(3))
+        except Exception as e:
+            kind = resilience.classify(e)
+            if kind == resilience.OOM and sub > floor:
+                sub = max(floor, sub // 2)
+                obs.add("ivf_bq.build.degraded_chunk")
+                resilience.record_event(
+                    "degraded_chunk", site="ivf_bq.build.encode_chunk",
+                    chunk_rows=sub)
+                continue
+            raise
+
+
+@traced("ivf_bq::build_streaming")
+def build_streaming(
+    chunk_fn,
+    n: int,
+    dim: int,
+    params: IvfBqParams = IvfBqParams(),
+    res: Optional[Resources] = None,
+    chunk_rows: int = 0,
+    train_rows: int = 0,
+) -> IvfBqIndex:
+    """Out-of-HBM IVF-BQ build: the dataset visits the device one chunk at
+    a time (the SIFT-1B per-chip-share configuration — peak residency is
+    the packed index + ONE chunk's encode transient, never the raw
+    (n, dim) matrix; obs.costmodel.predict_build_streaming_bytes is the
+    closed-form bound, asserted in tier-1).
+
+    ``chunk_fn(start, end) -> (end-start, dim) array`` supplies rows — a
+    file reader (bench/io.py), a generator, or a host array slice. It is
+    called once per chunk per pass (twice total), so it must be
+    deterministic. ``chunk_rows`` defaults to the workspace-budget
+    formula, overridable via ``RAFT_TPU_BQ_BUILD_CHUNK``.
+
+    Rides the ``ivf_pq.build_streaming`` cache-only pattern: quantizers
+    train on ``train_rows`` sampled rows (default ≤ 2M; ``>= n`` streams
+    the WHOLE dataset through training, in which case the output is
+    BIT-IDENTICAL — codes, scales, ids — to one-shot :func:`build` at
+    ``kmeans_trainset_fraction=1`` and ``list_size_cap=0``, the
+    check.sh/tier-1 parity contract); pass 1 streams label assignment
+    (capacity diversion under a cap: nearest-full rows take their
+    second-nearest, doubly-full rows are DROPPED and counted on
+    ``index._streaming_dropped``); pass 2 encodes each chunk through the
+    shared :func:`_encode_chunk` (the ``ivf_bq.build.encode_chunk``
+    faultpoint with OOM→halve-chunk degraded retry, round-7 gate) and
+    offset-scatters into DONATED blocks."""
+    import os
+
+    import numpy as np
+
+    res = res or current_resources()
+    if params.metric == "cosine":
+        raise ValueError("build_streaming: cosine needs normalized chunks; "
+                         "normalize inside chunk_fn and use inner_product")
+    rot_dim = auto_rot_dim(dim, params.rotation_kind)
+    nb_total = multibit_width(rot_dim, params.bits)
+    km_metric = ("inner_product" if params.metric == "inner_product"
+                 else "sqeuclidean")
+    km = kmeans_balanced.KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=km_metric, seed=params.seed)
+    env_chunk = int(os.environ.get("RAFT_TPU_BQ_BUILD_CHUNK", "0") or 0)
+    chunk = int(chunk_rows) or env_chunk or int(
+        max(262_144, min(n, res.workspace_bytes // max(dim * 12, 1))))
+    chunk = min(chunk, n)
+    starts = list(range(0, n, chunk))
+    cap = params.list_size_cap
+    if cap < 0:
+        cap = _packing.auto_list_cap(n, params.n_lists, _GROUP)
+
+    from raft_tpu.core.interruptible import check_interrupt
+
+    # --- quantizers (same key derivation as build(): bit-identity) ---------
+    key = jax.random.key(params.seed)
+    _k_train, k_rot = jax.random.split(key)
+    rotation = _make_rotation(k_rot, rot_dim, params.rotation_kind)
+    t_rows = int(train_rows) or int(min(2_000_000, max(
+        params.n_lists * 32, n * params.kmeans_trainset_fraction)))
+    t_rows = min(t_rows, n)
+    with obs.record_span("ivf_bq::coarse_train"):
+        if t_rows >= n:
+            # full-data training: read whole chunks so the trainset IS the
+            # dataset in order (the bit-identity-with-build() contract)
+            train_parts = [jnp.asarray(chunk_fn(s, min(s + chunk, n)),
+                                       jnp.float32) for s in starts]
+        else:
+            per = max(1, t_rows // len(starts))
+            train_parts = [jnp.asarray(chunk_fn(s, min(s + per, n)),
+                                       jnp.float32) for s in starts]
+        trainset = (jnp.concatenate(train_parts) if len(train_parts) > 1
+                    else train_parts[0])
+        del train_parts
+        centers = kmeans_balanced.fit(trainset, params.n_lists, km, res=res)
+        del trainset
+    if obs.enabled():
+        obs.add("ivf_bq.build.rows", n)
+        obs.add("ivf_bq.build.lists", params.n_lists)
+        obs.add("ivf_bq.build.streamed_chunks", len(starts))
+
+    # --- pass 1: streamed assignment (+ capacity diversion under a cap) ----
+    n_lists = params.n_lists
+    run = np.zeros(n_lists, np.int64)
+    counts_np = np.zeros((len(starts), n_lists), np.int64)
+    labels_chunks = []
+    dropped = 0
+    for ci, s in enumerate(starts):
+        check_interrupt()
+        e = min(s + chunk, n)
+        rows = jnp.asarray(chunk_fn(s, e), jnp.float32)
+        if cap:
+            l1, l2_ = _packing.assign_top2(rows, centers, metric=km_metric)
+            labels = _packing.divert_to_cap(
+                l1, l2_, jnp.asarray(run, jnp.int32), jnp.int32(cap),
+                n_lists)
+        else:
+            labels = kmeans_balanced.predict(rows, centers, km, res=res)
+        labels_chunks.append(labels)
+        # deliberate per-chunk host fetch (ivf_pq.build_streaming precedent):
+        # the streamed build is host-driven by design — the (n_lists,) count
+        # steers cap diversion and the pass-2 offsets, amortized by the
+        # chunk's assign gemm
+        c = np.asarray(jnp.bincount(jnp.minimum(labels, n_lists),  # graftlint: ignore[loop-host-transfer]
+                                    length=n_lists + 1))
+        counts_np[ci] = c[:n_lists]
+        dropped += int(c[n_lists])
+        run += c[:n_lists]
+        del rows
+    totals = counts_np.sum(axis=0)
+    # strip-eligible padded size: 512 granule, pow2 chunks — THE shared
+    # pack_lists formula, so one-shot and streamed builds agree on mls
+    mls = _packing.round_list_size(int(totals.max()), _GROUP,
+                                   pow2_chunks=True)
+    base_np = np.cumsum(counts_np, axis=0) - counts_np  # per-chunk offsets
+    if dropped:
+        from raft_tpu.core.logger import get_logger
+
+        get_logger().warning(
+            "ivf_bq.build_streaming: %d row(s) overflowed both their "
+            "nearest and second-nearest capped lists and were dropped "
+            "(cap=%d); raise list_size_cap or n_lists.", dropped, cap)
+
+    # --- pass 2: encode + offset-scatter into donated blocks ---------------
+    l2 = params.metric in ("sqeuclidean", "euclidean")
+    rc = linalg.rotate_rows(centers, rotation, params.rotation_kind)
+    c2 = dist_mod.sqnorm(centers)
+    list_codes = jnp.zeros((n_lists, mls, nb_total), jnp.uint8)
+    list_ids = jnp.full((n_lists, mls), -1, jnp.int32)
+    list_scale = jnp.zeros((n_lists, mls), jnp.float32)
+    list_bias = jnp.full((n_lists, mls), jnp.inf, jnp.float32)
+    for ci, s in enumerate(starts):
+        check_interrupt()
+        e = min(s + chunk, n)
+        rows = jnp.asarray(chunk_fn(s, e), jnp.float32)
+        labels = labels_chunks[ci]
+        safe = jnp.minimum(labels, n_lists - 1)
+        with obs.record_span("ivf_bq::encode_chunk",
+                             attrs=({"rows": int(e - s), "chunk": ci}
+                                    if obs.enabled() else None)):
+            codes, scale, bias = _encode_chunk_degradable(
+                rows, safe, centers, rotation, rc, c2, l2, params.bits,
+                params.rotation_kind)
+            list_codes, list_ids, list_scale, list_bias = _scatter_chunk_bq(
+                list_codes, list_ids, list_scale, list_bias, codes, scale,
+                bias, labels, jnp.asarray(base_np[ci], jnp.int32),
+                jnp.int32(s))
+        del rows
+    out = IvfBqIndex(centers, rotation, list_codes, list_ids, list_scale,
+                     list_bias, params.metric, params.bits,
+                     params.rotation_kind)
+    out._streaming_dropped = dropped
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -384,20 +705,24 @@ def extend(index: IvfBqIndex, new_vectors, new_ids=None,
 @functools.partial(
     jax.jit,
     static_argnames=("n_probes", "metric", "select_algo", "compute_dtype",
-                     "l2"),
+                     "l2", "bits", "rotation_kind"),
 )
 def _bq_search_prep(queries, centers, rotation, list_bias, list_ids, filter,
-                    n_probes, metric, select_algo, compute_dtype, l2):
+                    n_probes, metric, select_algo, compute_dtype, l2,
+                    bits: int = 1, rotation_kind: str = "dense"):
     """Stage 1 + operand prep: ONE coarse gemm feeds both the probe ranking
     and the exact per-pair center term (ivf_pq's shared ``_pq_probe_prep``
     — one copy of the math, so the packed and paged engines cannot
-    drift); the rotated query is the scan's A operand. ``list_bias`` /
-    ``list_ids`` may equally be a paged store's (capacity, page_rows)
-    pools — the masking is shape-agnostic."""
+    drift); the rotated query — plane-extended for multi-bit codes
+    (ops/bq_scan.extend_query_planes) — is the scan's A operand.
+    ``list_bias`` / ``list_ids`` may equally be a paged store's
+    (capacity, page_rows) pools — the masking is shape-agnostic."""
     from raft_tpu.neighbors.ivf_pq import _pq_probe_prep
 
     probes, qr, pair_const = _pq_probe_prep(
-        queries, centers, rotation, n_probes, select_algo, l2)
+        queries, centers, rotation, n_probes, select_algo, l2,
+        rotation_kind)
+    qr = extend_query_planes(qr, bits)
     bias = list_bias
     if filter is not None:
         bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
@@ -408,11 +733,12 @@ def _bq_search_prep(queries, centers, rotation, list_bias, list_ids, filter,
     jax.jit,
     static_argnames=("k", "n_probes", "metric", "select_algo",
                      "compute_dtype", "classes", "class_counts", "q_tile",
-                     "interpret", "impl"),
+                     "interpret", "impl", "bits", "rotation_kind"),
 )
 def _bq_fused(queries, centers, rotation, list_codes, list_scale, list_bias,
               list_ids, filter, cls_ord, k, n_probes, metric, select_algo,
-              compute_dtype, classes, class_counts, q_tile, interpret, impl):
+              compute_dtype, classes, class_counts, q_tile, interpret, impl,
+              bits: int = 1, rotation_kind: str = "dense"):
     """The ENTIRE BQ search — coarse gemm, device strip planning, packed
     scan, merge, finalize — as one jit: one runtime dispatch, zero host
     syncs (the round-4 _ragged_fused shape). The in-kernel tournament
@@ -429,7 +755,8 @@ def _bq_fused(queries, centers, rotation, list_codes, list_scale, list_bias,
         static={"k": k, "n_probes": n_probes, "metric": metric,
                 "select_algo": select_algo, "compute_dtype": compute_dtype,
                 "classes": classes, "class_counts": class_counts,
-                "q_tile": q_tile, "interpret": interpret, "impl": impl})
+                "q_tile": q_tile, "interpret": interpret, "impl": impl,
+                "bits": bits, "rotation_kind": rotation_kind})
     l2 = metric in ("sqeuclidean", "euclidean")
     # packed coarse select only while its perturbation bound stays tight
     # (2^-(23-ceil(log2 n_lists)) ≤ 5e-4 at 4096 lists — see
@@ -438,7 +765,7 @@ def _bq_fused(queries, centers, rotation, list_codes, list_scale, list_bias,
           and centers.shape[0] <= 4096 else select_algo)
     probes, qr, bias, pair_const = _bq_search_prep(
         queries, centers, rotation, list_bias, list_ids, filter,
-        n_probes, metric, sa, compute_dtype, l2,
+        n_probes, metric, sa, compute_dtype, l2, bits, rotation_kind,
     )
     vals, ids = bq_strip_search_traced(
         qr, probes, list_codes, list_scale, bias, list_ids, cls_ord,
@@ -519,21 +846,24 @@ def search(
                 lambda: occupancy_stats(
                     lens_cached, index.max_list_size, q_obs, n_probes,
                     rot_dim=index.rot_dim,
-                    workspace_bytes=res.workspace_bytes, kf=kf_occ))
+                    workspace_bytes=res.workspace_bytes, kf=kf_occ,
+                    bits=index.bits))
         obs_roofline.note_dispatch(
             "ivf_bq.search",
             {"q": q_obs, "dim": index.dim, "n_lists": index.n_lists,
              "max_list_size": index.max_list_size,
              "n_probes": int(n_probes), "k": int(k),
-             "rot_dim": index.rot_dim},
+             "rot_dim": index.rot_dim, "bits": index.bits,
+             "rotation_kind": index.rotation_kind},
             occupancy=occ)
     from raft_tpu import resilience
     from raft_tpu.neighbors.ivf_flat import _ragged_plan_static
 
     # plan with the scan's REAL row width (the bf16 unpacked block the
-    # kernel holds in VMEM is rot_dim wide)
+    # kernel holds in VMEM is bits·rot_dim wide — every extra bit-plane
+    # widens the MXU contraction)
     classes, class_counts, cls_ord, q_tile = _ragged_plan_static(
-        index, n_probes, k, res, index.rot_dim)
+        index, n_probes, k, res, index.rot_dim * index.bits)
     q_tile = min(q_tile, queries.shape[0])
     interpret = jax.default_backend() != "tpu"
     while True:
@@ -548,7 +878,8 @@ def search(
                     index.list_scale, index.list_bias, index.list_ids,
                     filter, cls_ord, int(k), n_probes, index.metric,
                     select_algo, res.compute_dtype, classes, class_counts,
-                    q_tile, interpret, impl,
+                    q_tile, interpret, impl, index.bits,
+                    index.rotation_kind,
                 )
         except Exception as e:
             kind = resilience.classify(e)
@@ -573,12 +904,14 @@ def search(
 @functools.partial(
     jax.jit,
     static_argnames=("k", "n_probes", "metric", "select_algo",
-                     "compute_dtype", "q_tile", "interpret", "impl"),
+                     "compute_dtype", "q_tile", "interpret", "impl",
+                     "bits", "rotation_kind"),
 )
 def _paged_fused_bq(queries, centers, rotation, codes_pool, scale_pool,
                     bias_pool, page_ids, table, chain_pages, filter,
                     k, n_probes, metric, select_algo, compute_dtype,
-                    q_tile, interpret, impl):
+                    q_tile, interpret, impl, bits: int = 1,
+                    rotation_kind: str = "dense"):
     """The ENTIRE paged BQ search as one jit: coarse gemm + rotation,
     device strip planning, the page-table DMA ±1 kernel, merge, finalize —
     the ``_bq_fused`` shape over page chains. Capacity-shaped operands
@@ -593,7 +926,8 @@ def _paged_fused_bq(queries, centers, rotation, codes_pool, scale_pool,
         chain_pages=chain_pages, filter=filter,
         static={"k": k, "n_probes": n_probes, "metric": metric,
                 "select_algo": select_algo, "compute_dtype": compute_dtype,
-                "q_tile": q_tile, "interpret": interpret, "impl": impl})
+                "q_tile": q_tile, "interpret": interpret, "impl": impl,
+                "bits": bits, "rotation_kind": rotation_kind})
     l2 = metric in ("sqeuclidean", "euclidean")
     sa = ("packed" if select_algo == "exact" and not interpret
           and centers.shape[0] <= 4096 else select_algo)
@@ -602,7 +936,7 @@ def _paged_fused_bq(queries, centers, rotation, codes_pool, scale_pool,
     # the store's pools instead of the packed arrays
     probes, qr, bias, pair_const = _bq_search_prep(
         queries, centers, rotation, bias_pool, page_ids, filter,
-        n_probes, metric, sa, compute_dtype, l2,
+        n_probes, metric, sa, compute_dtype, l2, bits, rotation_kind,
     )
     alpha = -2.0 if l2 else -1.0
     vals, ids = paged_bq_search_traced(
@@ -658,6 +992,8 @@ def search_paged(
         queries = queries / jnp.maximum(
             jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-30)
     rot_dim = int(store.rotation.shape[0])
+    bits = int(getattr(store, "bq_bits", 1))
+    rotation_kind = getattr(store, "rotation_kind", "dense")
     scan_attrs = None
     if obs.enabled():
         q_obs = int(queries.shape[0])
@@ -676,17 +1012,19 @@ def search_paged(
                 width, store.page_rows, store._list_pages, store.size,
                 store.tombstones, q_obs, int(n_probes), int(k),
                 int(codes_pool.shape[-1]),
-                workspace_bytes=res.workspace_bytes, dim=rot_dim))
+                workspace_bytes=res.workspace_bytes, dim=rot_dim * bits))
         obs_roofline.note_dispatch(
             "ivf_bq.paged_pallas",
             {"q": q_obs, "dim": store.dim, "n_lists": store.n_lists,
              "page_rows": store.page_rows, "table_width": width,
-             "n_probes": int(n_probes), "k": int(k), "rot_dim": rot_dim},
+             "n_probes": int(n_probes), "k": int(k), "rot_dim": rot_dim,
+             "bits": bits, "rotation_kind": rotation_kind},
             occupancy=occ)
     from raft_tpu.resilience import faultpoint
 
     interpret = jax.default_backend() != "tpu"
-    q_tile = min(_paged_plan_static(store, n_probes, k, res, rot_dim),
+    q_tile = min(_paged_plan_static(store, n_probes, k, res,
+                                    rot_dim * bits),
                  queries.shape[0])
     impl = "pallas" if backend == "paged_pallas" else "jnp"
     faultpoint("ivf_bq.search_paged.scan")
@@ -696,7 +1034,8 @@ def search_paged(
                 queries, store.centers, store.rotation, codes_pool,
                 scale_pool, bias_pool, page_ids, table, chain_pages,
                 filter, int(k), n_probes, store.metric, select_algo,
-                res.compute_dtype, int(q_tile), interpret, impl)
+                res.compute_dtype, int(q_tile), interpret, impl, bits,
+                rotation_kind)
 
 
 @traced("ivf_bq::search_refined")

@@ -54,6 +54,7 @@ from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
 from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops import linalg
 from raft_tpu.ops.pq_scan import group_probed_pairs, pq_scan
 from raft_tpu.ops.select_k import select_k
 from raft_tpu.utils.tiling import map_row_tiles
@@ -276,12 +277,10 @@ def unpack_codes(packed, pq_dim: int, pq_bits: int):
     return ((lo | hi) & mask).astype(jnp.uint8)
 
 
-def make_rotation_matrix(key, rot_dim: int) -> jax.Array:
-    """Random orthogonal (rot_dim, rot_dim) via QR of a gaussian
-    (make_rotation_matrix analog, detail/ivf_pq_build.cuh:119)."""
-    g = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
-    q, r = jnp.linalg.qr(g)
-    return q * jnp.sign(jnp.diagonal(r))[None, :]
+# promoted to ops/linalg (round 17, with the SRHT rotation family); these
+# re-export shims keep the long-standing public names importable from here
+make_rotation_matrix = linalg.make_rotation_matrix
+pad_rot = linalg.pad_rot
 
 
 @functools.partial(jax.jit, static_argnames=("n_codes", "n_iters"))
@@ -443,9 +442,9 @@ def _pack_lists(codes, row_ids, labels, n_lists: int, group: int = 0):
                                pow2_chunks=group == 512)
 
 
-def _pad_rot(x, rot_dim):
-    pad = rot_dim - x.shape[1]
-    return jnp.pad(x, ((0, 0), (0, pad))) if pad else x
+# legacy private alias (pre-promotion call sites across the repo and old
+# user code imported `_pad_rot` from here)
+_pad_rot = linalg.pad_rot
 
 
 @traced("ivf_pq::build")
@@ -563,22 +562,9 @@ def build(
     )
 
 
-def _chunk_ranks(labels, n_lists: int):
-    """Chunk-local arrival rank of each row within its label, in
-    label-sorted order: returns ``(order, sorted_labels, rank_sorted)``.
-    The ONE definition shared by the streamed-build scatter position math
-    and the capacity diversion's fill check — they must agree exactly or
-    rows overwrite/drop (code-review r5). Sentinel labels (== n_lists)
-    sort last and rank within the sentinel bucket."""
-    m = labels.shape[0]
-    order = jnp.argsort(labels)
-    sorted_labels = labels[order]
-    counts = jnp.bincount(labels, length=n_lists + 1)[:n_lists]
-    offsets = jnp.cumsum(counts) - counts
-    safe_sl = jnp.minimum(sorted_labels, n_lists - 1)
-    rank_sorted = (jnp.arange(m, dtype=jnp.int32)
-                   - offsets[safe_sl].astype(jnp.int32))
-    return order, sorted_labels, rank_sorted
+# promoted to _packing (round 17: the ivf_bq streamed build shares them);
+# the private aliases keep this module's long-standing names working
+_chunk_ranks = _packing.chunk_ranks
 
 
 @functools.partial(
@@ -618,54 +604,7 @@ def _scatter_chunk(list_codes, list_ids, chunk, labels, base, row_start,
     return list_codes, list_ids
 
 
-@functools.partial(jax.jit, static_argnames=("block", "metric"))
-def _assign_top2(rows, centers, block: int = 4096,
-                 metric: str = "sqeuclidean"):
-    """Best and second-best center per row, tiled over center blocks
-    (fused_l2_nn_argmin gives only the argmin; the streamed build's
-    capacity diversion needs the runner-up as the spill target — the
-    one-pass analog of _packing.spill_to_cap's first alternative round).
-    ``metric`` matches kmeans_balanced._assign: "sqeuclidean" ranks by
-    expanded L2, "inner_product" by −⟨row, center⟩."""
-    m, dim = rows.shape
-    n_c = centers.shape[0]
-    nb = -(-n_c // block)
-    cpad = jnp.pad(centers, ((0, nb * block - n_c), (0, 0)))
-    cn = jnp.sum(cpad * cpad, axis=1)
-    cn = jnp.where(jnp.arange(nb * block) < n_c, cn, jnp.inf)
-
-    def step(carry, bi):
-        v1, i1, v2, i2 = carry
-        cb = lax.dynamic_slice_in_dim(cpad, bi * block, block, axis=0)
-        bn = lax.dynamic_slice_in_dim(cn, bi * block, block, axis=0)
-        ip = jnp.einsum("md,cd->mc", rows, cb,
-                        preferred_element_type=jnp.float32)
-        d = -ip if metric == "inner_product" else bn[None, :] - 2.0 * ip
-        d = jnp.where(jnp.isinf(bn)[None, :], jnp.inf, d)
-        bv1 = jnp.min(d, axis=1)
-        ba1 = jnp.argmin(d, axis=1).astype(jnp.int32) + bi * block
-        d2 = jnp.where(jnp.arange(block)[None, :]
-                       == (ba1 - bi * block)[:, None], jnp.inf, d)
-        bv2 = jnp.min(d2, axis=1)
-        ba2 = jnp.argmin(d2, axis=1).astype(jnp.int32) + bi * block
-        # merge two sorted pairs -> global best two
-        cand_v = jnp.stack([v1, v2, bv1, bv2], axis=1)
-        cand_i = jnp.stack([i1, i2, ba1, ba2], axis=1)
-        nv1 = jnp.min(cand_v, axis=1)
-        na1 = jnp.argmin(cand_v, axis=1)
-        ni1 = jnp.take_along_axis(cand_i, na1[:, None], axis=1)[:, 0]
-        cv2 = jnp.where(jnp.arange(4)[None, :] == na1[:, None],
-                        jnp.inf, cand_v)
-        na2 = jnp.argmin(cv2, axis=1)
-        nv2 = jnp.take_along_axis(cv2, na2[:, None], axis=1)[:, 0]
-        ni2 = jnp.take_along_axis(cand_i, na2[:, None], axis=1)[:, 0]
-        return (nv1, ni1, nv2, ni2), None
-
-    init = (jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32),
-            jnp.full((m,), jnp.inf), jnp.zeros((m,), jnp.int32))
-    (v1, i1, v2, i2), _ = lax.scan(step, init,
-                                   jnp.arange(nb, dtype=jnp.int32))
-    return i1, i2
+_assign_top2 = _packing.assign_top2
 
 
 @functools.partial(
@@ -918,23 +857,7 @@ def build_streaming(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("n_lists",))
-def _divert_to_cap(l1, l2, run_counts, cap, n_lists):
-    """Capacity diversion for one streamed chunk: rows whose nearest list
-    is full (given the running fill) take their second-nearest; rows whose
-    second choice is also full get the drop sentinel ``n_lists``. Ranks are
-    chunk-local arrival order, matching the scatter's position math."""
-    m = l1.shape[0]
-
-    def rank_of(lab):
-        order, _, rank_sorted = _chunk_ranks(lab, n_lists)
-        return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
-
-    full1 = run_counts[l1] + rank_of(l1) >= cap
-    lab = jnp.where(full1, l2, l1)
-    # re-rank under the diverted labels; overflow past cap drops
-    full2 = run_counts[jnp.minimum(lab, n_lists - 1)] + rank_of(lab) >= cap
-    return jnp.where(full2, n_lists, lab).astype(jnp.int32)
+_divert_to_cap = _packing.divert_to_cap
 
 
 @functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits", "cluster"))
@@ -1211,11 +1134,14 @@ def _search_ragged_pq(index, queries, k, n_probes, filter, select_algo, res):
     )
 
 
-def _pq_probe_prep(queries, centers, rotation, n_probes, select_algo, l2):
+def _pq_probe_prep(queries, centers, rotation, n_probes, select_algo, l2,
+                   rotation_kind: str = "dense"):
     """Probe selection + query rotation + the exact per-pair center term —
     THE one copy of the op sequence both the packed strip path and the
     paged Pallas path consume (bitwise parity between them is the paged
-    plane's acceptance contract, so this math must not fork)."""
+    plane's acceptance contract, so this math must not fork).
+    ``rotation_kind`` selects the apply (ops/linalg.rotate_rows): the
+    dense gemm, or the SRHT butterfly ivf_bq's Hadamard indexes carry."""
     ip_c = dist_mod.matmul_t(queries, centers, None, "highest")
     if l2:
         # expanded L2 from the single gemm (review: _expanded_distance would
@@ -1225,8 +1151,7 @@ def _pq_probe_prep(queries, centers, rotation, n_probes, select_algo, l2):
     else:
         coarse = -ip_c
     _, probes = select_k(coarse, n_probes, select_min=True, algo=select_algo)
-    rot_dim = rotation.shape[0]
-    qr = _pad_rot(queries, rot_dim) @ rotation.T
+    qr = linalg.rotate_rows(queries, rotation, rotation_kind)
     alpha = -2.0 if l2 else -1.0
     pair_const = alpha * jnp.take_along_axis(ip_c, probes, axis=1)
     return probes, qr, pair_const

@@ -147,14 +147,35 @@ def _predict_ivf_pq(*, n_lists: int, dim: int, max_list_size: int,
     return total
 
 
+def _rotation_bytes(rot_dim: int, rotation_kind: str) -> int:
+    """Resident bytes of the rotation operand: the dense (rot_dim, rot_dim)
+    fp32 matrix, or the SRHT (rot_dim,) fp32 sign diagonal — the 1/d
+    storage side of the Hadamard rotation's O(d·log d) apply."""
+    if rotation_kind == "hadamard":
+        return rot_dim * 4
+    return rot_dim * rot_dim * 4
+
+
+def _auto_rot_dim_bq(dim: int, rotation_kind: str) -> int:
+    """ivf_bq.auto_rot_dim mirrored (kind-aware): whole code bytes for
+    dense, the next power of two for the Walsh–Hadamard butterfly — the
+    kinds disagree (dim=100 → 104 vs 128), so a kind-blind default would
+    under-predict every hadamard byte count."""
+    if rotation_kind == "hadamard":
+        d = max(int(dim), 1)
+        return max(8, 1 << (d - 1).bit_length())
+    return -(-int(dim) // 8) * 8
+
+
 def _predict_ivf_bq(*, n_lists: int, dim: int, max_list_size: int,
-                    rot_dim: Optional[int] = None,
+                    rot_dim: Optional[int] = None, bits: int = 1,
+                    rotation_kind: str = "dense",
                     plan_cache: bool = False) -> int:
     if rot_dim is None:
-        rot_dim = -(-dim // 8) * 8
+        rot_dim = _auto_rot_dim_bq(dim, rotation_kind)
     total = n_lists * dim * 4                                # centers
-    total += rot_dim * rot_dim * 4                           # rotation
-    total += n_lists * max_list_size * (rot_dim // 8)        # list_codes
+    total += _rotation_bytes(rot_dim, rotation_kind)         # rotation
+    total += n_lists * max_list_size * (bits * rot_dim // 8)  # list_codes
     total += n_lists * max_list_size * 4                     # list_ids
     total += n_lists * max_list_size * 4                     # list_scale
     total += n_lists * max_list_size * 4                     # list_bias
@@ -181,7 +202,11 @@ def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
                          payload_dtype="float32", store_kind: str = "ivf_flat",
                          pq_dim: int = 0, pq_bits: int = 8,
                          rot_dim: Optional[int] = None,
+                         rotation_kind: str = "dense", bits: int = 1,
                          paged_plan_cache: bool = False) -> int:
+    # ``bits`` (BQ multi-bit stores) rides in the payload_width the caller
+    # measured off the pool — accepted here so index_layout() round-trips
+    del bits
     total = n_lists * dim * 4                                         # centers
     total += capacity_pages * page_rows * payload_width * _isize(payload_dtype)
     total += capacity_pages * page_rows * 4                           # page_ids
@@ -208,8 +233,8 @@ def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
         total += 4                                  # decoded_scale (0-d fp32)
     elif store_kind == "ivf_bq":
         if rot_dim is None:
-            rot_dim = -(-dim // 8) * 8
-        total += rot_dim * rot_dim * 4                                # rotation
+            rot_dim = _auto_rot_dim_bq(dim, rotation_kind)
+        total += _rotation_bytes(rot_dim, rotation_kind)              # rotation
         total += capacity_pages * page_rows * 4             # page_scale
     return total
 
@@ -238,6 +263,50 @@ def predict_index_bytes(kind: str, **layout) -> int:
             raise ValueError(
                 f"unknown index family {kind!r} (have {sorted(_FAMILIES)})")
         return int(fn(**layout))
+
+
+def predict_build_streaming_bytes(*, n: int, dim: int, n_lists: int,
+                                  max_list_size: int, chunk_rows: int,
+                                  train_rows: int = 0,
+                                  rot_dim: Optional[int] = None,
+                                  bits: int = 1,
+                                  rotation_kind: str = "dense") -> dict:
+    """Predicted PEAK resident bytes of one ``ivf_bq.build_streaming`` run
+    — the bound the streamed build exists to enforce: the donated index
+    blocks plus ONE chunk's encode transient (never the raw (n, dim)
+    matrix). Closed-form, computable before the build runs (the
+    billion-scale admission input: at the SIFT-1B 15.6M-row per-chip
+    share this is the number that must fit next to the serving residents).
+
+    Returns ``{"index_bytes", "chunk_transient_bytes", "labels_bytes",
+    "train_bytes", "peak_bytes"}`` where ``peak_bytes = index + pass-1
+    labels + max(chunk transient, training residents)`` — the two phases'
+    peaks never coexist (the trainset is freed before pass 2).
+    ``train_rows=0`` resolves to the build's own default sample
+    (min(2M, max(n_lists·32, n·0.5)) — the default trainset fraction;
+    pass ``train_rows`` explicitly for other configurations. Modeling
+    the sentinel as zero residency would under-predict by the whole
+    trainset), and ``train_bytes`` counts 2× the sample: the per-chunk
+    parts and their concatenation coexist transiently
+    (jnp.concatenate in build_streaming's training phase)."""
+    if rot_dim is None:
+        rot_dim = _auto_rot_dim_bq(dim, rotation_kind)
+    idx = _predict_ivf_bq(n_lists=n_lists, dim=dim,
+                          max_list_size=max_list_size, rot_dim=rot_dim,
+                          bits=bits, rotation_kind=rotation_kind)
+    # one chunk in flight: the fp32 rows, the rotated residual u and its
+    # fp32 level view (the g/proj einsum operand), the packed codes, and
+    # the per-row labels/scale/bias scalars
+    chunk_t = int(chunk_rows) * (dim * 4 + 2 * rot_dim * 4
+                                 + (bits * rot_dim) // 8 + 16)
+    labels = int(n) * 4                   # pass-1 labels, kept whole-run
+    t_rows = int(train_rows) or int(min(2_000_000,
+                                        max(n_lists * 32, n * 0.5)))
+    t_rows = min(t_rows, int(n))
+    train = 2 * t_rows * dim * 4          # parts + concat coexist
+    return {"index_bytes": int(idx), "chunk_transient_bytes": int(chunk_t),
+            "labels_bytes": int(labels), "train_bytes": int(train),
+            "peak_bytes": int(idx + labels + max(chunk_t, train))}
 
 
 def index_layout(index) -> dict:
@@ -272,7 +341,8 @@ def index_layout(index) -> dict:
     if isinstance(index, bq_mod.IvfBqIndex):
         return {"kind": "ivf_bq", "n_lists": index.n_lists,
                 "dim": index.dim, "max_list_size": index.max_list_size,
-                "rot_dim": index.rot_dim, "plan_cache": plan}
+                "rot_dim": index.rot_dim, "bits": index.bits,
+                "rotation_kind": index.rotation_kind, "plan_cache": plan}
     if isinstance(index, cagra_mod.CagraIndex):
         return {"kind": "cagra", "n": index.size, "dim": index.dim,
                 "graph_degree": index.graph_degree,
@@ -296,6 +366,8 @@ def index_layout(index) -> dict:
                 "pq_dim": index.pq_dim, "pq_bits": index.pq_bits,
                 "rot_dim": (None if index.rotation is None
                             else int(index.rotation.shape[0])),
+                "rotation_kind": getattr(index, "rotation_kind", "dense"),
+                "bits": int(getattr(index, "bq_bits", 1)),
                 # the paged Pallas path's lazily-built device mirror
                 "paged_plan_cache": getattr(index, "_dev_lens", None)
                 is not None}
@@ -382,37 +454,41 @@ def _est_ivf_pq_paged(*, q, dim, n_lists, capacity_pages, page_rows,
 
 
 def _est_ivf_bq_search(*, q, dim, n_lists, max_list_size, n_probes, k,
-                       rot_dim=None, workspace_bytes=None):
+                       rot_dim=None, bits=1, rotation_kind="dense",
+                       workspace_bytes=None):
     ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
     if rot_dim is None:
-        rot_dim = -(-dim // 8) * 8
+        rot_dim = _auto_rot_dim_bq(dim, rotation_kind)
     operands = q * dim * 4 + _predict_ivf_bq(
         n_lists=n_lists, dim=dim, max_list_size=max_list_size,
-        rot_dim=rot_dim)
-    # rotated queries + coarse gemm + the unpacked ±1 strip block the scan
-    # holds per tile (bf16 rows, rot_dim wide) + score/merge rows
-    per_query = max(1, n_probes * max_list_size * (rot_dim * 2 + 8))
+        rot_dim=rot_dim, bits=bits, rotation_kind=rotation_kind)
+    # rotated (plane-extended) queries + coarse gemm + the unpacked ±1
+    # strip block the scan holds per tile (bf16 rows, bits·rot_dim wide)
+    # + score/merge rows
+    width = rot_dim * bits
+    per_query = max(1, n_probes * max_list_size * (width * 2 + 8))
     qt = _ws_tile(q, per_query, ws)
-    workspace = qt * per_query + q * rot_dim * 4 + q * n_lists * 8
+    workspace = qt * per_query + q * width * 4 + q * n_lists * 8
     outputs = q * k * 8
     return operands, outputs, workspace
 
 
 def _est_ivf_bq_paged(*, q, dim, n_lists, capacity_pages, page_rows,
-                      table_width, n_probes, k, rot_dim=None,
-                      workspace_bytes=None):
+                      table_width, n_probes, k, rot_dim=None, bits=1,
+                      rotation_kind="dense", workspace_bytes=None):
     ws = workspace_bytes if workspace_bytes is not None else _workspace_bytes()
     if rot_dim is None:
-        rot_dim = -(-dim // 8) * 8
+        rot_dim = _auto_rot_dim_bq(dim, rotation_kind)
     operands = q * dim * 4 + _predict_paged_store(
         n_lists=n_lists, dim=dim, capacity_pages=capacity_pages,
         page_rows=page_rows, table_width=table_width,
-        payload_width=rot_dim // 8, payload_dtype="uint8",
-        store_kind="ivf_bq", rot_dim=rot_dim)
+        payload_width=bits * rot_dim // 8, payload_dtype="uint8",
+        store_kind="ivf_bq", rot_dim=rot_dim, rotation_kind=rotation_kind)
     # the unpacked ±1 strip block per probed chain row + score/merge rows
-    per_query = max(1, n_probes * table_width * page_rows * (rot_dim * 2 + 8))
+    width = rot_dim * bits
+    per_query = max(1, n_probes * table_width * page_rows * (width * 2 + 8))
     qt = _ws_tile(q, per_query, ws)
-    workspace = qt * per_query + q * rot_dim * 4 + q * n_lists * 8
+    workspace = qt * per_query + q * width * 4 + q * n_lists * 8
     outputs = q * k * 8
     return operands, outputs, workspace
 
@@ -500,7 +576,10 @@ def estimate_search(index, q: int, k: int, n_probes: int = 0,
         return estimate("ivf_bq.search", q=q, k=k, n_probes=n_probes,
                         dim=layout["dim"], n_lists=layout["n_lists"],
                         max_list_size=layout["max_list_size"],
-                        rot_dim=layout["rot_dim"], **ws)
+                        rot_dim=layout["rot_dim"],
+                        bits=layout.get("bits", 1),
+                        rotation_kind=layout.get("rotation_kind", "dense"),
+                        **ws)
     if kind == "brute_force":
         return estimate("brute_force.search", q=q, k=k, n=layout["n"],
                         dim=layout["dim"], dtype=layout["dtype"], **ws)
@@ -518,7 +597,9 @@ def estimate_search(index, q: int, k: int, n_probes: int = 0,
             kw.update(pq_dim=layout["pq_dim"], pq_bits=layout["pq_bits"],
                       rot_dim=layout["rot_dim"])
         elif entry == "ivf_bq.paged_scan":
-            kw.update(rot_dim=layout["rot_dim"])
+            kw.update(rot_dim=layout["rot_dim"],
+                      bits=layout.get("bits", 1),
+                      rotation_kind=layout.get("rotation_kind", "dense"))
         return estimate(entry, **kw)
     raise ValueError(f"no dispatch estimator for index family {kind!r}")
 

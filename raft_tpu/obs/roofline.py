@@ -195,8 +195,13 @@ def _rot_dim_pq(dim: int, pq_dim: int, rot_dim) -> int:
     return int(rot_dim) if rot_dim else pq_dim * _ceil_div(dim, pq_dim)
 
 
-def _rot_dim_bq(dim: int, rot_dim) -> int:
-    return int(rot_dim) if rot_dim else _ceil_div(dim, 8) * 8
+def _rot_dim_bq(dim: int, rot_dim, rotation_kind: str = "dense") -> int:
+    if rot_dim:
+        return int(rot_dim)
+    if rotation_kind == "hadamard":
+        # the Walsh–Hadamard width: next power of two, not byte-rounding
+        return max(8, 1 << (max(int(dim), 1) - 1).bit_length())
+    return _ceil_div(dim, 8) * 8
 
 
 def _fb_brute_force_search(*, q, n, dim, k, dtype="float32"):
@@ -237,20 +242,104 @@ def _fb_ivf_pq_search(*, q, dim, n_lists, max_list_size, pq_dim, n_probes,
     return coarse + rotate + scan, br, q * k * 8
 
 
+def _log2i(n: int) -> int:
+    return max(int(n), 1).bit_length() - 1
+
+
+def _rotate_cost(q: int, dim: int, rd: int, rotation_kind: str):
+    """(flops, rotation-operand bytes) of rotating ``q`` rows up to width
+    ``rd``: the dense gemm (2 per MAC, (rd, rd) fp32 operand) or the SRHT
+    butterfly — the sign multiply, log2(rd) full-width add/sub stages and
+    the 1/√d scale, with only the (rd,) sign diagonal as its operand."""
+    if rotation_kind == "hadamard":
+        return q * rd * (_log2i(rd) + 2), rd * 4
+    return 2 * q * dim * rd, rd * rd * 4
+
+
 def _fb_ivf_bq_search(*, q, dim, n_lists, max_list_size, n_probes, k,
-                      rot_dim=None):
-    """The packed ±1 strip scan: coarse gemm + rotation + one rot_dim-wide
-    contraction per probed entry, plus the per-entry scale multiply AND
-    bias add. Strip traffic reads 1 BIT/dim codes + two fp32 scalars."""
-    rd = _rot_dim_bq(dim, rot_dim)
+                      rot_dim=None, bits=1, rotation_kind="dense"):
+    """The packed multi-bit strip scan: coarse gemm + rotation (dense gemm
+    or SRHT butterfly) + one bits·rot_dim-wide contraction per probed
+    entry (every extra bit-plane widens the MXU contraction), plus the
+    per-entry scale multiply AND bias add. Strip traffic reads
+    bits·rot_dim/8 code bytes + two fp32 scalars per entry."""
+    rd = _rot_dim_bq(dim, rot_dim, rotation_kind)
     coarse = 2 * q * n_lists * dim
-    rotate = 2 * q * dim * rd
-    scan = 2 * q * n_probes * max_list_size * rd \
+    rotate, rot_bytes = _rotate_cost(q, dim, rd, rotation_kind)
+    scan = 2 * q * n_probes * max_list_size * rd * bits \
         + 2 * q * n_probes * max_list_size
     strips = _ceil_div(q * n_probes, STRIP_C)
-    br = q * dim * 4 + n_lists * dim * 4 + rd * rd * 4 \
-        + strips * max_list_size * (rd // 8 + 4 + 4 + 4)
+    br = q * dim * 4 + n_lists * dim * 4 + rot_bytes \
+        + strips * max_list_size * (bits * rd // 8 + 4 + 4 + 4)
     return coarse + rotate + scan, br, q * k * 8
+
+
+def _fb_ivf_flat_build(*, n, dim, n_lists, kmeans_iters=20, train_rows=0,
+                       dtype="float32"):
+    """One packed IVF-Flat build, kmeans-dominated: per CONFIGURED EM
+    iteration one assign gemm + one M-step one-hot matmul over the
+    trainset (4·tr·K·d — the balancing loop may extend past the
+    configured budget, so this is the floor the build can't beat), the
+    full-data predict, and the row-norm reduction. Bytes: the trainset
+    re-streamed per iteration, the dataset twice (predict + pack read),
+    the packed block written."""
+    tr = train_rows or n
+    flops = kmeans_iters * 4 * tr * n_lists * dim \
+        + 2 * n * n_lists * dim + 2 * n * dim
+    br = (kmeans_iters + 1) * tr * dim * 4 + 2 * n * dim * 4
+    bw = n * (dim * _isize(dtype) + 4 + 4)
+    return flops, br, bw
+
+
+def _fb_ivf_pq_build(*, n, dim, n_lists, pq_dim, kmeans_iters=20,
+                     codebook_iters=25, train_rows=0, cb_rows=0,
+                     pq_bits=8, rot_dim=None):
+    """One packed IVF-PQ build: the flat build's kmeans legs + per-subspace
+    codebook Lloyd (4·cbr·n_codes·rot_dim per configured iteration) + the
+    dense rotation of every row + the encode's code-scoring einsum
+    (2·n·n_codes·rot_dim). Writes packed codes + ids + b_sum."""
+    tr = train_rows or n
+    rd = _rot_dim_pq(dim, pq_dim, rot_dim)
+    n_codes = 1 << pq_bits
+    cbr = cb_rows or min(tr, 65536)
+    flops = kmeans_iters * 4 * tr * n_lists * dim \
+        + 2 * n * n_lists * dim \
+        + codebook_iters * 4 * cbr * n_codes * rd \
+        + 2 * n * dim * rd + 2 * n * n_codes * rd
+    br = (kmeans_iters + 1) * tr * dim * 4 + 2 * n * dim * 4 + rd * rd * 4
+    bw = n * ((pq_dim * pq_bits + 7) // 8 + 4 + 4)
+    return flops, br, bw
+
+
+def _fb_ivf_bq_build(*, n, dim, n_lists, kmeans_iters=20, train_rows=0,
+                     rot_dim=None, bits=1, rotation_kind="dense"):
+    """One IVF-BQ build (packed or streamed — the op sequence is the
+    same): the flat build's kmeans legs + the rotation of every row
+    (dense gemm or SRHT butterfly — THE build-cost headline this round:
+    O(d²) → O(d·log d) per row) + the level quantize and the
+    norm/projection/bias reductions (rd·(2·bits + 4) per row, counting
+    the quantize compare/scale ops per plane and the three einsum-grade
+    reductions). Writes packed codes + ids + the two fp32 scalars. BQ has
+    NO codebook leg — that is the IVF-RaBitQ build-time headline."""
+    tr = train_rows or n
+    rd = _rot_dim_bq(dim, rot_dim, rotation_kind)
+    rot_f, rot_bytes = _rotate_cost(n, dim, rd, rotation_kind)
+    flops = kmeans_iters * 4 * tr * n_lists * dim \
+        + 2 * n * n_lists * dim + rot_f + n * rd * (2 * bits + 4)
+    br = (kmeans_iters + 1) * tr * dim * 4 + 2 * n * dim * 4 + rot_bytes
+    bw = n * (bits * rd // 8 + 8 + 4)
+    return flops, br, bw
+
+
+def _fb_srht_apply(*, n, rot_dim):
+    """One SRHT rotation apply (ops/linalg.srht_rotate): the sign
+    multiply, log2(rot_dim) butterfly add/sub stages and the 1/√d scale —
+    n·rot_dim·(log2(rot_dim) + 2) VPU flops against n·rot_dim fp32 rows
+    in/out and the (rot_dim,) sign diagonal. The O(d·log d)-vs-O(d²)
+    build-cost claim as a number."""
+    flops = n * rot_dim * (_log2i(rot_dim) + 2)
+    br = n * rot_dim * 4 + rot_dim * 4
+    return flops, br, n * rot_dim * 4
 
 
 def _fb_ivf_flat_paged(*, q, dim, n_lists, page_rows, table_width,
@@ -323,19 +412,20 @@ def _fb_ivf_pq_paged_pallas(*, q, dim, n_lists, page_rows, table_width,
 
 
 def _fb_ivf_bq_paged_pallas(*, q, dim, n_lists, page_rows, table_width,
-                            n_probes, k, rot_dim=None):
-    """The paged ±1 Pallas scan: coarse gemm + rotation + one rot_dim-wide
-    contraction per capacity-chain row, plus the per-row scale multiply
-    AND bias add. Streams 1 BIT/dim codes + two fp32 scalars per row,
-    strip-shared."""
-    rd = _rot_dim_bq(dim, rot_dim)
+                            n_probes, k, rot_dim=None, bits=1,
+                            rotation_kind="dense"):
+    """The paged multi-bit Pallas scan: coarse gemm + rotation + one
+    bits·rot_dim-wide contraction per capacity-chain row, plus the per-row
+    scale multiply AND bias add. Streams bits·rot_dim/8 code bytes + two
+    fp32 scalars per row, strip-shared."""
+    rd = _rot_dim_bq(dim, rot_dim, rotation_kind)
     ent = table_width * page_rows
     coarse = 2 * q * n_lists * dim
-    rotate = 2 * q * dim * rd
-    scan = 2 * q * n_probes * ent * rd + 2 * q * n_probes * ent
+    rotate, rot_bytes = _rotate_cost(q, dim, rd, rotation_kind)
+    scan = 2 * q * n_probes * ent * rd * bits + 2 * q * n_probes * ent
     strips = _ceil_div(q * n_probes, STRIP_C)
-    br = q * dim * 4 + n_lists * dim * 4 + rd * rd * 4 \
-        + strips * ent * (rd // 8 + 4 + 4)
+    br = q * dim * 4 + n_lists * dim * 4 + rot_bytes \
+        + strips * ent * (bits * rd // 8 + 4 + 4)
     return coarse + rotate + scan, br, q * k * 8
 
 
@@ -381,6 +471,10 @@ _MODELS = {
     "ivf_bq.paged_pallas": _fb_ivf_bq_paged_pallas,
     "cagra.fused_hop": _fb_cagra_fused_hop,
     "serving.scatter": _fb_serving_scatter,
+    "linalg.srht_apply": _fb_srht_apply,
+    "ivf_flat.build": _fb_ivf_flat_build,
+    "ivf_pq.build": _fb_ivf_pq_build,
+    "ivf_bq.build": _fb_ivf_bq_build,
 }
 
 #: dispatch entry → the span whose sync-mode committed durations measure
@@ -467,7 +561,8 @@ def _search_kwargs(index, q: int, k: int, n_probes: int) -> tuple:
             q=q, k=k, n_probes=n_probes, dim=layout["dim"],
             n_lists=layout["n_lists"],
             max_list_size=layout["max_list_size"],
-            rot_dim=layout["rot_dim"])
+            rot_dim=layout["rot_dim"], bits=layout.get("bits", 1),
+            rotation_kind=layout.get("rotation_kind", "dense"))
     if kind == "brute_force":
         return "brute_force.search", dict(
             q=q, k=k, n=layout["n"], dim=layout["dim"],
@@ -487,7 +582,9 @@ def _search_kwargs(index, q: int, k: int, n_probes: int) -> tuple:
                     table_width=layout["table_width"])
         if sk == "ivf_bq":
             return "ivf_bq.paged_pallas", dict(
-                base, rot_dim=layout["rot_dim"])
+                base, rot_dim=layout["rot_dim"],
+                bits=layout.get("bits", 1),
+                rotation_kind=layout.get("rotation_kind", "dense"))
         if sk == "ivf_pq":
             pq_kw = dict(base, pq_dim=layout["pq_dim"],
                          pq_bits=layout["pq_bits"],

@@ -88,6 +88,73 @@ def _unpack_pm1(packed):
     return (2 * bits - 1).astype(jnp.int8)
 
 
+# ---------------------------------------------------------------------------
+# Multi-bit (2–4 bit) extended codes — extra bit-planes, same kernels
+# ---------------------------------------------------------------------------
+#
+# A B-bit code c ∈ {0 … 2^B−1} per rotated dimension dequantizes to the odd
+# integer LEVEL  L = 2c − (2^B−1) = Σ_p 2^p · (2·bit_p(c) − 1):  the level-
+# weighted contraction ⟨q, L⟩ decomposes EXACTLY into B ±1 contractions, one
+# per bit-plane, weighted 2^p. Storage stacks each plane as its own packed
+# group of rot_dim/8 bytes (pack_sign_bits layout per plane), so the kernels'
+# existing byte-block DMA + `_unpack_pm1` + one MXU matmul work UNCHANGED at
+# the wider byte width bits·rot_dim/8; the level weights ride the QUERY
+# operand (:func:`extend_query_planes` — built once per dispatch, outside the
+# kernel), which is why the high-recall multi-bit scan is "still just a
+# wider MXU contraction" (TPU-KNN's peak-FLOP/s framing). For B = 1 the
+# level set is {−1, +1} and everything degenerates to the original layout.
+
+
+def multibit_width(rot_dim: int, bits: int) -> int:
+    """Bytes per B-bit-encoded row: ``bits`` stacked sign planes."""
+    if not 1 <= int(bits) <= 4:
+        raise ValueError(f"bits must be in [1, 4], got {bits}")
+    return int(bits) * packed_width(rot_dim)
+
+
+def pack_code_planes(codes, bits: int) -> jax.Array:
+    """(…, rot_dim) uint8 codes in [0, 2^bits) → (…, bits·rot_dim/8) uint8:
+    plane p (bit p of every code) packed via :func:`pack_sign_bits` into its
+    own contiguous nb-byte group. bits=1 gives exactly the 1-bit layout."""
+    if not 1 <= int(bits) <= 4:
+        raise ValueError(f"bits must be in [1, 4], got {bits}")
+    codes = codes.astype(jnp.uint8)
+    planes = [pack_sign_bits((((codes >> p) & 1).astype(jnp.int8) * 2 - 1))
+              for p in range(int(bits))]
+    return planes[0] if bits == 1 else jnp.concatenate(planes, axis=-1)
+
+
+def unpack_code_levels(packed, rot_dim: int, bits: int) -> jax.Array:
+    """Inverse view of :func:`pack_code_planes` → (…, rot_dim) int32 LEVELS
+    (odd integers in [−(2^bits−1), 2^bits−1]); bits=1 gives ±1."""
+    nb = packed_width(rot_dim)
+    if packed.shape[-1] != int(bits) * nb:
+        raise ValueError(
+            f"expected {int(bits) * nb} packed bytes, got {packed.shape[-1]}")
+    lv = None
+    for p in range(int(bits)):
+        pm1 = _unpack_pm1(packed[..., p * nb:(p + 1) * nb]).astype(jnp.int32)
+        lv = pm1 if lv is None else lv + (1 << p) * pm1
+    return lv
+
+
+def extend_query_planes(queries_rot, bits: int) -> jax.Array:
+    """(q, rot_dim) rotated queries → (q, bits·rot_dim) plane-weighted query
+    operand, ordered to match ``_unpack_pm1`` over a (w, bits·nb) packed
+    block: unpacked position ``j·bits·nb + p·nb + r`` is bit j of plane p's
+    byte r = plane p of dimension ``j·nb + r``, so the slot carries
+    ``2^p · q[j·nb + r]``. Then ⟨ext(q), ±1-planes⟩ == ⟨q, levels⟩ exactly.
+    bits=1 is the identity."""
+    bits = int(bits)
+    if bits == 1:
+        return queries_rot
+    q, rot_dim = queries_rot.shape
+    nb = packed_width(rot_dim)
+    w = (2.0 ** jnp.arange(bits)).astype(queries_rot.dtype)
+    a = queries_rot.reshape(q, 8, 1, nb) * w[None, None, :, None]
+    return a.reshape(q, 8 * bits * nb)
+
+
 def _score_topk(a, b_packed, scale_row, bias_row, alpha: float, kf: int,
                 w: int, approx_ok: bool):
     """One strip's scores + fused top-kf — THE shared compute of both
@@ -573,15 +640,16 @@ def paged_bq_search_traced(queries_rot, probes, codes, scale_pool,
 
 
 def occupancy_stats(lens, m: int, q: int, p: int, rot_dim: int,
-                    workspace_bytes: int = 1 << 30, kf: int = 10) -> dict:
+                    workspace_bytes: int = 1 << 30, kf: int = 10,
+                    bits: int = 1) -> dict:
     """Static occupancy diagnostics of one packed-scan dispatch: the strip
     planner's numbers (:func:`strip_scan.occupancy_stats`) at the scan's
-    REAL planning width (the bf16 unpacked block is ``rot_dim`` wide —
+    REAL planning width (the bf16 unpacked block is ``bits·rot_dim`` wide —
     the width ivf_bq's ``_ragged_plan_static`` plans with), plus the
     packed-code byte width the DMAs actually move."""
-    out = ss.occupancy_stats(lens, m, q, p, dim=rot_dim,
+    out = ss.occupancy_stats(lens, m, q, p, dim=rot_dim * int(bits),
                              workspace_bytes=workspace_bytes, kf=kf)
-    out["code_bytes_per_entry"] = packed_width(rot_dim)
+    out["code_bytes_per_entry"] = multibit_width(rot_dim, bits)
     return out
 
 

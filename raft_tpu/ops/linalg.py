@@ -12,6 +12,7 @@ jnp expression that XLA fuses; what earns a real design here:
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -111,6 +112,109 @@ def matrix_vector_op(x, v, axis: int = 1, op=jnp.add):
     (linalg/matrix_vector_op.cuh analog)."""
     v = jnp.asarray(v)
     return op(x, v[None, :] if axis == 1 else v[:, None])
+
+
+# -- random rotations (the IVF-PQ/BQ quantizer front end) -------------------
+
+#: recognised rotation representations (core/serialize `rotation_kind`):
+#:   * "dense"    — an explicit orthogonal (rot_dim, rot_dim) matrix
+#:                  (:func:`make_rotation_matrix`), applied as one gemm;
+#:   * "hadamard" — a structured SRHT rotation R = H·D/√d stored as ONLY its
+#:                  (rot_dim,) ±1 sign diagonal D (:func:`make_srht_signs`),
+#:                  applied in O(d·log d) via the fast Walsh–Hadamard
+#:                  butterfly (:func:`srht_rotate`). Same orthogonality —
+#:                  and therefore the same estimator-unbiasedness contract —
+#:                  at log d the FLOPs and 1/d the stored bytes.
+ROTATION_KINDS = ("dense", "hadamard")
+
+
+def pad_rot(x, rot_dim: int):
+    """Zero-pad the trailing dim of ``x`` up to ``rot_dim`` (the rotation
+    input width — ivf_pq_build.cuh pads the residual the same way)."""
+    pad = rot_dim - x.shape[-1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),))
+
+
+def make_rotation_matrix(key, rot_dim: int) -> jax.Array:
+    """Random orthogonal (rot_dim, rot_dim) via QR of a gaussian
+    (make_rotation_matrix analog, detail/ivf_pq_build.cuh:119)."""
+    g = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
+    q, r = jnp.linalg.qr(g)
+    return q * jnp.sign(jnp.diagonal(r))[None, :]
+
+
+def hadamard_rot_dim(dim: int) -> int:
+    """Rotation width for the SRHT kind: the next power of two ≥ dim (the
+    Walsh–Hadamard butterfly needs a pow2 width; ≥ 8 keeps codes at whole
+    bytes). The extra zero-padded coordinates rotate to ordinary signal —
+    the estimator algebra is width-agnostic."""
+    return max(8, 1 << max(0, math.ceil(math.log2(max(int(dim), 1)))))
+
+
+def make_srht_signs(key, rot_dim: int) -> jax.Array:
+    """The SRHT sign diagonal: (rot_dim,) fp32 in {−1, +1}. ``rot_dim``
+    must be a power of two (:func:`hadamard_rot_dim`)."""
+    if rot_dim & (rot_dim - 1) or rot_dim < 2:
+        raise ValueError(f"SRHT needs a power-of-two rot_dim, got {rot_dim}")
+    bits = jax.random.bernoulli(key, 0.5, (rot_dim,))
+    return jnp.where(bits, jnp.float32(1), jnp.float32(-1))
+
+
+def hadamard_transform(x) -> jax.Array:
+    """Unnormalized fast Walsh–Hadamard transform along the last axis:
+    ``x @ H_d`` for the (symmetric) ±1 Hadamard matrix, as log2(d)
+    full-width butterfly stages (each one reshape + add/sub — `jax.lax`
+    friendly: static shapes, no gathers, fuses into surrounding jits).
+    The last axis must be a power of two."""
+    d = x.shape[-1]
+    if d & (d - 1) or d < 1:
+        raise ValueError(f"hadamard_transform needs a power-of-two width, got {d}")
+    h = 1
+    while h < d:
+        y = x.reshape(x.shape[:-1] + (d // (2 * h), 2, h))
+        a, b = y[..., 0, :], y[..., 1, :]
+        x = jnp.stack([a + b, a - b], axis=-2).reshape(x.shape)
+        h *= 2
+    return x
+
+
+def srht_rotate(x, signs) -> jax.Array:
+    """Apply the structured rotation R = H·D/√d to rows of ``x``:
+    ``fwht(x · D) / √d``. Exactly orthogonal (H/√d is, D is diagonal ±1),
+    so ‖R·x‖ = ‖x‖ and the RaBitQ estimator's unbiasedness-over-rotations
+    argument carries over unchanged; O(d·log d) per row where the dense
+    rotation gemm pays O(d²)."""
+    d = signs.shape[-1]
+    return hadamard_transform(x * signs) * jnp.float32(1.0 / math.sqrt(d))
+
+
+def rotate_rows(x, rotation, kind: str = "dense") -> jax.Array:
+    """Rows of ``x`` (zero-padded up to the rotation width) through the
+    rotation in either representation: ``rotation`` is the dense matrix for
+    kind="dense", the (rot_dim,) sign diagonal for kind="hadamard". The
+    ONE apply every build/encode/search-prep flow shares, so the two kinds
+    cannot drift in padding or normalization conventions."""
+    if kind == "dense":
+        return pad_rot(x, rotation.shape[0]) @ rotation.T
+    if kind == "hadamard":
+        return srht_rotate(pad_rot(x, rotation.shape[0]), rotation)
+    raise ValueError(f"unknown rotation kind {kind!r} (expected one of "
+                     f"{ROTATION_KINDS})")
+
+
+def rotation_matrix_of(rotation, kind: str = "dense") -> jax.Array:
+    """The explicit (rot_dim, rot_dim) matrix of either representation —
+    for oracles/tests and the rare consumer that genuinely needs the dense
+    operator (never on a hot path for kind="hadamard")."""
+    if kind == "dense":
+        return jnp.asarray(rotation)
+    if kind == "hadamard":
+        d = rotation.shape[-1]
+        return srht_rotate(jnp.eye(d, dtype=jnp.float32), rotation).T
+    raise ValueError(f"unknown rotation kind {kind!r} (expected one of "
+                     f"{ROTATION_KINDS})")
 
 
 # -- decompositions (cuSOLVER-wrapper analogs) ------------------------------

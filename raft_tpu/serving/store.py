@@ -81,6 +81,7 @@ from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors._packing import pack_lists
 from raft_tpu.ops import distance as dist_mod
+from raft_tpu.ops import linalg
 
 PAGE_ROWS_ENV = "RAFT_TPU_SERVING_PAGE_ROWS"
 _DEFAULT_PAGE_ROWS = 128
@@ -166,6 +167,7 @@ class PagedListStore:
                  payload_width: int, payload_dtype,
                  rotation=None, codebooks=None, pq_bits: int = 8,
                  pq_dim: int = 0, codebook_kind: str = "subspace",
+                 bq_bits: int = 1, rotation_kind: str = "dense",
                  initial_pages: int = 0,
                  res: Optional[Resources] = None):
         if kind not in ("ivf_flat", "ivf_pq", "ivf_bq"):
@@ -184,6 +186,11 @@ class PagedListStore:
         self.pq_bits = int(pq_bits)
         self.pq_dim = int(pq_dim)
         self.codebook_kind = codebook_kind
+        # BQ extended-code/rotation configuration (round 17): the encode at
+        # upsert and the paged scans' plane-extended query operand both key
+        # off these (neighbors/ivf_bq docstring)
+        self.bq_bits = int(bq_bits)
+        self.rotation_kind = rotation_kind
         self.page_rows = int(page_rows or default_page_rows())
         self._res = res or current_resources()
         self._lock = threading.RLock()
@@ -254,7 +261,8 @@ class PagedListStore:
                 "ivf_bq", index.centers, index.metric, page_rows=page_rows,
                 payload_width=int(index.list_codes.shape[2]),
                 payload_dtype=index.list_codes.dtype,
-                rotation=index.rotation, res=res)
+                rotation=index.rotation, bq_bits=index.bits,
+                rotation_kind=index.rotation_kind, res=res)
         else:
             raise TypeError(f"unsupported index type {type(index).__name__}")
         if include_rows:
@@ -566,11 +574,12 @@ class PagedListStore:
             return payload, aux, aux, None
         if self.kind == "ivf_bq":
             labels = jnp.asarray(labels_np)
-            rot_dim = self.rotation.shape[0]
-            rc = ivf_pq_mod._pad_rot(self.centers, rot_dim) @ self.rotation.T
+            rc = linalg.rotate_rows(self.centers, self.rotation,
+                                    self.rotation_kind)
             c2 = dist_mod.sqnorm(self.centers)
             payload, scale, bias = ivf_bq_mod._encode_chunk(
-                work, labels, self.centers, self.rotation, rc, c2, l2)
+                work, labels, self.centers, self.rotation, rc, c2, l2,
+                self.bq_bits, self.rotation_kind)
             return payload, bias, bias, scale
         labels = jnp.asarray(labels_np)
         resid = ivf_pq_mod._pad_rot(work - self.centers[labels],
@@ -850,7 +859,7 @@ class PagedListStore:
                 self.centers, self.rotation, list_payload, list_ids,
                 aux2[:, :, 0],
                 jnp.where(list_ids >= 0, aux2[:, :, 1], jnp.inf),
-                self.metric)
+                self.metric, self.bq_bits, self.rotation_kind)
         else:
             aux_packed, _ = pack_lists(aux, ids_dev, labels_dev,
                                        self.n_lists, group,
@@ -885,6 +894,7 @@ class PagedListStore:
             payload_dtype=self.pages.dtype, rotation=self.rotation,
             codebooks=self.codebooks, pq_bits=self.pq_bits,
             pq_dim=self.pq_dim, codebook_kind=self.codebook_kind,
+            bq_bits=self.bq_bits, rotation_kind=self.rotation_kind,
             initial_pages=self.capacity_pages, res=self._res)
         if clone.table_width < self.table_width:
             clone._table = np.full((self.n_lists, self.table_width), -1,

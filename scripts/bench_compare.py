@@ -156,6 +156,19 @@ def direction(metric: str) -> str:
     tail = metric.rsplit(".", 1)[-1]
     if tail in _CONFIG_KEYS or metric.startswith("counters."):
         return "info"
+    # build trajectory (round 17): throughputs grow toward good — checked
+    # BEFORE the `_s` suffix rule, which would read `rows_per_s` as a
+    # latency; the streamed build's peak-residency predictions shrink
+    # toward good (a bigger peak is a smaller margin on the 15.6M-row
+    # per-chip share); the no-refine recall and the dense-vs-Hadamard
+    # rotation speedup grow toward good
+    if tail.endswith("rows_per_s") or tail == "rotation_speedup_x":
+        return "up"
+    if tail in ("build_peak_predicted_bytes",
+                "sift1b_share_peak_predicted_bytes"):
+        return "down"
+    if tail == "no_refine_recall":
+        return "up"
     if tail.endswith("_ub") or tail.endswith("_s") or "latency" in tail:
         return "down"
     # SLO plane (round 10): burn rates spend error budget — down is
@@ -243,6 +256,13 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "ivf_flat.hbm_predicted_to_measured": 0.05,
     "ivf_pq.hbm_predicted_to_measured": 0.05,
     "ivf_bq.hbm_predicted_to_measured": 0.05,
+    # build fast path (round 17): the no-refine multi-bit recall is a
+    # promise (the ≥0.95 rung), and the streamed build's peak-residency
+    # prediction is a step function of the layout — ANY growth is a
+    # margin loss on the per-chip share worth a row
+    "bq_build.no_refine_recall": 0.01,
+    "bq_build.build_peak_predicted_bytes": 0.0,
+    "bq_build.sift1b_share_peak_predicted_bytes": 0.0,
 }
 
 

@@ -201,6 +201,42 @@ print("tiny ivf_bq smoke: OK (qps=%s recall=%s code_bytes/row=%s "
 EOF
 
 echo
+echo "== streamed IVF-BQ build smoke (ISSUE 14) =="
+# build_streaming bit-identical (codes, scales, ids, bias) to one-shot
+# build on the same data/seed (1-bit dense AND 4-bit Hadamard), the same
+# under an armed ivf_bq.build.encode_chunk=oom fault completing through
+# the halve-chunk degraded retry, and the costmodel peak-residency bound
+# chunk-sized / n-independent.
+JAX_PLATFORMS=cpu python scripts/bq_build_smoke.py || fail=1
+
+echo
+echo "== bench tiny smoke (IVF-BQ build fast path: SRHT + multi-bit no-refine) =="
+# The bq_build section's three rungs at smoke scale: a measured
+# dense-vs-Hadamard rotation pair at d>=512, a streamed-build rows/s +
+# chunk-bounded predicted peak, and the multi-bit rung holding recall
+# >= 0.95 WITHOUT the exact refine (refine_ratio=1 — the high-recall
+# no-rerank regime the extended codes exist for).
+RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=bq_build \
+RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb_bqb.jsonl python - <<'EOF' || fail=1
+import json, subprocess, sys
+proc = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                      text=True, timeout=600)
+assert proc.returncode == 0, proc.stderr[-2000:]
+line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+bqb = json.loads(line)["extras"]["bq_build"]
+assert "error" not in bqb, bqb
+assert bqb["rotation_dim"] >= 512 and bqb["rotation_speedup_x"] > 0, bqb
+assert bqb["build_rows_per_s"] > 0, bqb
+assert bqb["build_peak_predicted_bytes"] > bqb["build_index_predicted_bytes"], bqb
+assert bqb["no_refine_recall"] >= 0.95, bqb
+assert bqb["no_refine_qps"] > 0, bqb
+print("tiny bq_build smoke: OK (rot speedup=%sx build_rows/s=%s "
+      "no_refine_recall=%s @%s bits)"
+      % (bqb["rotation_speedup_x"], bqb["build_rows_per_s"],
+         bqb["no_refine_recall"], bqb["no_refine_bits"]))
+EOF
+
+echo
 echo "== tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -99,6 +99,35 @@ def check_oracles():
     # scatter: pure data movement
     cases["serving.scatter"] = (
         dict(n_rows=5, dim=dim, payload_width=dim), 0)
+    # round 17 — SRHT apply: per row the sign multiply + log2(d) butterfly
+    # stages + the 1/sqrt(d) scale
+    cases["linalg.srht_apply"] = (
+        dict(n=5, rot_dim=16), 5 * 16 * (4 + 2))
+    # round 17 — multi-bit Hadamard BQ scan: every bit-plane widens the
+    # per-entry contraction; rotation is the butterfly, not a gemm
+    bits = 3
+    got = roofline.estimate_flops(
+        "ivf_bq.search", q=q, dim=dim, n_lists=nl, max_list_size=mls,
+        n_probes=p, k=k, rot_dim=16, bits=bits,
+        rotation_kind="hadamard")["flops"]
+    want = _mm(q, nl, dim) + q * 16 * (4 + 2) \
+        + q * p * mls * (2 * 16 * bits + 2)
+    assert got == want, ("ivf_bq.search multibit", got, want)
+    # round 17 — build models (configured-iteration floors)
+    it, tr = 2, 6
+    cases["ivf_flat.build"] = (
+        dict(n=7, dim=dim, n_lists=nl, kmeans_iters=it, train_rows=tr),
+        it * 4 * tr * nl * dim + _mm(7, nl, dim) + 2 * 7 * dim)
+    cases["ivf_pq.build"] = (
+        dict(n=7, dim=dim, n_lists=nl, pq_dim=pq_dim, kmeans_iters=it,
+             codebook_iters=2, train_rows=tr, cb_rows=4),
+        it * 4 * tr * nl * dim + _mm(7, nl, dim)
+        + 2 * 4 * 4 * 256 * rd + _mm(7, rd, dim) + _mm(7, 256, rd))
+    cases["ivf_bq.build"] = (
+        dict(n=7, dim=dim, n_lists=nl, kmeans_iters=it, train_rows=tr,
+             rot_dim=16, bits=2, rotation_kind="hadamard"),
+        it * 4 * tr * nl * dim + _mm(7, nl, dim) + 7 * 16 * (4 + 2)
+        + 7 * 16 * (2 * 2 + 4))
 
     for entry, (shapes, expect) in cases.items():
         got = roofline.estimate_flops(entry, **shapes)["flops"]

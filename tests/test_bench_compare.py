@@ -172,3 +172,63 @@ def test_paged_pallas_direction_rules(tmp_path):
     assert rows["serving.paged_to_packed_qps_ratio"] == "regression"
     assert rows["serving.compaction_cycles"] == "regression"
     assert rows["serving.tombstone_ratio_peak"] == "regression"
+
+
+def test_build_fast_path_direction_rules(tmp_path):
+    """Round 17 (ISSUE 14 satellite): build throughput and the no-refine
+    recall gate upward; build seconds and the streamed build's
+    peak-residency predictions gate downward (zero tolerance on the peak
+    — a bigger peak is lost margin on the per-chip share). The
+    `rows_per_s` rule must win over the generic `_s` latency suffix."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"bq_build": {"build_s": 10.0,
+                                   "build_rows_per_s": 100_000.0,
+                                   "build_peak_predicted_bytes": 1_000_000,
+                                   "sift1b_share_peak_predicted_bytes":
+                                       5_000_000,
+                                   "no_refine_recall": 0.96,
+                                   "rotation_speedup_x": 4.0},
+                      "ivf_flat": {"build_rows_per_s": 50_000.0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"bq_build": {"build_s": 30.0,
+                                   "build_rows_per_s": 30_000.0,
+                                   "build_peak_predicted_bytes": 1_200_000,
+                                   "sift1b_share_peak_predicted_bytes":
+                                       6_000_000,
+                                   "no_refine_recall": 0.90,
+                                   "rotation_speedup_x": 1.0},
+                      "ivf_flat": {"build_rows_per_s": 20_000.0}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    assert rows["bq_build.build_s"] == "regression"
+    assert rows["bq_build.build_rows_per_s"] == "regression"
+    assert rows["ivf_flat.build_rows_per_s"] == "regression"
+    assert rows["bq_build.build_peak_predicted_bytes"] == "regression"
+    assert rows["bq_build.sift1b_share_peak_predicted_bytes"] \
+        == "regression"
+    assert rows["bq_build.no_refine_recall"] == "regression"
+    assert rows["bq_build.rotation_speedup_x"] == "regression"
+
+
+def test_build_fast_path_improvements_not_regressions(tmp_path):
+    """The same metrics moving the GOOD way must never render as
+    regressions (direction sanity in both polarities)."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"bq_build": {"build_rows_per_s": 30_000.0,
+                                   "build_peak_predicted_bytes": 1_200_000,
+                                   "no_refine_recall": 0.90}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"bq_build": {"build_rows_per_s": 100_000.0,
+                                   "build_peak_predicted_bytes": 1_000_000,
+                                   "no_refine_recall": 0.96}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    verdicts = [line.strip("|").split("|")[-1].strip()
+                for line in proc.stdout.splitlines()
+                if line.startswith("| `")]
+    assert verdicts and "regression" not in verdicts, proc.stdout

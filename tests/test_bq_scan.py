@@ -222,3 +222,107 @@ class TestScoreOracle:
             cand.sort()
             want = [c[1] for c in cand[:k]] + [-1] * max(0, k - len(cand))
             assert list(got_i[r]) == want
+
+
+class TestMultiBitPlanes:
+    """Round-17 extended codes: stacked bit-planes scanned by the SAME
+    kernels at a wider byte width, the level weighting riding the query
+    operand (ops/bq_scan module docstring)."""
+
+    def test_pack_unpack_levels_roundtrip(self, rng):
+        from raft_tpu.ops.bq_scan import (multibit_width, pack_code_planes,
+                                          unpack_code_levels)
+
+        for bits in (1, 2, 3, 4):
+            for rot_dim in (8, 32, 64):
+                codes = rng.integers(0, 1 << bits, (9, rot_dim)) \
+                    .astype(np.uint8)
+                packed = pack_code_planes(jnp.asarray(codes), bits)
+                assert packed.shape == (9, multibit_width(rot_dim, bits))
+                lv = np.asarray(unpack_code_levels(packed, rot_dim, bits))
+                np.testing.assert_array_equal(
+                    lv, 2 * codes.astype(np.int32) - ((1 << bits) - 1))
+
+    def test_bits1_is_the_legacy_sign_layout(self, rng):
+        from raft_tpu.ops.bq_scan import pack_code_planes
+
+        codes = rng.integers(0, 2, (7, 32)).astype(np.uint8)
+        signs = np.where(codes > 0, 1, -1).astype(np.int8)
+        np.testing.assert_array_equal(
+            np.asarray(pack_code_planes(jnp.asarray(codes), 1)),
+            np.asarray(pack_sign_bits(jnp.asarray(signs))))
+
+    def test_query_extension_contraction_identity(self, rng):
+        """⟨ext(q), unpack_pm1(planes)⟩ == ⟨q, levels⟩ EXACTLY — the
+        identity that lets the ±1 kernels scan multi-bit codes without a
+        single kernel change."""
+        from raft_tpu.ops.bq_scan import (_unpack_pm1, extend_query_planes,
+                                          pack_code_planes)
+
+        rot_dim = 32
+        for bits in (2, 3, 4):
+            codes = rng.integers(0, 1 << bits, (11, rot_dim)) \
+                .astype(np.uint8)
+            packed = pack_code_planes(jnp.asarray(codes), bits)
+            q = rng.standard_normal((5, rot_dim)).astype(np.float32)
+            qe = np.asarray(extend_query_planes(jnp.asarray(q), bits))
+            assert qe.shape == (5, bits * rot_dim)
+            pm1 = np.asarray(_unpack_pm1(packed)).astype(np.float32)
+            levels = (2 * codes.astype(np.float32) - ((1 << bits) - 1))
+            np.testing.assert_allclose(qe @ pm1.T, q @ levels.T,
+                                       rtol=1e-5, atol=1e-4)
+
+    @pytest.mark.parametrize("bits", [2, 4])
+    def test_ragged_bit_parity_multibit(self, rng, bits):
+        """Kernel vs jnp reference over a ragged layout at bits > 1: ids
+        AND distances bit-identical (the acceptance-criteria contract at
+        the engine level)."""
+        from raft_tpu.ops.bq_scan import (extend_query_planes,
+                                          pack_code_planes)
+
+        rot_dim, n_lists = 16, 6
+        lens = [0, 3, ss.MC, 17, 1, ss.MC + 5]
+        chunks = max((max(lens) + ss.MC - 1) // ss.MC, 1)
+        m = ss.MC * (1 << (chunks - 1).bit_length())
+        codes = np.zeros((n_lists, m, bits * rot_dim // 8), np.uint8)
+        scale = np.zeros((n_lists, m), np.float32)
+        bias = np.full((n_lists, m), np.inf, np.float32)
+        ids = np.full((n_lists, m), -1, np.int32)
+        nxt = 0
+        for l in range(n_lists):
+            if lens[l] == 0:
+                continue
+            cl = rng.integers(0, 1 << bits, (lens[l], rot_dim)) \
+                .astype(np.uint8)
+            codes[l, :lens[l]] = np.asarray(
+                pack_code_planes(jnp.asarray(cl), bits))
+            scale[l, :lens[l]] = rng.uniform(0.5, 2.0, lens[l]) \
+                .astype(np.float32)
+            bias[l, :lens[l]] = rng.normal(size=lens[l]).astype(np.float32)
+            ids[l, :lens[l]] = np.arange(nxt, nxt + lens[l])
+            nxt += lens[l]
+        q = 4
+        qr = rng.standard_normal((q, rot_dim)).astype(np.float32)
+        qe = np.asarray(extend_query_planes(jnp.asarray(qr), bits))
+        probes = np.stack([rng.permutation(n_lists)[:3] for _ in range(q)])
+        outs = run_both(qe, probes, codes, scale, bias, ids, lens, k=5)
+        assert_bit_parity(outs)
+        # and against a dense oracle: score = α·⟨q, L⟩·scale + bias
+        vals, got_ids = outs["jnp"]
+        from raft_tpu.ops.bq_scan import unpack_code_levels
+
+        levels = np.asarray(unpack_code_levels(
+            jnp.asarray(codes), rot_dim, bits)).astype(np.float32)
+        for qi in range(q):
+            best = []
+            for l in probes[qi]:
+                for j in range(lens[l]):
+                    s = -2.0 * float(qr[qi] @ levels[l, j]) * scale[l, j] \
+                        + bias[l, j]
+                    best.append((s, ids[l, j]))
+            best.sort(key=lambda t: t[0])
+            want_ids = [b[1] for b in best[:5]]
+            got = [i for i in np.asarray(got_ids)[qi] if i >= 0]
+            # rank parity at fp32-vs-bf16 resolution: top-1 must agree
+            assert got[0] == want_ids[0] or abs(
+                best[0][0] - best[1][0]) < 1e-2

@@ -90,13 +90,63 @@ class TestPredictIndexBytes:
     def test_ivf_bq_exact_random_draws(self, rng, draw):
         n = int(rng.integers(400, 1500))
         dim = int(rng.choice([16, 32, 40]))
+        # round 17: the draw also covers the multi-bit code widths and
+        # both rotation representations (the SRHT sign diagonal stores
+        # rot_dim·4 bytes where the dense matrix stores rot_dim²·4)
+        bits = int(rng.integers(1, 5))
+        rkind = str(rng.choice(["dense", "hadamard"]))
         X = rng.standard_normal((n, dim)).astype(np.float32)
-        idx = ivf_bq.build(X, ivf_bq.IvfBqParams(n_lists=8))
+        idx = ivf_bq.build(X, ivf_bq.IvfBqParams(
+            n_lists=8, bits=bits, rotation_kind=rkind))
         pred, real = _roundtrip(idx)
         assert pred == real
         ivf_bq.search(idx, X[:4], 3, n_probes=8)
         pred, real = _roundtrip(idx)
         assert pred == real
+
+    def test_ivf_bq_multibit_store_exact(self, rng):
+        X = rng.standard_normal((800, 24)).astype(np.float32)
+        idx = ivf_bq.build(X, ivf_bq.IvfBqParams(
+            n_lists=8, bits=3, rotation_kind="hadamard", list_size_cap=0))
+        store = serving.PagedListStore.from_index(idx, page_rows=32)
+        serving.search(store, X[:4], 3, n_probes=4)  # device table built
+        pred, real = _roundtrip(store)
+        assert pred == real
+
+    def test_build_streaming_bound_chunk_sized(self):
+        """The streamed-build peak prediction is index + labels + ONE
+        chunk transient: chunk-linear, n-independent (the ISSUE 14
+        peak-residency acceptance bound)."""
+        kw = dict(dim=64, n_lists=128, max_list_size=2048, train_rows=64,
+                  rot_dim=64, bits=2, rotation_kind="hadamard")
+        a = costmodel.predict_build_streaming_bytes(
+            n=100_000, chunk_rows=8192, **kw)
+        b = costmodel.predict_build_streaming_bytes(
+            n=100_000_000, chunk_rows=8192, **kw)
+        assert a["chunk_transient_bytes"] == b["chunk_transient_bytes"]
+        half = costmodel.predict_build_streaming_bytes(
+            n=100_000, chunk_rows=4096, **kw)
+        assert 2 * half["chunk_transient_bytes"] == \
+            a["chunk_transient_bytes"]
+        assert a["peak_bytes"] - a["index_bytes"] - a["labels_bytes"] \
+            == a["chunk_transient_bytes"]
+
+    def test_build_streaming_bound_counts_default_trainset(self):
+        """The train_rows=0 sentinel models the build's DEFAULT sample
+        (never zero residency), at 2× for the parts+concat transient —
+        and the hadamard rot_dim default is the pow2 width, not the
+        dense byte-rounding (review round 17)."""
+        out = costmodel.predict_build_streaming_bytes(
+            n=4_000_000, dim=100, n_lists=4096, max_list_size=4096,
+            chunk_rows=8192, rotation_kind="hadamard")
+        assert out["train_bytes"] == 2 * 2_000_000 * 100 * 4
+        assert out["peak_bytes"] >= out["index_bytes"] \
+            + out["labels_bytes"] + out["train_bytes"]
+        # rot_dim defaulted kind-aware: 100 → 128 (pow2), not 104
+        explicit = costmodel.predict_build_streaming_bytes(
+            n=4_000_000, dim=100, n_lists=4096, max_list_size=4096,
+            chunk_rows=8192, rot_dim=128, rotation_kind="hadamard")
+        assert out == explicit
 
     def test_brute_force_exact(self, rng):
         X = rng.standard_normal((700, 24)).astype(np.float32)

@@ -258,3 +258,326 @@ class TestEstimatorUnbiased:
                                            rtol=2e-3, atol=2e-2)
                 checked += 1
         assert checked >= 100
+
+
+class TestHadamardRotation:
+    """Round 17: the SRHT structured rotation behind rotation_kind —
+    same estimator contract as the dense QR rotation at O(d·log d)."""
+
+    def test_refined_recall_hadamard(self, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(
+            n_lists=64, seed=0, rotation_kind="hadamard"))
+        assert idx.rotation_kind == "hadamard"
+        assert idx.rotation.ndim == 1          # the sign diagonal
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_bq.search_refined(idx, ds, qs, 10, n_probes=16,
+                                       refine_ratio=8)
+        assert _recall(got, exact) >= 0.95
+
+    def test_backend_bit_parity_hadamard(self, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(
+            n_lists=32, seed=1, rotation_kind="hadamard"))
+        v1, i1 = ivf_bq.search(idx, qs, 10, n_probes=8, backend="packed")
+        v2, i2 = ivf_bq.search(idx, qs, 10, n_probes=8,
+                               backend="reference")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_unbiased_over_srht_rotations(self):
+        """The existing unbiasedness property test, SRHT edition: pooled
+        over random sign diagonals, the signed error of f·⟨b, Rv⟩ against
+        ⟨u, Rv⟩ cancels — the Hadamard rotation preserves the estimator
+        contract (acceptance criterion)."""
+        from raft_tpu.ops import linalg
+
+        rng = np.random.default_rng(3)
+        D, n, S = 64, 256, 16
+        X = rng.standard_normal((n, D)).astype(np.float32)
+        v = rng.standard_normal(D).astype(np.float32)
+        true = X @ v
+        errs = []
+        for s in range(S):
+            signs = linalg.make_srht_signs(jax.random.key(s), D)
+            R = np.asarray(linalg.rotation_matrix_of(signs, "hadamard"))
+            U = X @ R.T
+            B = np.where(U >= 0, 1.0, -1.0).astype(np.float32)
+            f = (U * U).sum(1) / np.abs(U).sum(1)
+            errs.append(f * (B @ (R @ v)) - true)
+        errs = np.concatenate(errs)
+        mean_abs = np.abs(errs).mean()
+        assert mean_abs > 0
+        assert abs(errs.mean()) < 0.05 * mean_abs, (errs.mean(), mean_abs)
+
+    def test_biased_scalar_fails_srht_gate_too(self):
+        """Negative control (acceptance criterion): the biased projection
+        scalar must fail the SAME gate under SRHT rotations — the gate
+        has teeth in the structured-rotation regime as well."""
+        from raft_tpu.ops import linalg
+
+        rng = np.random.default_rng(3)
+        D, n, S = 64, 256, 16
+        v = rng.standard_normal(D).astype(np.float32)
+        X = (rng.standard_normal((n, D)) + 0.5 * v).astype(np.float32)
+        true = X @ v
+        errs = []
+        for s in range(S):
+            signs = linalg.make_srht_signs(jax.random.key(s), D)
+            R = np.asarray(linalg.rotation_matrix_of(signs, "hadamard"))
+            U = X @ R.T
+            B = np.where(U >= 0, 1.0, -1.0).astype(np.float32)
+            errs.append((np.abs(U).sum(1) / D) * (B @ (R @ v)) - true)
+        errs = np.concatenate(errs)
+        assert abs(errs.mean()) > 0.05 * np.abs(errs).mean()
+
+
+class TestMultiBit:
+    """Round 17: 2–4 bit extended codes — the high-recall/no-refine
+    regime, scanned as a wider MXU contraction by the unchanged kernels."""
+
+    def test_no_refine_recall_improves_with_bits(self, data):
+        ds, qs = data
+        _, exact = brute_force.knn(qs, ds, 10)
+        recalls = {}
+        for bits in (1, 2, 4):
+            idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(
+                n_lists=64, seed=0, bits=bits, rotation_kind="hadamard"))
+            assert idx.code_bytes_per_row == bits * idx.rot_dim // 8
+            _, got = ivf_bq.search(idx, qs, 10, n_probes=32)
+            recalls[bits] = _recall(got, exact)
+        assert recalls[2] > recalls[1]
+        assert recalls[4] > recalls[2]
+        assert recalls[4] >= 0.9     # set-based; the tie-aware bench
+        #                              rung holds the 0.95 gate
+
+    @pytest.mark.parametrize("bits", [2, 4])
+    def test_backend_bit_parity_multibit(self, data, bits):
+        ds, qs = data
+        idx = ivf_bq.build(ds, ivf_bq.IvfBqParams(
+            n_lists=32, seed=1, bits=bits, rotation_kind="hadamard"))
+        v1, i1 = ivf_bq.search(idx, qs, 10, n_probes=8, backend="packed")
+        v2, i2 = ivf_bq.search(idx, qs, 10, n_probes=8,
+                               backend="reference")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_multibit_scalars_match_definition(self, data):
+        """f = ‖u‖²/⟨L, u⟩ and bias = ‖c‖² + ‖u‖² + 2f⟨L, Rc̃⟩ with L the
+        odd-integer levels — recomputed from raw rows through the explicit
+        SRHT matrix."""
+        from raft_tpu.ops import linalg
+        from raft_tpu.ops.bq_scan import unpack_code_levels
+
+        ds, _ = data
+        n, bits = 1000, 3
+        idx = ivf_bq.build(ds[:n], ivf_bq.IvfBqParams(
+            n_lists=8, seed=0, bits=bits, rotation_kind="hadamard"))
+        R = np.asarray(linalg.rotation_matrix_of(idx.rotation, "hadamard"))
+        centers = np.asarray(idx.centers)
+        ids = np.asarray(idx.list_ids)
+        scale = np.asarray(idx.list_scale)
+        bias = np.asarray(idx.list_bias)
+        levels = np.asarray(unpack_code_levels(
+            idx.list_codes, idx.rot_dim, bits)).astype(np.float64)
+        pad = idx.rot_dim - ds.shape[1]
+        checked = 0
+        for l in range(idx.n_lists):
+            for j in range(min(int((ids[l] >= 0).sum()), 15)):
+                x = ds[ids[l, j]]
+                u = R @ np.pad(x - centers[l], (0, pad))
+                L = levels[l, j]
+                f = (u @ u) / (L @ u)
+                np.testing.assert_allclose(scale[l, j], f, rtol=2e-4)
+                g = float(L @ (R @ np.pad(centers[l], (0, pad))))
+                want = (centers[l] @ centers[l]) + (u @ u) + 2 * f * g
+                np.testing.assert_allclose(bias[l, j], want,
+                                           rtol=2e-3, atol=2e-2)
+                checked += 1
+        assert checked >= 50
+
+    def test_extend_multibit_preserves_old_rows(self, data):
+        ds, _ = data
+        idx = ivf_bq.build(ds[:4000], ivf_bq.IvfBqParams(
+            n_lists=16, seed=0, bits=2, rotation_kind="hadamard"))
+        codes0 = {int(i): c.copy() for l in range(idx.n_lists)
+                  for i, c in zip(np.asarray(idx.list_ids)[l],
+                                  np.asarray(idx.list_codes)[l]) if i >= 0}
+        idx2 = ivf_bq.extend(idx, ds[4000:5000])
+        assert idx2.bits == 2 and idx2.rotation_kind == "hadamard"
+        ids1 = np.asarray(idx2.list_ids)
+        codes1 = np.asarray(idx2.list_codes)
+        hits = 0
+        for l in range(idx2.n_lists):
+            for j in range(int((ids1[l] >= 0).sum())):
+                rid = int(ids1[l, j])
+                if rid in codes0:
+                    np.testing.assert_array_equal(codes1[l, j], codes0[rid])
+                    hits += 1
+        assert hits == 4000
+
+
+class TestSerializationV2:
+    """Satellite 3: v2 serialization of the new index shapes."""
+
+    def test_roundtrip_multibit_hadamard_bit_parity(self, tmp_path, data):
+        ds, qs = data
+        idx = ivf_bq.build(ds[:5000], ivf_bq.IvfBqParams(
+            n_lists=32, seed=0, bits=4, rotation_kind="hadamard"))
+        p = tmp_path / "bq_mb.raft"
+        idx.save(p)
+        idx2 = ivf_bq.IvfBqIndex.load(p)
+        assert idx2.bits == 4 and idx2.rotation_kind == "hadamard"
+        for name in ("centers", "rotation", "list_codes", "list_ids",
+                     "list_scale", "list_bias"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(idx, name)),
+                np.asarray(getattr(idx2, name)), err_msg=name)
+        v1, i1 = ivf_bq.search(idx, qs, 5, n_probes=8)
+        v2, i2 = ivf_bq.search(idx2, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_legacy_file_loads_as_dense(self, tmp_path, data):
+        """A pre-round-17 file carries neither rotation_kind nor bits:
+        it must load as the dense 1-bit index it is (regression: old
+        snapshots keep working)."""
+        from raft_tpu.core.serialize import save_arrays
+
+        ds, qs = data
+        idx = ivf_bq.build(ds[:3000], ivf_bq.IvfBqParams(n_lists=16,
+                                                         seed=0))
+        p = tmp_path / "bq_legacy.raft"
+        # exactly the pre-round-17 save_arrays call (no new meta fields)
+        save_arrays(p, {"kind": "ivf_bq", "metric": idx.metric},
+                    {"centers": idx.centers, "rotation": idx.rotation,
+                     "list_codes": idx.list_codes,
+                     "list_ids": idx.list_ids,
+                     "list_scale": idx.list_scale,
+                     "list_bias": idx.list_bias})
+        idx2 = ivf_bq.IvfBqIndex.load(p)
+        assert idx2.rotation_kind == "dense" and idx2.bits == 1
+        v1, i1 = ivf_bq.search(idx, qs, 5, n_probes=8)
+        v2, i2 = ivf_bq.search(idx2, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_unknown_rotation_kind_classified(self, tmp_path, data):
+        """A file from a newer format revision (unknown rotation_kind)
+        fails loudly by name and classifies FATAL — never decodes through
+        the wrong apply."""
+        from raft_tpu import resilience
+        from raft_tpu.core.serialize import save_arrays
+
+        ds, _ = data
+        idx = ivf_bq.build(ds[:2000], ivf_bq.IvfBqParams(n_lists=16))
+        p = tmp_path / "bq_future.raft"
+        save_arrays(p, {"kind": "ivf_bq", "metric": idx.metric,
+                        "bits": 1, "rotation_kind": "givens"},
+                    {"centers": idx.centers, "rotation": idx.rotation,
+                     "list_codes": idx.list_codes,
+                     "list_ids": idx.list_ids,
+                     "list_scale": idx.list_scale,
+                     "list_bias": idx.list_bias})
+        with pytest.raises(ValueError, match="rotation_kind"):
+            ivf_bq.IvfBqIndex.load(p)
+        try:
+            ivf_bq.IvfBqIndex.load(p)
+        except ValueError as e:
+            assert resilience.classify(e) == resilience.FATAL
+
+    def test_params_validation(self):
+        with pytest.raises(ValueError):
+            ivf_bq.IvfBqParams(bits=5)
+        with pytest.raises(ValueError):
+            ivf_bq.IvfBqParams(bits=0)
+        with pytest.raises(ValueError):
+            ivf_bq.IvfBqParams(rotation_kind="givens")
+
+
+class TestBuildStreaming:
+    """Tentpole leg 2: the chunked two-pass build — bounded residency,
+    bit-identity with one-shot build, round-7 fault recovery."""
+
+    _FIELDS = ("list_codes", "list_ids", "list_scale", "list_bias",
+               "centers", "rotation")
+
+    def _params(self, bits=1, rkind="dense"):
+        return ivf_bq.IvfBqParams(
+            n_lists=16, seed=4, bits=bits, rotation_kind=rkind,
+            kmeans_trainset_fraction=1.0, list_size_cap=0)
+
+    @pytest.mark.parametrize("bits,rkind", [(1, "dense"), (4, "hadamard")])
+    def test_bit_identical_to_build(self, data, bits, rkind):
+        ds, qs = data
+        ds = ds[:6000]
+        p = self._params(bits, rkind)
+        one = ivf_bq.build(ds, p)
+        streamed = ivf_bq.build_streaming(
+            lambda s, e: ds[s:e], ds.shape[0], ds.shape[1], p,
+            chunk_rows=1700, train_rows=ds.shape[0])
+        for name in self._FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(one, name)),
+                np.asarray(getattr(streamed, name)), err_msg=name)
+        v1, i1 = ivf_bq.search(one, qs, 5, n_probes=8)
+        v2, i2 = ivf_bq.search(streamed, qs, 5, n_probes=8)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+    def test_oom_fault_degrades_and_stays_identical(self, data):
+        from raft_tpu import obs, resilience
+
+        ds, _ = data
+        ds = ds[:5000]
+        p = self._params(2, "hadamard")
+        one = ivf_bq.build(ds, p)
+        obs.enable()
+        resilience.arm_faults("ivf_bq.build.encode_chunk=oom:1")
+        try:
+            streamed = ivf_bq.build_streaming(
+                lambda s, e: ds[s:e], ds.shape[0], ds.shape[1], p,
+                chunk_rows=2500, train_rows=ds.shape[0])
+            snap = obs.snapshot()["counters"]
+        finally:
+            resilience.clear_faults()
+            obs.disable()
+            obs.reset()
+        assert snap.get("ivf_bq.build.degraded_chunk", 0) >= 1
+        for name in self._FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(one, name)),
+                np.asarray(getattr(streamed, name)), err_msg=name)
+
+    def test_fatal_fault_propagates(self, data):
+        from raft_tpu import resilience
+
+        ds, _ = data
+        p = self._params()
+        resilience.arm_faults("ivf_bq.build.encode_chunk=fatal:1")
+        try:
+            with pytest.raises(Exception) as ei:
+                ivf_bq.build_streaming(
+                    lambda s, e: ds[s:e], 3000, ds.shape[1], p,
+                    chunk_rows=3000, train_rows=3000)
+            assert resilience.classify(ei.value) == resilience.FATAL
+        finally:
+            resilience.clear_faults()
+
+    def test_capacity_diversion_under_cap(self, data):
+        """With a cap, pass-1 diverts nearest-full rows to their
+        second-nearest; resulting list fills never exceed the cap and the
+        searchable row count matches (no silent loss at the auto cap)."""
+        ds, qs = data
+        ds = ds[:6000]
+        p = ivf_bq.IvfBqParams(n_lists=16, seed=4, list_size_cap=512)
+        streamed = ivf_bq.build_streaming(
+            lambda s, e: ds[s:e], ds.shape[0], ds.shape[1], p,
+            chunk_rows=1500)
+        sizes = np.asarray(streamed.list_sizes())
+        assert sizes.max() <= 512
+        assert streamed.size + streamed._streaming_dropped == ds.shape[0]
+        _, exact = brute_force.knn(qs, ds, 10)
+        _, got = ivf_bq.search_refined(streamed, ds, qs, 10, n_probes=16,
+                                       refine_ratio=8)
+        assert _recall(got, exact) >= 0.9

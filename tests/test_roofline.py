@@ -151,6 +151,79 @@ class TestFlopOracles:
                                      + rd * rd * 4
                                      + strips * mls * (rd // 8 + 12))
 
+    @pytest.mark.parametrize("draw", range(3))
+    def test_ivf_bq_multibit_hadamard(self, rng, draw):
+        """The round-17 extended-code scan: every extra bit-plane widens
+        the per-entry contraction to bits·rot_dim, the strip stream to
+        bits·rot_dim/8 code bytes; the SRHT rotation counts the sign
+        multiply + log2(rd) butterfly stages + the 1/√d scale per row
+        with only a (rd,) operand."""
+        q, dim = int(rng.integers(1, 5)), int(rng.integers(3, 9))
+        n_lists, mls = 3, int(rng.integers(2, 7))
+        p, k = 2, 3
+        bits = int(rng.integers(2, 5))
+        rd = 1 << math.ceil(math.log2(max(dim, 8)))     # hadamard width
+        est = roofline.estimate_flops(
+            "ivf_bq.search", q=q, dim=dim, n_lists=n_lists,
+            max_list_size=mls, n_probes=p, k=k, rot_dim=rd, bits=bits,
+            rotation_kind="hadamard")
+        flops = _loop_matmul_flops(q, n_lists, dim)      # coarse
+        for _ in range(q):                               # SRHT butterfly
+            flops += rd * (int(math.log2(rd)) + 2)
+        for _ in range(q):
+            for _ in range(p):
+                for _ in range(mls):
+                    flops += 2 * rd * bits + 2           # wide scan + s/b
+        assert est["flops"] == flops
+        strips = math.ceil(q * p / roofline.STRIP_C)
+        assert est["bytes_read"] == (q * dim * 4 + n_lists * dim * 4
+                                     + rd * 4             # sign diagonal
+                                     + strips * mls * (bits * rd // 8 + 12))
+
+    def test_srht_apply_oracle(self):
+        n, rd = 5, 64
+        est = roofline.estimate_flops("linalg.srht_apply", n=n, rot_dim=rd)
+        # per row: rd sign multiplies + log2(rd) add/sub stages of rd
+        # butterflies + rd scale multiplies
+        assert est["flops"] == n * rd * (6 + 2)
+        assert est["bytes_read"] == n * rd * 4 + rd * 4
+        assert est["bytes_written"] == n * rd * 4
+        # the O(d log d) vs O(d²) claim as numbers: dense apply of the
+        # same rows costs 2·n·d·d
+        dense = 2 * n * rd * rd
+        assert est["flops"] < dense / 10
+
+    def test_build_model_oracles(self):
+        """Hand-counted build models (round-17 satellite: the bench's
+        flat/pq/bq build phases stamp these)."""
+        n, dim, nl, tr, it = 10, 4, 3, 6, 2
+        est = roofline.estimate_flops(
+            "ivf_flat.build", n=n, dim=dim, n_lists=nl, kmeans_iters=it,
+            train_rows=tr)
+        want = it * 4 * tr * nl * dim + 2 * n * nl * dim + 2 * n * dim
+        assert est["flops"] == want
+        pq_dim, cb_it, cbr = 2, 3, 5
+        rd = pq_dim * math.ceil(dim / pq_dim)
+        est = roofline.estimate_flops(
+            "ivf_pq.build", n=n, dim=dim, n_lists=nl, pq_dim=pq_dim,
+            kmeans_iters=it, codebook_iters=cb_it, train_rows=tr,
+            cb_rows=cbr)
+        want = (it * 4 * tr * nl * dim + 2 * n * nl * dim
+                + cb_it * 4 * cbr * 256 * rd + 2 * n * dim * rd
+                + 2 * n * 256 * rd)
+        assert est["flops"] == want
+        rdb = 8
+        for bits, rkind, rot_f in (
+                (1, "dense", 2 * n * dim * rdb),
+                (3, "hadamard", n * rdb * (3 + 2))):
+            est = roofline.estimate_flops(
+                "ivf_bq.build", n=n, dim=dim, n_lists=nl, kmeans_iters=it,
+                train_rows=tr, rot_dim=rdb, bits=bits,
+                rotation_kind=rkind)
+            want = (it * 4 * tr * nl * dim + 2 * n * nl * dim + rot_f
+                    + n * rdb * (2 * bits + 4))
+            assert est["flops"] == want, (bits, rkind)
+
     @pytest.mark.parametrize("draw", range(2))
     def test_paged_flat(self, rng, draw):
         q, dim, n_lists = int(rng.integers(1, 5)), 4, 3
