@@ -888,6 +888,22 @@ def run_suite():
         hb.section("serving", extras["serving"])
     serving_src_index = None  # release for the large sections below
 
+    # --- Capacity: multi-tenant chaos rung (ISSUE 15 / ROADMAP item 4) ----
+    # N tenants at ~4× HBM oversubscription under skewed Poisson traffic
+    # through the acting admission controller: zero OOM verdicts, every
+    # demotion/promotion/rejection classified, per-tenant SLO rows
+    # exported, and the snapshot-restore hot swap a MEASURED latency row.
+    if section_on("capacity"):
+        if on_cpu or elapsed() < 1100:
+            hb.set_section("capacity")
+            try:
+                extras["capacity"] = _capacity_chaos(tiny=tiny)
+            except Exception as e:
+                extras["capacity"] = section_error(e)
+        else:
+            extras["capacity"] = {"error": "skipped: time budget"}
+        hb.section("capacity", extras["capacity"])
+
     # --- CAGRA at the FULL bench scale and the FULL query batch (VERDICT
     # r4 weak #3: q=2000 vs the IVF rows' q=10000 needed a footnote).
     # Build = IVF candidate scan (+ compressed-traversal payload, round 5);
@@ -1624,6 +1640,185 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
 
     out["store_after"] = store.stats()
     out["_store"] = store  # the section owner compacts + caches this
+    return out
+
+
+def _capacity_chaos(tiny: bool, rng_seed: int = 11) -> dict:
+    """Multi-tenant capacity chaos rung (ISSUE 15 acceptance): N tenants
+    with skewed (Zipf) popularity served as Poisson streaming traffic,
+    ~4× oversubscribed against a SYNTHETIC HBM budget, through the acting
+    :class:`raft_tpu.serving.CapacityController`. Gates:
+
+    * ZERO OOM verdicts — oversubscription lands as classified
+      demotions / degraded warm serves / rejections, never an allocator
+      failure;
+    * every demotion, promotion and rejection classified (no
+      unclassified residue in the per-tenant report);
+    * per-tenant SLO rows exported through the crash-safe progress
+      channel (``results/obs_report_capacity.jsonl``);
+    * the snapshot-restore hot-swap (promote) latency is a MEASURED row
+      (``promote_p50_s``), not a claim.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from raft_tpu import obs, resilience, serving
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import costmodel as obs_costmodel
+    from raft_tpu.obs import report as obs_report
+
+    rng = np.random.default_rng(rng_seed)
+    if tiny:
+        n_tenants, n_req, rows, dim = 8, 160, 900, 16
+    else:
+        n_tenants, n_req, rows, dim = 12, 480, 3000, 32
+    snap_dir = tempfile.mkdtemp(prefix="raft_tpu_capacity_")
+
+    # build the tenants (off the serving clock — registration is the
+    # expensive moment by design) and size the synthetic budget at ~4×
+    # oversubscription of their FULL residency
+    registry = serving.TenantRegistry()
+    sizing = serving.CapacityController(registry=registry,
+                                        budget_bytes=1 << 50)
+    datasets = {}
+    for i in range(n_tenants):
+        name = f"tenant{i:02d}"
+        X = rng.standard_normal((rows, dim)).astype(np.float32)
+        datasets[name] = X
+        idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(
+            n_lists=8, list_size_cap=0))
+        sizing.register(name, idx, snap_dir)
+    total_full = registry.resident_bytes()
+    biggest = max(t.resident_bytes() for t in registry.tenants())
+    one_probe = obs_costmodel.estimate_search(
+        registry.tenants()[0].hot_obj, q=1, k=5,
+        n_probes=4)["transient_bytes"]
+    # ~4× oversubscribed, but with room for at least one hot tenant plus
+    # a dispatch transient (otherwise the rung measures nothing but
+    # rejections)
+    budget = int(max(total_full / 4.0,
+                     (biggest + 2 * one_probe) / 0.8))
+    ctrl = serving.CapacityController(
+        registry=registry, budget_bytes=budget, window_s=0.2)
+    # re-place every tenant under the REAL budget (registration-time
+    # admission ran against the sizing sentinel); the demotion window
+    # bounds each pass, so wait it out until the ledger converges
+    t_rebudget = time.perf_counter() + 30
+    rec = ctrl.admit(0, entry="capacity.rebudget")
+    while rec["verdict"] != "admit" and time.perf_counter() < t_rebudget:
+        if not ctrl.make_room(rec.get("shortfall_bytes", 0)):
+            time.sleep(ctrl.window_s + 0.02)
+        rec = ctrl.admit(0, entry="capacity.rebudget")
+    out = {
+        "tenants": n_tenants,
+        "budget_bytes": budget,
+        "oversubscription_x": round(total_full / budget, 2),
+        "rows_per_tenant": rows,
+    }
+
+    # skewed popularity (Zipf-ish) + Poisson arrivals
+    ranks = np.arange(1, n_tenants + 1, dtype=np.float64)
+    popularity = (1.0 / ranks ** 1.1)
+    popularity /= popularity.sum()
+    names = sorted(datasets)
+    choices = rng.choice(n_tenants, size=n_req, p=popularity)
+    think = rng.exponential(0.002, size=n_req)  # offered-load shaping
+    outcomes = {"ok": 0, "degraded": 0, "rejected": 0, "deadline": 0,
+                "oom": 0, "other": 0}
+    k = 5
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        name = names[int(choices[i])]
+        q = datasets[name][rng.integers(0, rows)][None].astype(np.float32)
+        try:
+            with resilience.Deadline(2.0, label="capacity.request"):
+                res = ctrl.search(name, q, k, n_probes=4)
+            outcomes["degraded" if res.degraded else "ok"] += 1
+        except Exception as e:
+            kind = resilience.classify(e)
+            if isinstance(e, serving.CapacityRejected):
+                outcomes["rejected"] += 1
+            elif kind in outcomes:
+                outcomes[kind] += 1
+            else:
+                outcomes["other"] += 1
+        if i % 12 == 0:
+            # the reverse path, off the request: popular demoted tenants
+            # get their measured hot swap when the budget allows
+            ctrl.autopromote(1)
+        if think[i] > 0.004:
+            time.sleep(min(think[i], 0.01))
+    wall = time.perf_counter() - t0
+
+    # force ≥1 measured promote even if the window stayed all-admit: the
+    # hot-swap latency row must exist (acceptance: measured, not claimed)
+    if ctrl.promote_latency()["count"] == 0:
+        victim = names[-1]
+        ctrl.demote(victim)
+        ctrl.registry.get(victim).last_demoted = 0.0
+        ctrl.promote(victim)
+
+    report = obs_report.collect(capacity=ctrl)
+    cap_sec = report["capacity"]
+    out["qps"] = round(n_req / wall, 1) if wall > 0 else 0.0
+    out.update({
+        "served_ok": outcomes["ok"],
+        "degraded_serves": outcomes["degraded"],
+        "rejections": outcomes["rejected"],
+        "deadline_misses": outcomes["deadline"],
+        # the headline gate: the allocator never saw an over-budget
+        # dispatch, so the only acceptable count is zero
+        "oom_verdicts": outcomes["oom"],
+        "unclassified": outcomes["other"],
+        "demotions": cap_sec["demotions"],
+        "promotions": cap_sec["promotions"],
+        "tenants_resident_hot": cap_sec["tenants_resident_hot"],
+        "tenants_resident_warm": cap_sec["tenants_resident_warm"],
+        "resident_bytes": cap_sec["resident_bytes"],
+        "resident_fraction": cap_sec["resident_fraction"],
+    })
+    plat = cap_sec["promote"]
+    out["promote_count"] = plat.get("count", 0)
+    if plat.get("p50_s") is not None:
+        out["promote_p50_s"] = plat["p50_s"]
+        out["promote_p99_s"] = plat.get("p99_s")
+
+    # degraded recall attribution: one demoted tenant's warm answers vs
+    # its own exact search — the number the WARM tier costs. Pick a
+    # tenant whose codes are ALREADY resident (a cold victim would need
+    # an admission-checked reload the packed ledger may refuse).
+    try:
+        warm_now = [t.name for t in ctrl.registry.tenants()
+                    if t.warm_index is not None]
+        victim = warm_now[0] if warm_now else names[0]
+        t = ctrl.registry.get(victim)
+        if t.tier == "hot":
+            ctrl.demote(victim)
+        X = datasets[victim]
+        qs = X[:32] + 0.01 * rng.standard_normal((32, dim)).astype(
+            np.float32)
+        res = ctrl.search(victim, qs, k, n_probes=64)
+        d2 = ((X[None, :, :] - qs[:, None, :]) ** 2).sum(-1)
+        exact_topk = np.argsort(d2, axis=1)[:, :k]
+        got = np.asarray(res.indices)
+        hits = sum(len(set(got[i]) & set(exact_topk[i]))
+                   for i in range(len(qs)))
+        out["degraded_recall"] = round(hits / (len(qs) * k), 4)
+    except Exception as e:
+        out["degraded_recall_error"] = section_error(e)
+
+    # per-tenant SLO rows through the crash-safe channel (acceptance);
+    # fresh stream per run, like the serving section's report file
+    from raft_tpu.bench import progress as prog
+
+    report_path = os.path.join("results", "obs_report_capacity.jsonl")
+    prog.truncate(report_path)
+    obs_report.export(report_path, report)
+    out["obs_report_file"] = report_path
+    out["per_tenant_rows"] = len(cap_sec["tenants"])
+    if obs.enabled():
+        obs.add("bench.capacity.requests", n_req)
     return out
 
 

@@ -39,8 +39,12 @@ from raft_tpu.analysis.registry import Rule, register
 from raft_tpu.analysis.rules._common import calls_record_span, is_traced_decorated
 
 _SCOPED_DIRS = {"neighbors", "cluster", "distributed", "serving"}
+#: ``promote``/``demote`` (round 18): the capacity plane's tier moves are
+#: serving-path policy actions — an unobserved demotion is an invisible
+#: recall hit, so they are entry points like search/upsert
 _ENTRY_NAMES = {"build", "search", "fit", "fit_predict", "extend", "knn",
-                "upsert", "delete", "submit", "compact"}
+                "upsert", "delete", "submit", "compact", "promote",
+                "demote"}
 _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 
 #: the obs plane's own public entry points (ISSUE 10; ISSUE 11 extended

@@ -73,14 +73,20 @@ class SpanNameRule(Rule):
                         f"convention (lower-case dotted segments around one "
                         f"'::') — renamed spans fork their metric series "
                         f"across rounds")
-                elif in_serving and not name.startswith("serving::"):
+                elif in_serving and not name.startswith(
+                        ("serving::", "capacity::")):
                     # the serving layer's span family is its SLO dashboard:
                     # a span filed under another module's prefix silently
-                    # drops out of every serving-latency query
+                    # drops out of every serving-latency query. Round 18
+                    # adds the capacity:: family — the multi-tenant
+                    # admission/tiering plane lives in serving/ but its
+                    # spans (capacity::admit/demote/promote/search) are
+                    # their own dashboard
                     yield self.finding(
                         ctx, node,
                         f"span name {name!r} in raft_tpu/serving/ must use "
-                        f"the serving:: prefix (serving::phase naming)")
+                        f"the serving:: or capacity:: prefix "
+                        f"(serving::phase naming)")
 
         if in_bench and not ctx.rel.endswith("/progress.py"):
             for node in ast.walk(ctx.tree):

@@ -150,7 +150,17 @@ def _load_v2(stream, meta) -> Dict[str, np.ndarray]:
 
 
 def load_arrays(path_or_stream) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
-    """Load a container written by :func:`save_arrays` (v1 or v2)."""
+    """Load a container written by :func:`save_arrays` (v1 or v2).
+
+    The ``serialize.load.read`` faultpoint (round 18) sits at the
+    host-side dispatch point of every container read — index ``load()``s,
+    ``distributed/snapshot.restore_shard``, and the capacity plane's
+    snapshot-backed promotion all pass through here, so an oom/hang on
+    the tunneled runtime's load path is injectable in CPU tier-1 (the
+    saves have carried ``serialize.save.write`` since round 9)."""
+    from raft_tpu.resilience import faultpoint
+
+    faultpoint("serialize.load.read")
     own = isinstance(path_or_stream, (str, bytes, os.PathLike))
     stream = open(path_or_stream, "rb") if own else path_or_stream
     try:

@@ -698,7 +698,8 @@ def hbm_budget() -> dict:
 
 
 def check_admission(predicted, entry: str = "",
-                    budget_bytes: Optional[int] = None) -> dict:
+                    budget_bytes: Optional[int] = None,
+                    bytes_in_use: Optional[int] = None) -> dict:
     """Pre-dispatch admission verdict for a predicted footprint:
     ``predicted`` is an :func:`estimate` dict (its ``transient_bytes`` is
     the projected delta) or a plain byte count. Projects ``bytes_in_use +
@@ -712,7 +713,16 @@ def check_admission(predicted, entry: str = "",
     would dilute one hot chip's pressure by the device count and admit
     the dispatch that OOMs it. Returns the verdict record; NEVER raises
     (an admission check that throws is worse than no check — failures
-    degrade to an ``unknown``-budget ADMIT, classified)."""
+    degrade to an ``unknown``-budget ADMIT, classified).
+
+    ``bytes_in_use`` (round 18) overrides the live watermark sample —
+    the per-tenant residency budgeter projects against its own PREDICTED
+    resident ledger (deterministic, synthetic-budget friendly) instead
+    of whatever else the process happens to hold. QUEUE/REJECT records
+    carry ``shortfall_bytes`` = ``projected − soft·budget`` — the exact
+    number of bytes an eviction must free to return the projection to
+    ADMIT, so the capacity controller sizes demotions instead of
+    guessing."""
     from raft_tpu import resilience
 
     with obs.record_span("obs.costmodel::check_admission",
@@ -733,11 +743,16 @@ def check_admission(predicted, entry: str = "",
             pred_bytes = 0
         per_dev = []
         try:
-            mem = obs_memory.sample(f"admission.{entry}" if entry
-                                    else "admission")
-            in_use = int(mem["bytes_in_use"])
-            per_dev = [d for d in (mem.get("per_device") or [])
-                       if d.get("bytes_limit")]
+            if bytes_in_use is not None:
+                # the budgeter's ledger IS the watermark: no sampling, no
+                # per-device dilution — one deterministic projection
+                in_use = int(bytes_in_use)
+            else:
+                mem = obs_memory.sample(f"admission.{entry}" if entry
+                                        else "admission")
+                in_use = int(mem["bytes_in_use"])
+                per_dev = [d for d in (mem.get("per_device") or [])
+                           if d.get("bytes_limit")]
             budget = ({"bytes": int(budget_bytes), "source": "caller"}
                       if budget_bytes else hbm_budget())
         except Exception as e:
@@ -748,18 +763,22 @@ def check_admission(predicted, entry: str = "",
             in_use, budget = 0, {"bytes": 0, "source": "unknown"}
         projected = in_use + pred_bytes
         soft, hard = _frac(SOFT_ENV, 0.85), _frac(HARD_ENV, 0.97)
+        shortfall = None
         if budget["source"] == "device_stats" and per_dev:
             # worst-device projection (see docstring)
             frac = max((d["bytes_in_use"] + pred_bytes) / d["bytes_limit"]
                        for d in per_dev)
             verdict = (ADMIT if frac <= soft
                        else QUEUE if frac <= hard else REJECT)
+            shortfall = max(d["bytes_in_use"] + pred_bytes
+                            - soft * d["bytes_limit"] for d in per_dev)
         elif budget["bytes"] <= 0:
             verdict, frac = ADMIT, None
         else:
             frac = projected / budget["bytes"]
             verdict = (ADMIT if frac <= soft
                        else QUEUE if frac <= hard else REJECT)
+            shortfall = projected - soft * budget["bytes"]
         rec = {
             "verdict": verdict,
             "entry": entry,
@@ -772,6 +791,10 @@ def check_admission(predicted, entry: str = "",
                                    if frac is not None else None),
             "t": round(time.time(), 3),
         }
+        if verdict != ADMIT and shortfall is not None:
+            # the eviction size: free this many bytes and the projection
+            # is back under the soft threshold (capacity controller input)
+            rec["shortfall_bytes"] = int(np.ceil(max(0.0, shortfall)))
         if obs.enabled():
             obs.add(f"{ADMISSION_COUNTER_PREFIX}{verdict}")
             obs.set_gauge("costmodel.admission.predicted_bytes", pred_bytes)

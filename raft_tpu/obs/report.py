@@ -77,12 +77,16 @@ def _classified(fn, label: str, out_errors: dict):
         return None
 
 
-def collect(engine=None, sampler=None, queue=None,
+def collect(engine=None, sampler=None, queue=None, capacity=None,
             snapshot: Optional[dict] = None,
             extra: Optional[dict] = None) -> dict:
     """One status snapshot of the observability plane. Every section
     degrades independently (classified into ``errors``) so a broken
-    provider never costs the rest of the report."""
+    provider never costs the rest of the report. ``capacity`` (round 18)
+    is a :class:`raft_tpu.serving.CapacityController`; its per-tenant
+    section (tiers, residency bytes, verdict counts, SLO rows, promote
+    latency) rides the report and is structurally gated by
+    :func:`validate`."""
     with obs.record_span("obs.report::collect"):
         errors: dict = {}
         snap = snapshot if snapshot is not None else \
@@ -90,7 +94,9 @@ def collect(engine=None, sampler=None, queue=None,
         counters = snap.get("counters") or {}
         verdicts = {k[len(_VERDICT_PREFIX):]: v for k, v in counters.items()
                     if k.startswith(_VERDICT_PREFIX)}
-        known = {"ok", "deadline", "fatal", "oom", "transient"}
+        # "rejected" (round 18): the capacity controller's classified
+        # admission rejection is a first-class outcome, never residue
+        known = {"ok", "deadline", "fatal", "oom", "transient", "rejected"}
         out = {
             "t": round(time.time(), 3),
             "type": "obs_report",
@@ -128,6 +134,11 @@ def collect(engine=None, sampler=None, queue=None,
             "shard_health": _classified(
                 lambda: resilience.shard_health().snapshot(),
                 "shard_health", errors),
+            # capacity plane (round 18): per-tenant residency tiers +
+            # budget + verdict counts + SLO rows — the multi-tenant
+            # chaos rung's acceptance record
+            "capacity": (_classified(capacity.report, "capacity", errors)
+                         if capacity is not None else None),
             "verdicts": {
                 **verdicts,
                 "unclassified": int(sum(
@@ -243,6 +254,37 @@ def validate(report: dict,
                 problems.append(
                     f"roofline[{name}] claims bound={row['bound']!r} "
                     f"with unknown peaks")
+    # capacity plane (round 18): every tenant must sit in a known tier
+    # with sane residency accounting, and the budgeter invariant —
+    # predicted resident bytes never exceed a known budget — must hold in
+    # the snapshot. Lenient on absence (no capacity controller wired).
+    cap = report.get("capacity")
+    if isinstance(cap, dict):
+        budget = cap.get("budget_bytes")
+        resident = cap.get("resident_bytes")
+        if not (_finite(resident) and resident >= 0):
+            problems.append(
+                f"capacity.resident_bytes not finite: {resident!r}")
+        elif _finite(budget) and budget > 0 and resident > budget:
+            problems.append(
+                f"capacity budgeter overcommitted: resident "
+                f"{resident} > budget {budget}")
+        for name, row in (cap.get("tenants") or {}).items():
+            if not isinstance(row, dict):
+                problems.append(f"capacity.tenants[{name}] is not a record")
+                continue
+            if row.get("tier") not in ("hot", "warm", "cold"):
+                problems.append(
+                    f"capacity.tenants[{name}].tier invalid: "
+                    f"{row.get('tier')!r}")
+            if not (_finite(row.get("resident_bytes"))
+                    and row["resident_bytes"] >= 0):
+                problems.append(
+                    f"capacity.tenants[{name}].resident_bytes not "
+                    f"finite: {row.get('resident_bytes')!r}")
+            if not isinstance(row.get("slo"), dict):
+                problems.append(
+                    f"capacity.tenants[{name}] carries no SLO row")
     return problems
 
 

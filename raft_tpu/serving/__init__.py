@@ -39,6 +39,18 @@ from raft_tpu.neighbors import ivf_bq as _ivf_bq
 from raft_tpu.neighbors import ivf_flat as _ivf_flat
 from raft_tpu.neighbors import ivf_pq as _ivf_pq
 from raft_tpu.serving.batching import QueryQueue, RequestHandle
+from raft_tpu.serving.capacity import (
+    COLD,
+    HOT,
+    MAX_DEMOTIONS_ENV,
+    PROMOTE_DEADLINE_ENV,
+    WARM,
+    WINDOW_ENV,
+    CapacityController,
+    CapacityRejected,
+    TenantRegistry,
+    TenantResult,
+)
 from raft_tpu.serving.compaction import (
     COMPACT_DEADLINE_ENV,
     COMPACT_INTERVAL_ENV,
@@ -95,14 +107,24 @@ def scan_trace_count() -> int:
 
 
 __all__ = [
+    "COLD",
     "COMPACT_DEADLINE_ENV",
     "COMPACT_INTERVAL_ENV",
     "COMPACT_RATIO_ENV",
+    "CapacityController",
+    "CapacityRejected",
     "CompactionManager",
+    "HOT",
+    "MAX_DEMOTIONS_ENV",
     "PAGE_ROWS_ENV",
+    "PROMOTE_DEADLINE_ENV",
     "PagedListStore",
     "QueryQueue",
     "RequestHandle",
+    "TenantRegistry",
+    "TenantResult",
+    "WARM",
+    "WINDOW_ENV",
     "default_compact_deadline",
     "default_compact_ratio",
     "default_page_rows",

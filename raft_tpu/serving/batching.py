@@ -32,14 +32,21 @@ survivors are counted (``serving.queue.requeued``) and flagged on their
 dispatch span, so SLO burn-rate math over the once-per-request verdict
 counters never double-counts their first admission.
 
-**Pre-dispatch admission gauges** (round 11): with a ``cost_model`` hook
-(``obs.costmodel.paged_scan_estimator(store, k, n_probes)``), every batch
-dispatch first runs ``costmodel.check_admission`` — its predicted HBM
-footprint projected against the live watermark and budget — and the
-classified ADMIT/QUEUE/REJECT verdict lands as gauges, events and a
-dispatch-span attribute. Record-only this round: the ROADMAP item-4
-admission controller is the consumer that will act on non-admit
-verdicts. Each dispatch also runs under ``obs.compile.watch()``, so a
+**Pre-dispatch admission** (round 11, BINDING since round 18): with a
+``cost_model`` hook (``obs.costmodel.paged_scan_estimator(store, k,
+n_probes)``), every batch dispatch first runs
+``costmodel.check_admission`` — its predicted HBM footprint projected
+against the live watermark and budget — and the classified
+ADMIT/QUEUE/REJECT verdict lands as gauges, events and a dispatch-span
+attribute. With a ``capacity=`` controller
+(:class:`raft_tpu.serving.CapacityController`) the verdict is POLICY:
+ADMIT dispatches; QUEUE holds the batch (requeued at the front, a short
+hold backoff, re-checked next pump — requests past their deadline drain
+with the classified DEADLINE verdict, so a sustained squeeze can never
+hang the queue); REJECT (after the controller's own eviction attempt)
+delivers the classified ``rejected`` verdict to exactly that batch while
+the queue keeps serving. Without ``capacity`` the hook stays
+record-only. Each dispatch also runs under ``obs.compile.watch()``, so a
 mid-traffic retrace is stamped with the wall-clock it cost in the
 compile ledger.
 
@@ -164,7 +171,8 @@ class QueryQueue:
                  default_timeout_s: Optional[float] = None,
                  pressure_margin_s: float = 0.002,
                  shadow=None,
-                 cost_model: Optional[Callable] = None):
+                 cost_model: Optional[Callable] = None,
+                 capacity=None, tenant: str = ""):
         self._search_fn = search_fn
         # optional online-recall shadow sampler (obs/shadow.ShadowSampler):
         # served results are OFFERED after each successful dispatch — one
@@ -180,6 +188,18 @@ class QueryQueue:
         # (``costmodel.paged_scan_estimator(store, k, n_probes)`` builds
         # the hook for a paged store.)
         self._cost_model = cost_model
+        # round 18: with a CapacityController the verdict ACTS (see the
+        # module docstring) — QUEUE holds the batch, REJECT delivers the
+        # classified ``rejected`` verdict after the controller's eviction
+        # attempt. ``_hold_until`` is the QUEUE-hold backoff: the pump
+        # loop stops re-popping a held batch every iteration while
+        # deadline expiry keeps draining underneath it.
+        self._capacity = capacity
+        # the tenant this queue serves (optional): the controller's
+        # eviction never demotes the tenant whose dispatch it is sizing,
+        # and the verdict lands in that tenant's per-tenant counts
+        self._tenant = str(tenant)
+        self._hold_until = 0.0
         self.slo_s = float(slo_s)
         self.max_batch = int(max_batch)
         self.buckets = _buckets(self.max_batch)
@@ -259,6 +279,11 @@ class QueryQueue:
         depth = len(self._pending)
         if depth == 0:
             return False
+        if now < self._hold_until:
+            # capacity QUEUE hold: admission said wait — expired requests
+            # still drain (pump expires before it forms batches), so the
+            # hold can never become a hang
+            return False
         cap = max(1, self._batch_cap)
         if depth >= cap:
             return True
@@ -333,16 +358,33 @@ class QueryQueue:
         self._close_request_trace(req, kind)
         req.event.set()
 
-    def _requeue_front(self, reqs: List[_Request]) -> None:
+    def _finish_rejected(self, req: _Request, err: BaseException) -> None:
+        """Capacity-rejected: a FIRST-CLASS classified verdict (round 18)
+        — the admission controller refused the dispatch after its
+        eviction attempt; the device allocator never saw it (this is
+        exactly NOT an OOM)."""
+        req.verdict = "rejected"
+        req.error = err
+        req._latency_s = time.monotonic() - req.t_arrive
+        obs.add("serving.requests.rejected")
+        self._close_request_trace(req, "rejected")
+        req.event.set()
+
+    def _requeue_front(self, reqs: List[_Request], count: bool = True) -> None:
         # requeue accounting (round-10 satellite): survivors of a partial
         # deadline drain or an OOM cap-halving go back for a SECOND
         # admission — counted once here and flagged on their dispatch span,
         # so burn-rate math over the once-per-request verdict counters
-        # never sees their first admission twice
-        for req in reqs:
-            req.requeued = True
-        if obs.enabled():
-            obs.add("serving.queue.requeued", len(reqs))
+        # never sees their first admission twice. A capacity QUEUE hold
+        # (round 18) passes count=False: a held batch was never
+        # dispatched, and re-counting it every ~2ms hold cycle would
+        # inflate the once-per-request series by orders of magnitude —
+        # holds have their own counter (serving.capacity.held).
+        if count:
+            for req in reqs:
+                req.requeued = True
+            if obs.enabled():
+                obs.add("serving.queue.requeued", len(reqs))
         with self._cv:
             for req in reversed(reqs):
                 self._pending.appendleft(req)
@@ -361,10 +403,10 @@ class QueryQueue:
         budget = min(r.t_deadline for r in batch) - now
         verdict_rec = None
         if self._cost_model is not None:
-            # pre-dispatch admission gauge (round 11): predict the batch's
-            # footprint, compare against the live memory watermark, record
-            # the classified verdict — never raises, never blocks (the
-            # item-4 controller is the consumer that will act on REJECTs)
+            # pre-dispatch admission (round 11; BINDING with a capacity
+            # controller since round 18): predict the batch's footprint,
+            # check admission, record the classified verdict — never
+            # raises
             from raft_tpu.obs import costmodel
 
             try:
@@ -375,8 +417,49 @@ class QueryQueue:
                              error=repr(e)[:200])
                 predicted = None
             if predicted is not None:
-                verdict_rec = costmodel.check_admission(
-                    predicted, entry="serving.dispatch")
+                if self._capacity is not None:
+                    # the controller's verdict is final AFTER its own
+                    # eviction attempt (REJECT → demote LRU tenants →
+                    # re-check); it never raises
+                    try:
+                        verdict_rec = self._capacity.admit(
+                            predicted, entry="serving.dispatch",
+                            tenant=self._tenant)
+                    except Exception as e:
+                        record_event("serving_capacity_error",
+                                     kind=resilience.classify(e),
+                                     error=repr(e)[:200])
+                        verdict_rec = None
+                else:
+                    verdict_rec = costmodel.check_admission(
+                        predicted, entry="serving.dispatch")
+            if self._capacity is not None and verdict_rec is not None:
+                if verdict_rec["verdict"] == costmodel.QUEUE:
+                    # hold under the requests' own deadlines: requeue at
+                    # the front with a short backoff — the next pumps
+                    # re-check admission, and requests past deadline
+                    # drain classified (never a hang)
+                    if obs.enabled():
+                        obs.add("serving.capacity.held")
+                    self._hold_until = time.monotonic() + max(
+                        self.pressure_margin_s, 1e-3)
+                    self._requeue_front(batch, count=False)
+                    return
+                if verdict_rec["verdict"] == costmodel.REJECT:
+                    from raft_tpu.serving.capacity import CapacityRejected
+
+                    if obs.enabled():
+                        obs.add("serving.capacity.rejected_batches")
+                    err = CapacityRejected(
+                        f"batch of {n} rejected by admission: projected "
+                        f"{verdict_rec.get('projected_bytes')} of "
+                        f"{verdict_rec.get('budget_bytes')} bytes "
+                        f"(shortfall "
+                        f"{verdict_rec.get('shortfall_bytes')} B after "
+                        f"eviction)")
+                    for req in batch:
+                        self._finish_rejected(req, err)
+                    return
         attrs = None
         if obs.enabled():
             attrs = {"batch": n, "bucket": bucket,

@@ -202,6 +202,17 @@ def direction(metric: str) -> str:
         return "up"
     if tail == "tombstone_ratio_peak":
         return "down"
+    # capacity plane (round 18): an OOM verdict in the oversubscribed
+    # chaos rung means the admission controller failed its one job —
+    # shrinking toward good at zero tolerance; the measured hot-swap
+    # (promote) latencies are caught by the `_s` latency rule below
+    # (down), and the tier census (`tenants_resident_hot`) is a
+    # configuration-dependent observation, informational by default
+    if tail == "oom_verdicts":
+        return "down"
+    if tail in ("tenants_resident_hot", "tenants_resident_warm",
+                "tenants_cold"):
+        return "info"
     # cost-model accuracy (round 11): the predicted/measured HBM ratio is
     # best AT 1.0 — drift in either direction is the predictor degrading,
     # so the verdict compares |ratio − 1| across rounds ("one" direction);
@@ -263,6 +274,11 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "bq_build.no_refine_recall": 0.01,
     "bq_build.build_peak_predicted_bytes": 0.0,
     "bq_build.sift1b_share_peak_predicted_bytes": 0.0,
+    # capacity plane (round 18): ANY OOM verdict in the oversubscribed
+    # chaos rung is the admission controller failing — zero tolerance;
+    # unclassified residue likewise
+    "capacity.oom_verdicts": 0.0,
+    "capacity.unclassified": 0.0,
 }
 
 

@@ -142,6 +142,18 @@ echo "== roofline smoke (FLOP model oracle + utilization stamps + report, ISSUE 
 JAX_PLATFORMS=cpu python scripts/roofline_smoke.py || fail=1
 
 echo
+echo "== capacity smoke (multi-tenant admission + tiering, ISSUE 15) =="
+# 4x-oversubscribed tiny window through the ACTING admission controller:
+# zero OOM verdicts (oversubscription degrades classified — demotions,
+# warm-tier degraded serves, first-class rejections), >=1 demotion and
+# >=1 promotion observed with measured hot-swap latency, warm results
+# stamped degraded, the predicted resident ledger never over budget, the
+# QueryQueue capacity wiring delivering the classified `rejected`
+# verdict, and the per-tenant obs.report section validating through the
+# CLI.
+JAX_PLATFORMS=cpu python scripts/capacity_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

@@ -341,12 +341,51 @@ def dispatch(batch):
     with obs.record_span("ivf_flat::dispatch"):
         return batch
 """,
+        # near-miss: the serving:: prefix AND the round-18 capacity::
+        # family (the multi-tenant plane lives in serving/ with its own
+        # span dashboard) are both sanctioned
         """
 from raft_tpu import obs
 
 def dispatch(batch):
     with obs.record_span("serving::dispatch"):
         return batch
+
+def promote(name):
+    with obs.record_span("capacity::promote"):
+        return name
+""",
+    ),
+    # ISSUE 15 extension: the capacity plane's tier moves
+    # (promote/demote) are serving-path policy actions — entry points
+    # like search/upsert; an unobserved demotion is an invisible recall
+    # hit
+    (
+        "obs-coverage",
+        "raft_tpu/serving/capacity.py",
+        """
+class Controller:
+    def promote(self, name):
+        return name
+""",
+        # near-miss: span-covered tier moves + non-entry helpers
+        """
+from raft_tpu import obs
+
+class Controller:
+    def promote(self, name):
+        with obs.record_span("capacity::promote"):
+            return name
+
+    def demote(self, name):
+        with obs.record_span("capacity::demote"):
+            return name
+
+    def make_room(self, shortfall):
+        return []
+
+    def report(self):
+        return {}
 """,
     ),
     # ISSUE 10 extension: the obs plane's own entry points (slo.py /

@@ -232,3 +232,58 @@ def test_build_fast_path_improvements_not_regressions(tmp_path):
                 for line in proc.stdout.splitlines()
                 if line.startswith("| `")]
     assert verdicts and "regression" not in verdicts, proc.stdout
+
+
+def _verdict_rows(stdout):
+    rows = {}
+    for line in stdout.splitlines():
+        if line.startswith("| `"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            rows[cells[0].strip("`")] = cells[-1]
+    return rows
+
+
+def test_capacity_plane_direction_rules(tmp_path):
+    """Round 18 (ISSUE 15 satellite): `oom_verdicts` gates DOWNWARD at
+    zero tolerance (one OOM in the oversubscribed rung is the admission
+    controller failing), `promote_p50_s` gates downward via the latency
+    rule, and the tier census (`tenants_resident_hot`) is informational
+    — a config observation, never a verdict."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"capacity": {"oom_verdicts": 0,
+                                   "promote_p50_s": 0.010,
+                                   "tenants_resident_hot": 4,
+                                   "unclassified": 0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"capacity": {"oom_verdicts": 1,
+                                   "promote_p50_s": 0.050,
+                                   "tenants_resident_hot": 1,
+                                   "unclassified": 2}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    # zero tolerance: the 0 -> 1 transition must be a regression row
+    assert rows["capacity.oom_verdicts"] == "regression"
+    assert rows["capacity.unclassified"] == "regression"
+    assert rows["capacity.promote_p50_s"] == "regression"
+    assert rows["capacity.tenants_resident_hot"] == "·"
+
+
+def test_capacity_plane_improvements_not_regressions(tmp_path):
+    """Both polarities pinned: the same capacity metrics moving the GOOD
+    way must never render as regressions."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"capacity": {"oom_verdicts": 3,
+                                   "promote_p50_s": 0.050,
+                                   "tenants_resident_hot": 1}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"capacity": {"oom_verdicts": 0,
+                                   "promote_p50_s": 0.010,
+                                   "tenants_resident_hot": 6}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["capacity.oom_verdicts"] == "improved"
+    assert rows["capacity.promote_p50_s"] == "improved"
+    assert rows["capacity.tenants_resident_hot"] == "·"
+    assert "regression" not in rows.values(), proc.stdout

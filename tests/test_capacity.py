@@ -1,0 +1,685 @@
+"""Multi-tenant capacity plane (ISSUE 15): acting admission, tiered
+residency, snapshot-backed promotion.
+
+Tier-1 contracts:
+
+* registry accounting is EXACT — per-tenant predicted residency equals
+  ``obs.memory.index_bytes`` of the resident artifacts, tier by tier;
+* the budgeter invariant: predicted resident bytes NEVER exceed the
+  budget, across registration, serving, demotion and promotion
+  (property-tested over random tenant sizes and access traces);
+* verdicts are binding — REJECT sizes an eviction from the verdict's
+  ``shortfall_bytes`` and demotes least-recently-served tenants first,
+  bounded per window (no demote/promote livelock under alternating
+  pressure);
+* warm-tier results ALWAYS carry ``degraded=True`` with ids translated
+  back to the tenant's own id space;
+* promotion restores the snapshot bit-identically with measured latency,
+  and an armed ``serving.capacity.promote`` / ``serialize.load.read``
+  oom/hang lands classified with the tenant left in its prior tier
+  (round-7 standing gate);
+* the ``QueryQueue(capacity=...)`` wiring turns the round-11 record-only
+  hook into policy: QUEUE holds under the request deadline (expiry →
+  classified DEADLINE, never a hang), REJECT delivers the classified
+  ``rejected`` verdict, and ``obs.report`` counts it as known residue.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, resilience, serving
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.obs import costmodel
+from raft_tpu.obs import memory as obs_memory
+from raft_tpu.obs import report as obs_report
+from raft_tpu.serving import capacity as cap
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+@pytest.fixture
+def telemetry():
+    obs.reset()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def _make_index(seed: int, n: int = 700, dim: int = 16):
+    r = np.random.default_rng(seed)
+    X = r.standard_normal((n, dim)).astype(np.float32)
+    return X, ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8,
+                                                       list_size_cap=0))
+
+
+@pytest.fixture(scope="module")
+def plane(tmp_path_factory):
+    """Four small tenants with PRE-BUILT warm twins (built once; each
+    test registers them into its own controller — registration then only
+    predicts layouts and writes snapshots)."""
+    snap = str(tmp_path_factory.mktemp("capacity_snap"))
+    tenants = {}
+    for i in range(4):
+        X, idx = _make_index(seed=i, n=600 + 100 * i)
+        warm, wids = cap._warm_twin(idx)
+        tenants[f"t{i}"] = (X, idx, warm, wids)
+    return snap, tenants
+
+
+def _controller(plane, budget, names=None, warm=True, **kw):
+    snap, tenants = plane
+    ctrl = cap.CapacityController(budget_bytes=budget, **kw)
+    for name in (names or sorted(tenants)):
+        _, idx, wi, wids = tenants[name]
+        ctrl.register(name, idx, snap,
+                      warm_index=wi if warm else None,
+                      warm_ids=wids if warm else None, warm=warm)
+    return ctrl
+
+
+def _full_bytes(plane, name):
+    """hot + warm predicted bytes of one prepared tenant."""
+    _, idx, warm, _ = plane[1][name]
+    return (costmodel.predict_index_bytes(**costmodel.index_layout(idx))
+            + costmodel.predict_index_bytes(**costmodel.index_layout(warm)))
+
+
+def _roomy_budget(plane, n_full=4, headroom=1 << 20):
+    """A budget that holds n_full tenants fully resident plus dispatch
+    transients (the tiny-config transients are large relative to the
+    tiny indexes — real chips have the opposite ratio)."""
+    total = sum(_full_bytes(plane, n) for n in sorted(plane[1])[:n_full])
+    return int(total / 0.85) + headroom
+
+
+# ---------------------------------------------------------------------------
+# registry + accounting
+# ---------------------------------------------------------------------------
+
+
+def test_registry_accounting_exact(plane):
+    """Predicted per-tier residency equals obs.memory.index_bytes of the
+    actual resident artifacts — the budgeter's ledger is the cost model's
+    exactness property applied per tenant."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane))
+    total = 0
+    for name in ctrl.registry.names():
+        t = ctrl.registry.get(name)
+        assert t.tier == cap.HOT
+        assert t.hot_bytes == obs_memory.index_bytes(t.hot_obj)
+        assert t.warm_bytes == obs_memory.index_bytes(t.warm_index)
+        assert t.resident_bytes() == t.hot_bytes + t.warm_bytes
+        total += t.resident_bytes()
+    assert ctrl.registry.resident_bytes() == total
+
+
+def test_register_places_tier_by_budget(plane):
+    """A registry growing past its budget degrades tier by tier at
+    registration instead of overcommitting."""
+    one_full = _full_bytes(plane, "t0")
+    ctrl = _controller(plane, budget=int(one_full * 1.1))
+    tiers = [ctrl.registry.get(n).tier for n in ctrl.registry.names()]
+    assert cap.HOT in tiers or cap.WARM in tiers
+    assert tiers.count(cap.HOT) <= 1
+    assert ctrl.registry.resident_bytes() <= ctrl.budget_bytes
+
+
+def test_duplicate_register_rejected(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"])
+    _, idx, _, _ = plane[1]["t0"]
+    with pytest.raises(ValueError, match="already registered"):
+        ctrl.register("t0", idx, plane[0])
+
+
+def test_unknown_tenant_named(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"])
+    with pytest.raises(KeyError, match="unknown tenant"):
+        ctrl.search("nope", np.zeros((1, 16), np.float32), 5)
+
+
+# ---------------------------------------------------------------------------
+# serving tiers
+# ---------------------------------------------------------------------------
+
+
+def test_hot_serve_exact_parity(plane, rng):
+    """An admitted HOT dispatch is the family search, bit-identical."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"])
+    _, idx, _, _ = plane[1]["t0"]
+    Q = rng.standard_normal((6, 16)).astype(np.float32)
+    res = ctrl.search("t0", Q, 5, n_probes=8)
+    assert res.tier == cap.HOT and not res.degraded
+    ref_v, ref_i = ivf_flat.search(idx, Q, 5, n_probes=8)
+    np.testing.assert_array_equal(np.asarray(res.indices),
+                                  np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(res.distances),
+                                  np.asarray(ref_v))
+
+
+def test_warm_serve_degraded_with_translated_ids(plane, rng):
+    """Warm-tier results ALWAYS carry degraded=True, and their ids live
+    in the tenant's own id space (the warm twin's positions are
+    translated through the warm_ids map)."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t1"])
+    X, idx, _, _ = plane[1]["t1"]
+    ctrl.demote("t1")
+    assert ctrl.registry.get("t1").tier == cap.WARM
+    Q = X[:8] + 0.01 * rng.standard_normal((8, 16)).astype(np.float32)
+    res = ctrl.search("t1", Q, 5, n_probes=32)
+    assert res.degraded and res.tier == cap.WARM
+    ids = np.asarray(res.indices)
+    live = ids[ids >= 0]
+    assert live.size and live.max() < X.shape[0]
+    # near-duplicate queries: the BQ codes at full probe width should
+    # place the true row in the top-5 for most queries
+    hits = sum(1 for i in range(8) if i in ids[i])
+    assert hits >= 5, ids
+
+
+def test_cold_query_pages_warm_back_in(plane, rng):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t2"])
+    ctrl.demote("t2")
+    ctrl.demote("t2")
+    t = ctrl.registry.get("t2")
+    assert t.tier == cap.COLD and t.resident_bytes() == 0
+    Q = rng.standard_normal((4, 16)).astype(np.float32)
+    res = ctrl.search("t2", Q, 5, n_probes=8)
+    assert res.degraded and res.tier == cap.WARM
+    assert t.tier == cap.WARM and t.warm_index is not None
+    assert ctrl.registry.resident_bytes() <= ctrl.budget_bytes
+
+
+def test_no_warm_tenant_rejects_classified(plane, rng, telemetry):
+    """A tenant without warm codes holds nothing non-HOT: serving it is a
+    classified first-class rejection, never a hang or an OOM."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"],
+                      warm=False)
+    ctrl.demote("t0")
+    assert ctrl.registry.get("t0").tier == cap.COLD
+    with pytest.raises(cap.CapacityRejected):
+        ctrl.search("t0", rng.standard_normal((2, 16)).astype(np.float32),
+                    5, n_probes=8)
+    assert ctrl.report()["rejections"] == 1
+    assert resilience.classify(cap.CapacityRejected("x")) == resilience.FATAL
+
+
+def test_hot_pressure_serves_warm_degraded(plane, rng):
+    """QUEUE/REJECT pressure on a HOT tenant's exact dispatch degrades to
+    the always-resident warm codes instead of refusing — availability
+    survives the squeeze, classified."""
+    full = _full_bytes(plane, "t0")
+    # budget fits the tenant (under the soft threshold) but NOT the
+    # dispatch transient on top
+    ctrl = _controller(plane, budget=int(full / 0.8), names=["t0"])
+    assert ctrl.registry.get("t0").tier == cap.HOT
+    res = ctrl.search("t0", rng.standard_normal((8, 16)).astype(np.float32),
+                      5, n_probes=8)
+    assert res.degraded and res.tier == cap.WARM
+    assert ctrl.report()["queued_degraded"] >= 1
+    # the tenant itself was never evicted
+    assert ctrl.registry.get("t0").tier == cap.HOT
+
+
+# ---------------------------------------------------------------------------
+# eviction: shortfall sizing, LRU order, window bound
+# ---------------------------------------------------------------------------
+
+
+def test_reject_evicts_shortfall_lru_first(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane, n_full=4))
+    # t3 most recently served; t0 least
+    for name in ("t0", "t1", "t2", "t3"):
+        ctrl.registry.touch(name)
+        time.sleep(0.002)
+    resident0 = ctrl.registry.resident_bytes()
+    # ask for almost the whole budget: forces a REJECT and an eviction
+    ask = int(ctrl.budget_bytes * 0.85) - resident0 + 2 * _full_bytes(
+        plane, "t0")
+    rec = ctrl.admit(ask, entry="test.evict", tenant="t3")
+    assert rec.get("demoted"), rec
+    # least-recently-served demoted first; the requesting tenant never
+    assert rec["demoted"][0] == "t0"
+    assert "t3" not in rec["demoted"]
+    freed = resident0 - ctrl.registry.resident_bytes()
+    assert freed > 0
+    # eviction was SIZED: it freed at least the original shortfall or
+    # ran out of candidates trying
+    assert rec["verdict"] in (costmodel.ADMIT, costmodel.QUEUE,
+                              costmodel.REJECT)
+
+
+def test_shortfall_drives_exact_recheck(plane):
+    """After a sized eviction the re-checked projection is back under
+    the soft threshold whenever enough bytes existed to free."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane, n_full=4))
+    resident0 = ctrl.registry.resident_bytes()
+    soft = 0.85 * ctrl.budget_bytes
+    # an ask just past the HARD threshold: REJECT, whose shortfall
+    # (projected − soft·budget) the eviction must free to reach ADMIT
+    ask = int(0.97 * ctrl.budget_bytes - resident0) + 1000
+    rec = ctrl.admit(ask, entry="test.sized", tenant="t3")
+    assert rec.get("demoted"), rec
+    assert rec["verdict"] == costmodel.ADMIT, rec
+    assert ctrl.registry.resident_bytes() + ask <= soft + 1
+
+
+def test_demotion_window_bound_no_livelock(plane):
+    """Alternating pressure cannot thrash: demotions are bounded per
+    window, the limiter is a classified event, and the loop terminates
+    fast."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane),
+                       max_demotions=2, window_s=60.0)
+    resilience.clear_events()
+    t0 = time.monotonic()
+    for _ in range(10):
+        # far more than the registry can ever free: every call wants an
+        # eviction
+        ctrl.admit(ctrl.budget_bytes * 4, entry="test.pressure")
+    wall = time.monotonic() - t0
+    assert wall < 10.0
+    assert ctrl.report()["demotions"] <= 2
+    events = [e for e in resilience.recent_events()
+              if e.get("event") == "capacity_demotion_limited"]
+    assert events, "window limiter never classified"
+
+
+def test_alternating_promote_pressure_bounded(plane):
+    """promote(A)/promote(B) under a budget that fits only one: the
+    window bound keeps the registry from livelocking into demote/promote
+    thrash — denied promotions are explicit records, not spins."""
+    one = _full_bytes(plane, "t0")
+    ctrl = _controller(plane, budget=int(one * 1.3), names=["t0", "t1"],
+                       max_demotions=3, window_s=60.0)
+    t0 = time.monotonic()
+    outcomes = []
+    for _ in range(6):
+        outcomes.append(ctrl.promote("t0").get("status"))
+        outcomes.append(ctrl.promote("t1").get("status"))
+    assert time.monotonic() - t0 < 20.0
+    assert ctrl.report()["demotions"] <= 3
+    assert all(s in ("ok", "denied", "noop") for s in outcomes), outcomes
+
+
+# ---------------------------------------------------------------------------
+# promotion: measured hot swap, fault recovery
+# ---------------------------------------------------------------------------
+
+
+def test_promote_restores_bit_identical_with_latency(plane, rng):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t1"])
+    _, idx, _, _ = plane[1]["t1"]
+    Q = rng.standard_normal((5, 16)).astype(np.float32)
+    ref_v, ref_i = ivf_flat.search(idx, Q, 5, n_probes=8)
+    ctrl.demote("t1")
+    ctrl.demote("t1")
+    rec = ctrl.promote("t1")
+    assert rec["status"] == "ok" and rec["promote_s"] > 0
+    assert ctrl.registry.get("t1").tier == cap.HOT
+    res = ctrl.search("t1", Q, 5, n_probes=8)
+    assert not res.degraded
+    np.testing.assert_array_equal(np.asarray(res.indices),
+                                  np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(res.distances),
+                                  np.asarray(ref_v))
+    lat = ctrl.promote_latency()
+    assert lat["count"] >= 1 and lat["p50_s"] > 0
+
+
+@pytest.mark.parametrize("fault,kind", [
+    ("serving.capacity.promote=oom:1", resilience.OOM),
+    ("serialize.load.read=oom:1", resilience.OOM),
+    ("serving.capacity.promote=fatal:1", resilience.FATAL),
+])
+def test_promote_fault_classified_tier_unchanged(plane, fault, kind):
+    """Round-7 gate on the promotion/load path: an armed oom/fatal at
+    either the promote site or the container read lands as a classified
+    verdict and the tenant stays in its prior tier."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t2"])
+    ctrl.demote("t2")
+    resilience.arm_faults(fault)
+    rec = ctrl.promote("t2")
+    assert rec["status"] == "error" and rec["kind"] == kind, rec
+    assert ctrl.registry.get("t2").tier == cap.WARM
+    resilience.clear_faults()
+    assert ctrl.promote("t2")["status"] == "ok"
+
+
+def test_promote_hang_bounded_by_deadline(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"],
+                       promote_deadline_s=0.3)
+    ctrl.demote("t0")
+    resilience.arm_faults("serving.capacity.promote=hang:1:30")
+    t0 = time.monotonic()
+    rec = ctrl.promote("t0")
+    assert time.monotonic() - t0 < 10.0
+    assert rec["status"] == "error" and rec["kind"] == resilience.DEADLINE
+    assert ctrl.registry.get("t0").tier == cap.WARM
+
+
+def test_cold_reload_fault_leaves_tenant_cold(plane, rng):
+    """The round-18 satellite: serialize.load.read armed on the warm
+    reload path — the query fails classified and the tenant is left in
+    its prior (COLD) tier, ready for a clean retry."""
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t3"])
+    ctrl.demote("t3")
+    ctrl.demote("t3")
+    assert ctrl.registry.get("t3").tier == cap.COLD
+    resilience.arm_faults("serialize.load.read=oom:1")
+    Q = rng.standard_normal((2, 16)).astype(np.float32)
+    with pytest.raises(Exception) as exc_info:
+        ctrl.search("t3", Q, 5, n_probes=8)
+    assert resilience.classify(exc_info.value) == resilience.OOM
+    assert ctrl.registry.get("t3").tier == cap.COLD
+    resilience.clear_faults()
+    res = ctrl.search("t3", Q, 5, n_probes=8)  # clean retry succeeds
+    assert res.degraded and ctrl.registry.get("t3").tier == cap.WARM
+
+
+def test_autopromote_skips_recently_demoted(plane, rng):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"],
+                       window_s=60.0)
+    ctrl.search("t0", rng.standard_normal((2, 16)).astype(np.float32), 5,
+                n_probes=8)
+    ctrl.demote("t0")   # just demoted: inside the anti-thrash window
+    assert ctrl.autopromote(1) == []
+    ctrl.registry.get("t0").last_demoted = time.monotonic() - 120.0
+    promoted = ctrl.autopromote(1)
+    assert [p["tenant"] for p in promoted] == ["t0"]
+
+
+# ---------------------------------------------------------------------------
+# budgeter convergence (satellite property test)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_budgeter_property_resident_never_exceeds_budget(
+        tmp_path, seed):
+    """Random tenant sizes + a random access/promote/demote trace: the
+    predicted resident ledger NEVER exceeds the budget, and every
+    warm-tier result carries degraded."""
+    r = np.random.default_rng(seed)
+    tenants = {}
+    for i in range(3):
+        n = int(r.integers(300, 700))
+        X, idx = _make_index(seed=100 + 10 * seed + i, n=n)
+        tenants[f"p{i}"] = (X, idx)
+    full = {}
+    reg = cap.TenantRegistry()
+    probe = cap.CapacityController(registry=reg, budget_bytes=1 << 40)
+    for name, (_, idx) in tenants.items():
+        probe.register(name, idx, tmp_path / "probe")
+        full[name] = reg.get(name).resident_bytes()
+    # budget between one and all tenants fully resident (oversubscribed)
+    lo, hi = max(full.values()), sum(full.values())
+    budget = int(lo * 1.2 + r.random() * (hi - lo))
+    ctrl = cap.CapacityController(budget_bytes=budget, window_s=0.05)
+    for name, (_, idx) in tenants.items():
+        t = reg.get(name)
+        ctrl.register(name, idx, tmp_path / "real",
+                      warm_index=t.warm_index, warm_ids=t.warm_ids)
+        assert ctrl.registry.resident_bytes() <= budget
+    names = sorted(tenants)
+    for step in range(40):
+        op = r.integers(0, 4)
+        name = names[int(r.integers(0, len(names)))]
+        try:
+            if op <= 1:
+                Q = r.standard_normal((2, 16)).astype(np.float32)
+                res = ctrl.search(name, Q, 5, n_probes=4)
+                if res.tier == cap.WARM:
+                    assert res.degraded
+            elif op == 2:
+                ctrl.promote(name)
+            else:
+                ctrl.demote(name)
+        except cap.CapacityRejected:
+            pass
+        assert ctrl.registry.resident_bytes() <= budget, \
+            f"step {step}: ledger {ctrl.registry.resident_bytes()} > " \
+            f"budget {budget}"
+
+
+# ---------------------------------------------------------------------------
+# check_admission satellite: shortfall + bytes_in_use override
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionShortfall:
+    """Verdict table for the round-18 check_admission satellite: the
+    bytes_in_use override and the shortfall_bytes sizing field."""
+
+    @pytest.fixture(autouse=True)
+    def _defaults(self, monkeypatch):
+        monkeypatch.delenv(costmodel.SOFT_ENV, raising=False)
+        monkeypatch.delenv(costmodel.HARD_ENV, raising=False)
+        monkeypatch.delenv(costmodel.HBM_ENV, raising=False)
+
+    def test_verdict_table_with_shortfall(self):
+        budget = 1000  # soft 850, hard 970
+        for pred, in_use, verdict, shortfall in [
+                (800, 0, costmodel.ADMIT, None),
+                (850, 0, costmodel.ADMIT, None),
+                (900, 0, costmodel.QUEUE, 50),
+                (960, 0, costmodel.QUEUE, 110),
+                (2000, 0, costmodel.REJECT, 1150),
+                (450, 500, costmodel.QUEUE, 100),
+                (600, 500, costmodel.REJECT, 250),
+        ]:
+            rec = costmodel.check_admission(
+                pred, entry="t", budget_bytes=budget, bytes_in_use=in_use)
+            assert rec["verdict"] == verdict, rec
+            assert rec.get("shortfall_bytes") == shortfall, rec
+
+    def test_bytes_in_use_override_skips_sampling(self):
+        rec = costmodel.check_admission(10, entry="t", budget_bytes=1000,
+                                        bytes_in_use=123)
+        assert rec["bytes_in_use"] == 123
+        assert rec["projected_bytes"] == 133
+
+    def test_admit_record_carries_no_shortfall(self):
+        rec = costmodel.check_admission(1, entry="t", budget_bytes=1000,
+                                        bytes_in_use=0)
+        assert rec["verdict"] == costmodel.ADMIT
+        assert "shortfall_bytes" not in rec
+
+
+# ---------------------------------------------------------------------------
+# QueryQueue wiring: the cost_model hook as policy
+# ---------------------------------------------------------------------------
+
+
+def test_queue_reject_delivers_classified_rejected(plane, rng, telemetry):
+    _, idx, _, _ = plane[1]["t0"]
+    hot = costmodel.predict_index_bytes(**costmodel.index_layout(idx))
+    ctrl = cap.CapacityController(budget_bytes=int(hot * 1.3))
+    ctrl.register("solo", idx, plane[0], warm=False)
+    assert ctrl.registry.get("solo").tier == cap.HOT
+    queue = serving.QueryQueue(
+        lambda q: ivf_flat.search(idx, q, 5, n_probes=8),
+        slo_s=0.2, max_batch=8,
+        cost_model=ctrl.cost_model_for("solo", 5, 8),
+        capacity=ctrl, tenant="solo")
+    handles = [queue.submit(rng.standard_normal(16), timeout_s=5.0)
+               for _ in range(5)]
+    t_end = time.monotonic() + 20
+    while queue.depth and time.monotonic() < t_end:
+        queue.pump()
+    assert [h.verdict for h in handles] == ["rejected"] * 5
+    # the queue's own tenant is never evicted by its own admission
+    assert ctrl.registry.get("solo").tier == cap.HOT
+    with pytest.raises(cap.CapacityRejected):
+        handles[0].result()
+    rep = obs_report.collect(queue=queue, capacity=ctrl)
+    assert rep["verdicts"]["rejected"] == 5
+    assert rep["verdicts"]["unclassified"] == 0
+    assert ctrl.registry.get("solo").verdicts.get("reject", 0) >= 1
+
+
+def test_queue_hold_expires_classified_never_hangs(plane, rng, telemetry):
+    """A sustained QUEUE squeeze holds batches (no dispatch) until the
+    per-request deadline drains them classified — bounded wall-clock."""
+    _, idx, _, _ = plane[1]["t0"]
+    hot = costmodel.predict_index_bytes(**costmodel.index_layout(idx))
+    est = costmodel.estimate_search(idx, q=1, k=5,
+                                    n_probes=8)["transient_bytes"]
+    ctrl = cap.CapacityController(budget_bytes=int((hot + est) / 0.90))
+    ctrl.register("solo", idx, plane[0], warm=False)
+    assert ctrl.registry.get("solo").tier == cap.HOT
+    queue = serving.QueryQueue(
+        lambda q: ivf_flat.search(idx, q, 5, n_probes=8),
+        slo_s=0.05, max_batch=1,
+        cost_model=ctrl.cost_model_for("solo", 5, 8),
+        capacity=ctrl, tenant="solo")
+    h = queue.submit(rng.standard_normal(16), timeout_s=0.2)
+    t0 = time.monotonic()
+    while not h.done() and time.monotonic() - t0 < 10:
+        queue.pump()
+        time.sleep(0.002)
+    assert h.verdict == resilience.DEADLINE
+    assert time.monotonic() - t0 < 5.0
+    counters = obs.snapshot()["counters"]
+    assert counters.get("serving.capacity.held", 0) >= 1
+    assert counters.get("serving.requests.deadline", 0) >= 1
+
+
+def test_queue_without_capacity_stays_record_only(plane, rng, telemetry):
+    """Backward compatibility: the round-11 record-only behavior is
+    unchanged when no controller is wired — a REJECT-grade prediction
+    still dispatches."""
+    _, idx, _, _ = plane[1]["t0"]
+    queue = serving.QueryQueue(
+        lambda q: ivf_flat.search(idx, q, 5, n_probes=8),
+        slo_s=0.5, max_batch=4,
+        cost_model=lambda b: 1 << 50)  # astronomically over any budget
+    h = queue.submit(rng.standard_normal(16), timeout_s=10.0)
+    t_end = time.monotonic() + 20
+    while not h.done() and time.monotonic() < t_end:
+        queue.pump()
+    assert h.verdict == "ok"
+
+
+# ---------------------------------------------------------------------------
+# report section
+# ---------------------------------------------------------------------------
+
+
+def test_report_capacity_section_validates(plane, rng, telemetry):
+    ctrl = _controller(plane, budget=_roomy_budget(plane))
+    Q = rng.standard_normal((3, 16)).astype(np.float32)
+    ctrl.search("t0", Q, 5, n_probes=8)
+    ctrl.demote("t1")
+    ctrl.search("t1", Q, 5, n_probes=8)
+    rep = obs_report.collect(capacity=ctrl)
+    sec = rep["capacity"]
+    assert sec["budget_bytes"] == ctrl.budget_bytes
+    assert sec["resident_bytes"] <= sec["budget_bytes"]
+    assert sec["tenants_resident_hot"] >= 1
+    for name, row in sec["tenants"].items():
+        assert row["tier"] in (cap.HOT, cap.WARM, cap.COLD)
+        assert row["resident_bytes"] >= 0
+        assert isinstance(row["slo"], dict)
+    t1 = sec["tenants"]["t1"]
+    assert t1["slo"]["degraded"] >= 1 and "p50_ms" in t1["slo"]
+    assert not [p for p in obs_report.validate(rep) if "capacity" in p]
+
+
+def test_report_flags_overcommit_and_bad_tier(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t0"])
+    rep = obs_report.collect(capacity=ctrl)
+    rep["capacity"]["resident_bytes"] = rep["capacity"]["budget_bytes"] + 1
+    assert any("overcommitted" in p for p in obs_report.validate(rep))
+    rep2 = obs_report.collect(capacity=ctrl)
+    rep2["capacity"]["tenants"]["t0"]["tier"] = "lukewarm"
+    assert any("tier invalid" in p for p in obs_report.validate(rep2))
+
+
+def test_cost_model_for_follows_tier(plane):
+    ctrl = _controller(plane, budget=_roomy_budget(plane), names=["t2"])
+    hook = ctrl.cost_model_for("t2", 5, 8)
+    hot_est = hook(4)
+    assert hot_est["transient_bytes"] > 0
+    assert hot_est["entry"] == "ivf_flat.search"
+    ctrl.demote("t2")
+    warm_est = hook(4)
+    assert warm_est["transient_bytes"] > 0
+    assert warm_est["entry"] == "ivf_bq.search"  # priced at the warm twin
+    ctrl.demote("t2")
+    assert hook(4)["transient_bytes"] == 0  # cold: nothing resident
+
+
+# ---------------------------------------------------------------------------
+# load-path faultpoint (round-18 satellite; save has had one since r09)
+# ---------------------------------------------------------------------------
+
+
+def test_serialize_load_faultpoint_fires(tmp_path, rng):
+    from raft_tpu.core.serialize import load_arrays, save_arrays
+
+    path = tmp_path / "c.raft"
+    save_arrays(path, {"kind": "t"}, {"a": np.arange(4)})
+    resilience.arm_faults("serialize.load.read=oom:1")
+    with pytest.raises(resilience.FaultInjected) as exc_info:
+        load_arrays(path)
+    assert resilience.classify(exc_info.value) == resilience.OOM
+    resilience.clear_faults()
+    meta, arrays = load_arrays(path)  # disarmed: clean read
+    np.testing.assert_array_equal(arrays["a"], np.arange(4))
+
+
+def test_index_load_routes_through_load_faultpoint(tmp_path, plane):
+    _, idx, _, _ = plane[1]["t0"]
+    path = tmp_path / "idx.raft"
+    idx.save(path)
+    resilience.arm_faults("serialize.load.read=fatal:1")
+    with pytest.raises(resilience.FaultInjected):
+        ivf_flat.IvfFlatIndex.load(path)
+    resilience.clear_faults()
+
+
+def test_paged_store_tenant_ledger_repredicted_on_promote(tmp_path, rng):
+    """A paged-store tenant promotes to its COMPACTED packed snapshot —
+    the ledger must re-predict hot_bytes for the object actually
+    resident, or every later admission projects a stale footprint."""
+    X, idx = _make_index(seed=42, n=600)
+    store = serving.PagedListStore.from_index(idx, page_rows=64)
+    Q = rng.standard_normal((3, 16)).astype(np.float32)
+    # warm: the lazy device table/chain mirrors materialize on the first
+    # scan — the prediction counts them (capacity-padded layout)
+    serving.search(store, Q, 5, n_probes=8)
+    ctrl = cap.CapacityController(budget_bytes=1 << 40)
+    t = ctrl.register("store", store, tmp_path)
+    assert t.kind == "paged_store"
+    assert t.hot_bytes == obs_memory.index_bytes(store)
+    hot_res = ctrl.search("store", Q, 5, n_probes=8)
+    assert not hot_res.degraded
+    ctrl.demote("store")
+    assert ctrl.promote("store")["status"] == "ok"
+    # the resident object is now the packed index; the ledger follows it
+    assert t.hot_bytes == obs_memory.index_bytes(t.hot_obj)
+    assert t.resident_bytes() == t.hot_bytes + t.warm_bytes
+    res = ctrl.search("store", Q, 5, n_probes=8)
+    assert not res.degraded and res.tier == cap.HOT
+
+
+def test_pq_tenant_without_raw_rows_demotes_to_cold(tmp_path, rng):
+    """ivf_pq keeps no raw rows: its tenant gets no warm twin and
+    demotes HOT→COLD directly — a documented tier table edge."""
+    X = rng.standard_normal((600, 16)).astype(np.float32)
+    idx = ivf_pq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8,
+                                             list_size_cap=0))
+    ctrl = cap.CapacityController(budget_bytes=1 << 40)
+    t = ctrl.register("pq", idx, tmp_path)
+    assert t.tier == cap.HOT and t.warm_index is None
+    ctrl.demote("pq")
+    assert t.tier == cap.COLD

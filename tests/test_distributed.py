@@ -641,6 +641,31 @@ class TestShardedSnapshot:
         with pytest.raises(ValueError, match="world"):
             snapshot.load(d, comms=Comms(local_mesh(4)))
 
+    def test_restore_shard_load_faultpoint(self, rng, comms, tmp_path,
+                                           clean_resilience):
+        """Round-18 satellite: the restore path rides the new
+        ``serialize.load.read`` faultpoint — an armed oom on the shard
+        reload lands classified (OOM), the index is untouched, and the
+        disarmed retry restores bit-identically."""
+        from raft_tpu.distributed import snapshot
+
+        X, Q = _data(rng)
+        idx = dbf.build(X, comms=comms)
+        full = dbf.search(idx, Q, 10)
+        d = str(tmp_path / "snap")
+        snapshot.save(idx, d)
+        # count=2: the manifest read is plain json; the first container
+        # read (common or shard file) fires
+        resilience.arm_faults("serialize.load.read=oom:1")
+        with pytest.raises(resilience.FaultInjected) as exc_info:
+            snapshot.restore_shard(idx, d, 0)
+        assert resilience.classify(exc_info.value) == resilience.OOM
+        resilience.clear_faults()
+        idx2 = snapshot.restore_shard(idx, d, 0)
+        healed = dbf.search(idx2, Q, 10)
+        np.testing.assert_array_equal(np.asarray(healed.indices),
+                                      np.asarray(full.indices))
+
 
 class TestDistributedBalancedKMeans:
     """Round 17: the distributed coarse trainer (shard-mapped assign +
