@@ -1256,6 +1256,7 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
     from raft_tpu.bench import progress as prog
     from raft_tpu.obs import compile as obs_compile
     from raft_tpu.obs import costmodel as obs_costmodel
+    from raft_tpu.obs import flight as obs_flight
     from raft_tpu.obs import memory as obs_memory
     from raft_tpu.obs import report as obs_report
     from raft_tpu.obs import roofline as obs_roofline
@@ -1370,6 +1371,32 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
 
     last_queue = [None]  # most recent window's queue (report depth source)
 
+    # --- flight recorder (ISSUE 16): continuous operating-point windows
+    # over the serving traffic, streamed crash-safe; the knob vector is a
+    # CALLABLE so each load window's live queue (its batch cap in
+    # particular) keys its own fingerprint group on the frontier
+    flight_path = os.path.join("results", "flight_streaming.jsonl")
+    prog.truncate(flight_path)  # fresh recording per run
+    st0 = store.stats()
+
+    def _flight_knobs():
+        knobs = {"algo": store.kind, "scan": "paged",
+                 "nprobe": int(nprobe), "k": int(k),
+                 "page_rows": st0.get("page_rows"),
+                 "n_lists": st0.get("n_lists")}
+        if last_queue[0] is not None:
+            knobs.update(last_queue[0].knobs())
+        return knobs
+
+    raw_iv = os.environ.get(obs_flight.INTERVAL_ENV, "").strip()
+    flight = obs_flight.FlightRecorder(
+        flight_path, knobs=_flight_knobs, engine=engine, sampler=sampler,
+        queue=lambda: last_queue[0], probe_health=True,
+        interval_s=None if raw_iv else (0.2 if tiny else 0.5))
+    # window 0 — the opening device-health verdict — pays its subprocess
+    # probe HERE, off every measured clock
+    flight.sample()
+
     def run_load(rate: float, batch_cap: int, with_upserts: bool,
                  shadow=None) -> dict:
         """One Poisson window: submit at ``rate`` req/s with mixed
@@ -1395,6 +1422,7 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
         i = 0
         t0 = time.perf_counter()
         while i < n_req:
+            flight.maybe_sample()  # one branch + clock read off-interval
             now = time.perf_counter() - t0
             if now >= arrivals[i]:
                 handles.append(queue.submit(q_pool[i % len(q_pool)],
@@ -1407,6 +1435,9 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
             if not queue.pump():
                 time.sleep(min(arrivals[i] - now, 2e-4))
         queue.drain(timeout=120.0)
+        # close the window on THIS load's fingerprint while its queue is
+        # still the live knob source (≥ one window per offered load)
+        flight.sample()
         wall = time.perf_counter() - t0
         ok_lats = [h.latency_s for h in handles if h.verdict == "ok"]
         n_ok = len(ok_lats)
@@ -1638,6 +1669,21 @@ def _serving_streaming(index, queries, k: int, nprobe: int, tiny: bool,
         engine=engine, sampler=sampler, queue=last_queue[0],
         extra={"final": True}))
 
+    # flight recording headline + frontier artifact (ISSUE 16): the
+    # fingerprint-grouped Pareto set ROADMAP item 2's autotuner consumes
+    out["flight_file"] = flight_path
+    out["flight_windows"] = flight.windows_recorded
+    out["straggler_events"] = flight.straggler_events
+    try:
+        frontier = obs_flight.extract_frontier(
+            obs_flight.read_recording(flight_path))
+        prog.write_artifact(os.path.join("results", "frontier.json"),
+                            frontier)
+        out["frontier_points"] = frontier["pareto_points"]
+        out["frontier_file"] = os.path.join("results", "frontier.json")
+    except Exception as e:
+        out["flight_error"] = section_error(e)
+
     out["store_after"] = store.stats()
     out["_store"] = store  # the section owner compacts + caches this
     return out
@@ -1664,8 +1710,10 @@ def _capacity_chaos(tiny: bool, rng_seed: int = 11) -> dict:
     import numpy as np
 
     from raft_tpu import obs, resilience, serving
+    from raft_tpu.bench import progress as prog
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.obs import costmodel as obs_costmodel
+    from raft_tpu.obs import flight as obs_flight
     from raft_tpu.obs import report as obs_report
 
     rng = np.random.default_rng(rng_seed)
@@ -1727,8 +1775,28 @@ def _capacity_chaos(tiny: bool, rng_seed: int = 11) -> dict:
     outcomes = {"ok": 0, "degraded": 0, "rejected": 0, "deadline": 0,
                 "oom": 0, "other": 0}
     k = 5
+
+    # flight recorder over the chaos window (ISSUE 16): the tier census
+    # rides the fingerprint, so residency reshuffles land as NEW frontier
+    # groups — the capacity plane's operating points over time
+    def _flight_knobs():
+        census = {tier: sum(1 for t in registry.tenants()
+                            if t.tier == tier)
+                  for tier in ("hot", "warm", "cold")}
+        return {"algo": "ivf_flat", "scan": "capacity", "k": k,
+                "tenants": n_tenants, "tier_census": census}
+
+    flight_path = os.path.join("results", "flight_capacity.jsonl")
+    prog.truncate(flight_path)
+    raw_iv = os.environ.get(obs_flight.INTERVAL_ENV, "").strip()
+    flight = obs_flight.FlightRecorder(
+        flight_path, knobs=_flight_knobs, capacity=ctrl,
+        interval_s=None if raw_iv else (0.05 if tiny else 0.2))
+    flight.sample()  # window 0 opens the recording before traffic
+
     t0 = time.perf_counter()
     for i in range(n_req):
+        flight.maybe_sample()
         name = names[int(choices[i])]
         q = datasets[name][rng.integers(0, rows)][None].astype(np.float32)
         try:
@@ -1750,6 +1818,7 @@ def _capacity_chaos(tiny: bool, rng_seed: int = 11) -> dict:
         if think[i] > 0.004:
             time.sleep(min(think[i], 0.01))
     wall = time.perf_counter() - t0
+    flight.sample()  # close the chaos window's recording
 
     # force ≥1 measured promote even if the window stayed all-admit: the
     # hot-swap latency row must exist (acceptance: measured, not claimed)
@@ -1810,13 +1879,13 @@ def _capacity_chaos(tiny: bool, rng_seed: int = 11) -> dict:
 
     # per-tenant SLO rows through the crash-safe channel (acceptance);
     # fresh stream per run, like the serving section's report file
-    from raft_tpu.bench import progress as prog
-
     report_path = os.path.join("results", "obs_report_capacity.jsonl")
     prog.truncate(report_path)
     obs_report.export(report_path, report)
     out["obs_report_file"] = report_path
     out["per_tenant_rows"] = len(cap_sec["tenants"])
+    out["flight_file"] = flight_path
+    out["flight_windows"] = flight.windows_recorded
     if obs.enabled():
         obs.add("bench.capacity.requests", n_req)
     return out
@@ -2099,6 +2168,43 @@ def _aggregate_fleet():
         return None
 
 
+def _stitch_fleet():
+    """Fold the children's per-process Perfetto traces into ONE fleet
+    timeline (results/trace_fleet.json) via obs/aggregate.stitch_traces —
+    per-host pid tracks, host-local span ids namespaced, fleet_trace_id
+    attrs left as the cross-host join key, clocks aligned by the flight
+    recording's handshake records when present. File-path loaded and
+    best-effort, the _aggregate_fleet contract: its absence must never
+    cost the metric line."""
+    trace_dir = os.path.join(_REPO, "results")
+    try:
+        files = sorted(
+            os.path.join(trace_dir, f) for f in os.listdir(trace_dir)
+            if f.startswith("trace_bench_p") and f.endswith(".json"))
+        if not files:
+            return None
+        agg = _load_by_path("_obs_aggregate", "raft_tpu", "obs",
+                            "aggregate.py")
+        docs = [agg.read_trace(p) for p in files]
+        if not any(d is not None for d in docs):
+            return None
+        offsets = None
+        flight_path = os.path.join(_REPO, "results",
+                                   "flight_streaming.jsonl")
+        if os.path.exists(flight_path):
+            offsets = agg.merge_records(
+                agg.read_jsonl(flight_path)).get("clock_offsets")
+        doc = agg.stitch_traces(docs, clock_offsets=offsets)
+        if not doc.get("traceEvents"):
+            return None
+        out = os.path.join(_REPO, "results", "trace_fleet.json")
+        _PROGRESS.write_artifact(out, doc)
+        return out
+    # same degrade-to-absent contract as _aggregate_fleet above
+    except Exception:  # graftlint: ignore[unclassified-except]
+        return None
+
+
 def _parse_args(argv):
     import argparse
 
@@ -2182,6 +2288,9 @@ def main():
         fleet = _aggregate_fleet()
         if fleet:
             result["fleet_metrics"] = fleet
+        trace = _stitch_fleet()
+        if trace:
+            result["fleet_trace"] = trace
         _emit(result)
         return
 
@@ -2194,6 +2303,9 @@ def main():
         fleet = _aggregate_fleet()
         if fleet:
             result["fleet_metrics"] = fleet
+        trace = _stitch_fleet()
+        if trace:
+            result["fleet_trace"] = trace
         _emit(result)
         return
     # _fail salvages from the checkpoint file before emitting bench_error

@@ -52,11 +52,16 @@ _ENTRY_PREFIXES = ("build_", "search_", "fit_")
 #: helper modules (aggregate, tracing) keep their non-span shape.
 #: ``trace_event`` is deliberately NOT an entry name — it runs at jit
 #: TRACE time, where opening a span would record tracing as work.
+#: ISSUE 16 adds the flight recorder (``obs/flight.py``): ``sample`` /
+#: ``render`` / ``extract_frontier`` are the timeline and the frontier the
+#: autotuner consumes (``maybe_sample`` stays exempt — it is the serving
+#: loop's one-branch pump and opens the span only when it samples).
 _OBS_FILES = {"slo.py", "report.py", "costmodel.py", "compile.py",
-              "roofline.py"}
+              "roofline.py", "flight.py"}
 _OBS_ENTRY_NAMES = {"sample", "evaluate", "collect", "render",
                     "estimate", "check_admission", "predict_index_bytes",
-                    "summary", "estimate_flops", "utilization"}
+                    "summary", "estimate_flops", "utilization",
+                    "extract_frontier"}
 
 
 def _is_entry_name(name: str) -> bool:

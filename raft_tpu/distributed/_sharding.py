@@ -10,6 +10,7 @@ cagra share the availability pieces."""
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -110,12 +111,22 @@ def probe_shards(algo: str, world: int, n_total: int,
     rows = shard_rows_held(world, n_total)
     dl = resilience.active_deadline()
     ok = []
-    probe_attrs = ({"shard": world} if obs.enabled() else None)
-    with obs.record_span("distributed::shard_probe", attrs=probe_attrs):
+    enabled = obs.enabled()
+    # per-shard wall times (round 19, telemetry-gated — NOOP mode pays no
+    # clock reads): a failing shard's probe spends exception handling +
+    # classification + health bookkeeping where a healthy one spends a
+    # bare faultpoint check, so the max/median ratio spikes exactly when a
+    # shard drags — the straggler signal the flight recorder windows fold
+    shard_times = [] if enabled else None
+    probe_attrs = ({"shard": world} if enabled else None)
+    probe_span = obs.record_span("distributed::shard_probe",
+                                 attrs=probe_attrs)
+    with probe_span:
         for r in range(world):
             if health.state(r) == resilience.LOST:
                 ok.append(False)
                 continue
+            t_shard = time.perf_counter() if enabled else 0.0
             try:
                 if dl is not None and dl.hard:
                     left = sum(1 for rr in range(r, world)
@@ -139,6 +150,14 @@ def probe_shards(algo: str, world: int, n_total: int,
                     raise
                 health.report_failure(r, e)
                 ok.append(False)
+            if enabled:
+                shard_times.append(time.perf_counter() - t_shard)
+        if enabled and shard_times:
+            ordered = sorted(shard_times)
+            med = ordered[len(ordered) // 2]
+            skew = round(max(shard_times) / max(med, 1e-9), 3)
+            obs.set_gauge("distributed.shard_skew", skew)
+            probe_span.set_attr("skew", skew)
     ok_np = np.asarray(ok, dtype=bool)
     covered = sum(rows[r] for r in range(world) if ok_np[r])
     coverage = covered / max(1, int(n_total))
@@ -403,9 +422,17 @@ def tiled_search(queries_mat, probes, lens_max, n_lists, k, comms,
 
     search_attrs = None
     if obs.enabled():
+        from raft_tpu.obs import tracing as obs_tracing
+
         search_attrs = {"shard": int(comms.size), "queries": int(q),
                         "probes": int(q * p),
-                        "coverage": round(report.coverage, 4)}
+                        "coverage": round(report.coverage, 4),
+                        # fleet-deterministic dispatch id (round 19): every
+                        # host stamps the SAME id on the same SPMD dispatch,
+                        # so the trace stitcher joins per-host tracks into
+                        # one fleet trace on this attr
+                        "fleet_trace_id": obs_tracing.fleet_trace_id(
+                            "distributed.tiled_search")}
     span = obs.record_span("distributed::tiled_search", attrs=search_attrs)
     with span:
         while start < q:

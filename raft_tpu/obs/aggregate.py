@@ -50,6 +50,8 @@ __all__ = [
     "merge_snapshots",
     "percentile_bounds",
     "read_jsonl",
+    "read_trace",
+    "stitch_traces",
 ]
 
 #: the quantiles snapshot()/export carry, as (key, q) pairs
@@ -173,7 +175,19 @@ def merge_records(records: List[dict]) -> dict:
     count — then merge the survivors. Returns the merged snapshot plus
     provenance (``processes``, ``process_count``, t range)."""
     latest: dict = {}
+    offsets: dict = {}
     for rec in records:
+        if rec.get("type") == "clock_offset":
+            # the flight recorder's per-process handshake: NOT a metrics
+            # snapshot — folding it into ``latest`` would let a newer
+            # handshake supersede (and erase) its process's real snapshot.
+            # Newest handshake per process wins; the fold is a key-wise
+            # max-by-t, so grouping cannot change it (associativity).
+            pi = rec.get("process_index", 0)
+            prev = offsets.get(pi)
+            if prev is None or rec.get("t", 0) >= prev.get("t", 0):
+                offsets[pi] = rec
+            continue
         src = rec.get("_source", "")
         key = (src, rec.get("process_index", 0))
         prev = latest.get(key)
@@ -189,6 +203,12 @@ def merge_records(records: List[dict]) -> dict:
     if ts:
         merged["t_min"] = min(ts)
         merged["t_max"] = max(ts)
+    if offsets:
+        merged["clock_offsets"] = {
+            f"p{pi}": {key: rec.get(key) for key in
+                       ("offset_s", "t_epoch", "t_mono", "t")
+                       if key in rec}
+            for pi, rec in sorted(offsets.items())}
     return merged
 
 
@@ -229,29 +249,141 @@ def merge_files(paths: Iterable[str]) -> dict:
     return out
 
 
+def read_trace(path: str) -> Optional[dict]:
+    """Load one per-process Chrome trace export (obs/tracing.chrome_trace
+    shape). Returns None for unreadable/garbage files — a dead child's
+    torn trace must cost one track, not the stitch."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("traceEvents"), list):
+        return None
+    return doc
+
+
+def stitch_traces(docs: Iterable[Optional[dict]],
+                  clock_offsets: Optional[dict] = None) -> dict:
+    """Fold per-process Chrome traces (``trace_bench_p{i}.json`` exports)
+    into ONE fleet timeline loadable as a single Perfetto file.
+
+    Each source keeps its own ``pid`` track (a ``process_name`` metadata
+    event labels it ``host<i>``); two exports claiming the same
+    process_index — the id-collision case — are re-homed on the next free
+    track, never merged. Host-LOCAL span/trace ids are namespaced
+    ``p<i>/<id>`` so pid-counter collisions across hosts stay distinct,
+    while the ``fleet_trace_id`` attr (obs/tracing.fleet_trace_id) is left
+    verbatim — it is the cross-host join key, one fleet trace over
+    distinct host tracks. ``clock_offsets`` (merge_records' fold of the
+    flight recording's handshake records, ``{"p<i>": {"offset_s": ..}}``)
+    shifts each host's timestamps onto the shared reference clock."""
+    events: list = []
+    used: set = set()
+    counts = [1]
+    sources = []
+    for slot, doc in enumerate(d for d in docs if d is not None):
+        meta = doc.get("otherData") or {}
+        pi = int(meta.get("process_index", slot))
+        while pi in used:
+            pi += 1
+        used.add(pi)
+        counts.append(int(meta.get("process_count", 1) or 1))
+        sources.append({"process_index": pi, **{
+            k: v for k, v in meta.items() if k != "process_index"}})
+        off_s = 0.0
+        if clock_offsets:
+            row = clock_offsets.get(f"p{pi}") or clock_offsets.get(pi) or {}
+            try:
+                off_s = float(row.get("offset_s", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                off_s = 0.0
+        events.append({"name": "process_name", "ph": "M", "pid": pi,
+                       "args": {"name": f"host{pi}"}})
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = pi
+            if off_s and isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(ev["ts"] - off_s * 1e6, 1)
+            args = ev.get("args")
+            if isinstance(args, dict):
+                args = dict(args)
+                for key in ("trace_id", "span_id", "parent_id"):
+                    if args.get(key):
+                        args[key] = f"p{pi}/{args[key]}"
+                ev["args"] = args
+            events.append(ev)
+    # metadata events first (no ts), then chronological across hosts
+    events.sort(key=lambda e: (e.get("ph") != "M",
+                               e["ts"] if isinstance(e.get("ts"),
+                                                     (int, float)) else 0.0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "stitched": True,
+            "processes": sorted(used),
+            "process_count": max(counts + [len(used)]),
+            "sources": sources,
+        },
+    }
+
+
+def _emit(text: str, output: Optional[str]) -> None:
+    if output:
+        with open(output, "w") as f:
+            f.write(text + "\n")
+            f.flush()
+    else:
+        print(text)
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m raft_tpu.obs.aggregate",
         description="Merge per-process obs metrics JSONL files into one "
                     "fleet-wide snapshot (exact for counters and "
-                    "power-of-two histograms).")
-    ap.add_argument("files", nargs="+", help="metrics JSONL files")
+                    "power-of-two histograms), or stitch per-process "
+                    "Chrome traces into one fleet timeline (--stitch).")
+    ap.add_argument("files", nargs="+",
+                    help="metrics JSONL files (or Chrome trace JSON files "
+                         "with --stitch)")
+    ap.add_argument("--stitch", action="store_true",
+                    help="treat files as per-process Chrome traces and "
+                         "fold them into ONE fleet trace with per-host "
+                         "tracks")
+    ap.add_argument("--handshakes", default=None, metavar="PATH",
+                    help="flight recording JSONL whose clock_offset "
+                         "handshake records align host clocks in the "
+                         "stitch")
     ap.add_argument("--output", default=None, metavar="PATH",
                     help="write the fleet view here instead of stdout")
     ap.add_argument("--indent", type=int, default=2)
     args = ap.parse_args(argv)
+    if args.stitch:
+        docs = [read_trace(p) for p in args.files]
+        if not any(d is not None for d in docs):
+            print("aggregate: no loadable traces in "
+                  f"{', '.join(args.files)}", file=sys.stderr)
+            return 2
+        offsets = None
+        if args.handshakes:
+            offsets = merge_records(
+                read_jsonl(args.handshakes)).get("clock_offsets")
+        doc = stitch_traces(docs, clock_offsets=offsets)
+        _emit(json.dumps(doc, indent=args.indent, sort_keys=True),
+              args.output)
+        return 0
     fleet = merge_files(args.files)
     if not fleet.get("sources"):
         print("aggregate: no parseable records in "
               f"{', '.join(args.files)}", file=sys.stderr)
         return 2
-    text = json.dumps(fleet, indent=args.indent, sort_keys=True)
-    if args.output:
-        with open(args.output, "w") as f:
-            f.write(text + "\n")
-            f.flush()
-    else:
-        print(text)
+    _emit(json.dumps(fleet, indent=args.indent, sort_keys=True),
+          args.output)
     return 0
 
 

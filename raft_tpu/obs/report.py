@@ -28,15 +28,32 @@ a serving process; standalone it is an empty-but-valid skeleton).
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import math
+import os
 import sys
 import time
 from typing import Optional
 
 from raft_tpu import obs, resilience
 
-__all__ = ["collect", "export", "main", "render", "validate"]
+__all__ = ["SCHEMA_VERSION", "collect", "export", "main", "render",
+           "validate"]
+
+#: Record schema stamped by :func:`collect` — :func:`validate` keys its
+#: leniency off this field instead of probing section shapes. History:
+#: 1 = SLO/recall/queue/memory/shard_health/verdicts (rounds ≤10);
+#: 2 = + compile ledger and admission sections (round 11);
+#: 3 = + roofline section (round 15);
+#: 4 = + capacity section, explicit version + window stamps (round 19).
+#: Records with NO version field are legacy streams: every later section
+#: is lenient-on-absence for them, exactly as before the stamp existed.
+SCHEMA_VERSION = 4
+
+#: monotonic window id for records collect() stamps itself (a caller-run
+#: windowed sampler — obs/flight.py — passes its own instead)
+_WINDOWS = itertools.count()
 
 #: verdict counters summarized into the report (everything the queue stamps)
 _VERDICT_PREFIX = "serving.requests."
@@ -79,14 +96,18 @@ def _classified(fn, label: str, out_errors: dict):
 
 def collect(engine=None, sampler=None, queue=None, capacity=None,
             snapshot: Optional[dict] = None,
-            extra: Optional[dict] = None) -> dict:
+            extra: Optional[dict] = None,
+            window: Optional[int] = None) -> dict:
     """One status snapshot of the observability plane. Every section
     degrades independently (classified into ``errors``) so a broken
     provider never costs the rest of the report. ``capacity`` (round 18)
     is a :class:`raft_tpu.serving.CapacityController`; its per-tenant
     section (tiers, residency bytes, verdict counts, SLO rows, promote
     latency) rides the report and is structurally gated by
-    :func:`validate`."""
+    :func:`validate`. Every record is stamped with :data:`SCHEMA_VERSION`
+    and a ``window`` id (round 19: the flight recorder passes its own;
+    otherwise a process-local counter — a report STREAM is ordered by more
+    than wall-clock t)."""
     with obs.record_span("obs.report::collect"):
         errors: dict = {}
         snap = snapshot if snapshot is not None else \
@@ -100,6 +121,8 @@ def collect(engine=None, sampler=None, queue=None, capacity=None,
         out = {
             "t": round(time.time(), 3),
             "type": "obs_report",
+            "schema_version": SCHEMA_VERSION,
+            "window": int(window) if window is not None else next(_WINDOWS),
             "slo": (_classified(engine.evaluate, "slo", errors)
                     if engine is not None else {}),
             "recall": (_classified(sampler.estimate, "recall", errors)
@@ -145,6 +168,11 @@ def collect(engine=None, sampler=None, queue=None, capacity=None,
                     v for k, v in verdicts.items() if k not in known)),
             },
         }
+        # round id (driver-stamped): lets a multi-round archive key reports
+        # without parsing file names
+        round_id = os.environ.get("RAFT_TPU_OBS_ROUND", "").strip()
+        if round_id:
+            out["round"] = round_id
         if errors:
             out["errors"] = errors
         if extra:
@@ -182,8 +210,17 @@ def validate(report: dict,
     """Structural health of one report record: the list of problems (empty
     = valid). Checks the acceptance invariants: every required SLO class
     present with finite burn rates, recall estimate populated with CI
-    bounds, a nonzero memory watermark, zero unclassified verdicts."""
+    bounds, a nonzero memory watermark, zero unclassified verdicts.
+
+    Section presence is keyed off the record's ``schema_version`` stamp
+    (:data:`SCHEMA_VERSION` history): a version that declares a section
+    (compile ≥ 2, roofline ≥ 3) must carry it — either populated or
+    degraded-classified in ``errors``. Unversioned records are legacy
+    streams and stay lenient on absence."""
     problems = []
+    version = report.get("schema_version")
+    version = version if isinstance(version, int) else 0
+    errors = report.get("errors") or {}
     slo = report.get("slo") or {}
     kinds = {row.get("kind") for row in slo.values()
              if isinstance(row, dict)}
@@ -217,20 +254,30 @@ def validate(report: dict,
     if verdicts.get("unclassified", 0):
         problems.append(
             f"{verdicts['unclassified']} unclassified verdict(s)")
-    # compile ledger (round 11): every retrace must carry a shape-diff —
-    # an unexplained retrace is a zero-recompile-contract violation.
-    # Lenient on absence (pre-round-11 report streams have no section).
+    # compile ledger: every retrace must carry a shape-diff — an
+    # unexplained retrace is a zero-recompile-contract violation. Schema
+    # v2+ declares the section, so its absence (without a classified
+    # degradation) is itself a problem; unversioned legacy streams pass.
     comp = report.get("compile")
     if isinstance(comp, dict) and comp.get("unexplained_retraces", 0):
         problems.append(
             f"{comp['unexplained_retraces']} unexplained retrace(s) "
             f"in the compile ledger")
-    # roofline plane (round 15): every noted entry must carry a finite
-    # positive byte model, a sane bound verdict, and FLOPs consistent
-    # with its own intensity; peaks must state their provenance (a
-    # made-up denominator is worse than an unknown one). Lenient on
-    # absence (pre-round-15 report streams have no section).
+    elif not isinstance(comp, dict) and version >= 2 \
+            and "compile" not in errors:
+        problems.append(
+            f"schema v{version} record missing its compile section")
+    # roofline plane: every noted entry must carry a finite positive byte
+    # model, a sane bound verdict, and FLOPs consistent with its own
+    # intensity; peaks must state their provenance (a made-up denominator
+    # is worse than an unknown one). Schema v3+ declares the section
+    # (absence without a classified degradation is a problem); unversioned
+    # legacy streams pass.
     roof = report.get("roofline")
+    if not isinstance(roof, dict) and version >= 3 \
+            and "roofline" not in errors:
+        problems.append(
+            f"schema v{version} record missing its roofline section")
     if isinstance(roof, dict):
         peaks = roof.get("peaks") or {}
         if peaks.get("source") not in ("env", "table", "unknown"):
@@ -254,10 +301,12 @@ def validate(report: dict,
                 problems.append(
                     f"roofline[{name}] claims bound={row['bound']!r} "
                     f"with unknown peaks")
-    # capacity plane (round 18): every tenant must sit in a known tier
+    # capacity plane (schema v4): every tenant must sit in a known tier
     # with sane residency accounting, and the budgeter invariant —
     # predicted resident bytes never exceed a known budget — must hold in
-    # the snapshot. Lenient on absence (no capacity controller wired).
+    # the snapshot. Lenient on absence at EVERY version: collect() emits
+    # None whenever no capacity controller is wired, which is the normal
+    # single-tenant shape, not a legacy artifact.
     cap = report.get("capacity")
     if isinstance(cap, dict):
         budget = cap.get("budget_bytes")

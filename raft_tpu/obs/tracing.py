@@ -43,6 +43,7 @@ import json
 import os
 import sys
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -50,6 +51,7 @@ __all__ = [
     "alloc_id",
     "chrome_trace",
     "clear_spans",
+    "clock_handshake",
     "current_span",
     "disable_sync",
     "drain_device",
@@ -57,9 +59,11 @@ __all__ = [
     "enter_span",
     "exit_span",
     "export_chrome_trace",
+    "fleet_trace_id",
     "manual_span",
     "process_info",
     "push_span",
+    "reset_fleet_ids",
     "set_ring_cap",
     "spans",
     "sync_enabled",
@@ -241,6 +245,60 @@ def exit_span(ids, token, *, name: str, t0: float, dur_s: float,
         rec["dispatch_s"] = dispatch_s
     push_span(rec)
     return rec
+
+
+#: per-site fleet dispatch counters (``fleet_trace_id``), guarded by _LOCK
+_fleet_ids: dict = {}
+
+
+def fleet_trace_id(site: str) -> str:
+    """Deterministic FLEET-scoped id for one dispatch of ``site``:
+    ``fleet:<site>:<n>`` where n counts this process's dispatches of that
+    site. Deliberately NOT pid-prefixed — under SPMD every host runs the
+    identical dispatch sequence, so host i and host j stamp the SAME id on
+    the same logical dispatch, which is exactly what lets the trace
+    stitcher (obs/aggregate.stitch_traces) line per-host tracks up into
+    one fleet trace. Span/trace ids stay host-local (:func:`alloc_id`);
+    this rides spans as an ``attrs`` entry."""
+    with _LOCK:
+        n = _fleet_ids.get(site, 0) + 1
+        _fleet_ids[site] = n
+    return f"fleet:{site}:{n}"
+
+
+def reset_fleet_ids() -> None:
+    """Reset the per-site dispatch counters (tests simulating two hosts
+    from one process re-zero between 'hosts' to mirror SPMD determinism)."""
+    with _LOCK:
+        _fleet_ids.clear()
+
+
+def clock_handshake(reference_epoch: Optional[float] = None) -> dict:
+    """The per-process clock-offset handshake record that opens a flight
+    recording: this host's epoch and monotonic readings, plus ``offset_s``
+    relative to a fleet-agreed reference epoch (``reference_epoch`` or the
+    ``RAFT_TPU_FLEET_EPOCH`` env var a multi-host launcher distributes).
+    With no reference the offset is 0.0 — single-host recordings stitch
+    unshifted. The stitcher subtracts ``offset_s`` from a host's event
+    timestamps so skewed wall clocks align on one timeline."""
+    pi, pc = process_info()
+    t_epoch = time.time()
+    t_mono = time.monotonic()
+    if reference_epoch is None:
+        raw = os.environ.get("RAFT_TPU_FLEET_EPOCH", "").strip()
+        try:
+            reference_epoch = float(raw) if raw else None
+        except ValueError:
+            reference_epoch = None
+    return {
+        "type": "clock_offset",
+        "process_index": pi,
+        "process_count": pc,
+        "t_epoch": round(t_epoch, 6),
+        "t_mono": round(t_mono, 6),
+        "offset_s": (round(t_epoch - reference_epoch, 6)
+                     if reference_epoch is not None else 0.0),
+    }
 
 
 def alloc_id() -> str:

@@ -622,3 +622,16 @@ class QueryQueue:
     def batch_cap(self) -> int:
         """Current adaptive batch-size cap (halved by OOM dispatches)."""
         return self._batch_cap
+
+    def knobs(self) -> dict:
+        """The queue's live config-knob vector — the serving slice of the
+        flight recorder's fingerprint (obs/flight.py). Includes the
+        ADAPTIVE batch cap, so an OOM-halved window lands as a distinct
+        fingerprint group on the frontier, not averaged into the sized
+        configuration it no longer runs."""
+        return {
+            "max_batch": self.max_batch,
+            "batch_cap": int(self._batch_cap),
+            "slo_s": self.slo_s,
+            "fill_wait_s": self.fill_wait_s,
+        }

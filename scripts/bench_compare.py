@@ -213,6 +213,16 @@ def direction(metric: str) -> str:
     if tail in ("tenants_resident_hot", "tenants_resident_warm",
                 "tenants_cold"):
         return "info"
+    # flight recorder (round 19): shard-time skew and sustained-straggler
+    # events shrink toward good (a hot shard is a fleet regression at any
+    # ratio); windows recorded and frontier size grow toward good — more
+    # timeline coverage and more Pareto-optimal operating points — but a
+    # dip is a run-length artifact, not a perf regression, so their
+    # verdicts stay rows, never gates
+    if tail in ("shard_skew", "straggler_events"):
+        return "down"
+    if tail in ("flight_windows", "frontier_points"):
+        return "up"
     # cost-model accuracy (round 11): the predicted/measured HBM ratio is
     # best AT 1.0 — drift in either direction is the predictor degrading,
     # so the verdict compares |ratio − 1| across rounds ("one" direction);
@@ -256,6 +266,9 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "serving.recall_estimate": 0.01,
     "serving.recall_stale": 0.0,
     "serving.recompiles_during_serving": 0.0,
+    # flight recorder (round 19): ONE sustained straggler excursion in the
+    # serving window is worth a row
+    "serving.straggler_events": 0.0,
     # cost model (round 11): an unexplained retrace is a contract
     # violation at ANY count; prediction accuracy gets a 5% band before a
     # drift away from ratio 1.0 becomes a regression row

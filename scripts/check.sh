@@ -63,9 +63,11 @@ echo "== degraded-mode shard-loss smoke (ISSUE 7) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 RAFT_TPU_FAULTS="distributed.brute_force.search.shard=fatal:1,distributed.ivf_flat.search.shard=fatal:1,distributed.ivf_pq.search.shard=fatal:1,distributed.ivf_bq.search.shard=fatal:1,distributed.cagra.search.shard=fatal:1" \
 python - <<'EOF' || fail=1
+import os, tempfile
 import numpy as np
-from raft_tpu import resilience
+from raft_tpu import obs, resilience
 from raft_tpu.comms import Comms, local_mesh
+from raft_tpu.obs import flight as obs_flight
 from raft_tpu.distributed import brute_force as dbf, cagra as dcagra, \
     ivf_bq as dbq, ivf_flat as divf, ivf_pq as dpq
 from raft_tpu.neighbors import cagra as slcagra, ivf_bq, ivf_pq
@@ -91,15 +93,38 @@ runs = {
             build_algo="brute"), comms=comms),
         Q, 5, slcagra.CagraSearchParams(itopk_size=32)),
 }
+# ISSUE 16: the induced losses must show up on the flight timeline —
+# a recorder window per algo whose events carry the partial merge AND
+# whose distributed.shard_skew reading spikes (the failing shard pays
+# the exception/classify path, so max/median jumps vs the healthy
+# baseline sampled after every one-shot fault is spent)
+obs.enable()
+rec_path = os.path.join(tempfile.mkdtemp(), "flight_shard_loss.jsonl")
+flight = obs_flight.FlightRecorder(rec_path, knobs={"smoke": "shard_loss"},
+                                   interval_s=0.01)
+skews = {}
 for name, run in runs.items():
     resilience.reset_shard_health()
     res = run()
     assert res.degraded and res.coverage < 1.0, (name, res.coverage)
     ids = np.asarray(res.indices)
     assert ids.max() < 1024 and (ids[ids >= 0] >= 128).all(), name
+    win = flight.sample()
+    events = {e.get("event") for e in win.get("events", [])}
+    assert "partial_merge" in events, (name, events)
+    skews[name] = win["ops"].get("shard_skew")
     print(f"  {name}: degraded ok (coverage={res.coverage:.3f}, "
-          f"lost={res.lost_shards})")
-print("shard-loss smoke: OK")
+          f"lost={res.lost_shards}, skew={skews[name]})")
+resilience.reset_shard_health()
+runs["ivf_flat"]()  # healthy: its one-shot fault fired above
+base = flight.sample()["ops"].get("shard_skew")
+assert base is not None, "baseline window carries no shard_skew"
+for name, skew in skews.items():
+    assert skew is not None and skew > max(4.0, 2.0 * base), \
+        (name, base, skew)
+assert obs_flight.validate(obs_flight.read_recording(rec_path)) == []
+print(f"shard-loss smoke: OK (losses visible as flight timeline events, "
+      f"skew excursions {min(skews.values())}+ vs healthy {base})")
 EOF
 
 echo
@@ -152,6 +177,16 @@ echo "== capacity smoke (multi-tenant admission + tiering, ISSUE 15) =="
 # verdict, and the per-tenant obs.report section validating through the
 # CLI.
 JAX_PLATFORMS=cpu python scripts/capacity_smoke.py || fail=1
+
+echo
+echo "== flight-recorder smoke (operating-point timeline + frontier, ISSUE 16) =="
+# Tiny serving window with the FlightRecorder pumping alongside the queue:
+# >=3 windows streamed crash-safe (clock-offset handshake + device-health
+# verdict on window 0), an armed obs.flight.sample=oom fault degrading ONE
+# window classified while serving continues, and the real CLI subprocess
+# validating the recording and extracting a non-empty Pareto frontier
+# grouped by config fingerprint.
+JAX_PLATFORMS=cpu python scripts/flight_smoke.py || fail=1
 
 echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Flight-recorder CPU smoke (ISSUE 16, wired into scripts/check.sh).
+
+A tiny serving window runs with the FlightRecorder pumping alongside the
+QueryQueue, then a second window at a different operating point (smaller
+max_batch, larger nprobe) so the recording carries TWO config
+fingerprints. Asserts the flight acceptance gates end to end:
+
+* >= 3 windows recorded, streamed crash-safe through bench/progress and
+  opened by the clock-offset handshake record;
+* window 0 carries the subprocess device-health verdict;
+* an armed ``obs.flight.sample=oom`` fault degrades ONE window to a
+  classified stub while serving continues (requests after the fault
+  still complete ok) and the next sample recovers clean;
+* ``python -m raft_tpu.obs.flight --validate --frontier`` (the real CLI,
+  subprocess) accepts the recording and extracts a NON-EMPTY Pareto
+  frontier grouped by fingerprint;
+* telemetry off => the recorder holds zero state and records nothing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu import obs, resilience, serving  # noqa: E402
+from raft_tpu.neighbors import ivf_flat  # noqa: E402
+from raft_tpu.obs import flight as obs_flight  # noqa: E402
+
+K, N_REQ = 5, 48
+
+
+def build_store(rng):
+    X = rng.standard_normal((2000, 16)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=32,
+                                                   list_size_cap=0))
+    return X, serving.PagedListStore.from_index(idx, page_rows=32)
+
+
+def run_window(flight, store, q_pool, rng, rate, max_batch, nprobe,
+               arm_fault_at=None):
+    queue = serving.QueryQueue(
+        serving.searcher(store, K, n_probes=nprobe),
+        slo_s=2.0, max_batch=max_batch, fill_wait_s=0.002)
+    flight.set_load(queue, {"algo": "ivf_flat", "scan": "paged", "k": K,
+                            "nprobe": nprobe, **queue.knobs()})
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=N_REQ))
+    handles, post_fault = [], []
+    i = 0
+    t0 = time.perf_counter()
+    while i < N_REQ:
+        flight.rec.maybe_sample()
+        now = time.perf_counter() - t0
+        if now >= arrivals[i]:
+            h = queue.submit(q_pool[i % len(q_pool)], timeout_s=2.0)
+            handles.append(h)
+            if arm_fault_at is not None and i >= arm_fault_at:
+                post_fault.append(h)
+            i += 1
+            if arm_fault_at is not None and i == arm_fault_at:
+                resilience.arm_faults("obs.flight.sample=oom:1")
+                flight.rec.sample()  # the degraded-classified window
+            continue
+        if not queue.pump():
+            time.sleep(min(arrivals[i] - now, 2e-4))
+    queue.drain(timeout=30.0)
+    flight.rec.sample()  # close this fingerprint's window on a clean sample
+    return handles, post_fault
+
+
+class Flight:
+    """Recorder plus the mutable per-load providers it closes over."""
+
+    def __init__(self, path):
+        self.queue = None
+        self.knobs = {}
+        self.rec = obs_flight.FlightRecorder(
+            path, knobs=lambda: self.knobs, queue=lambda: self.queue,
+            probe_health=True, interval_s=0.05)
+
+    def set_load(self, queue, knobs):
+        self.queue, self.knobs = queue, knobs
+
+
+def main():
+    # telemetry-off NOOP gate first: zero flight state, nothing recorded
+    off_dir = tempfile.mkdtemp()
+    off = obs_flight.FlightRecorder(os.path.join(off_dir, "off.jsonl"),
+                                    knobs={"algo": "noop"})
+    assert not off.enabled and off.maybe_sample() is None
+    assert off.sample() is None and off.records() == []
+    assert off.windows_recorded == 0
+    assert not hasattr(off, "_ring"), "disabled recorder holds state"
+    assert not os.listdir(off_dir), "disabled recorder wrote a file"
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    q_pool, store = build_store(rng)
+
+    # warm the batch buckets off the recorded clock
+    b = 1
+    while True:
+        float(np.asarray(serving.search(
+            store, np.repeat(q_pool[:1], b, axis=0), K, n_probes=2)[0]).sum())
+        if b >= 32:
+            break
+        b *= 2
+
+    path = os.path.join(tempfile.mkdtemp(), "flight_smoke.jsonl")
+    flight = Flight(path)
+    flight.rec.sample()  # window 0: pays the subprocess health probe
+
+    # two operating points => two fingerprint groups on the frontier;
+    # the second window carries the armed-fault degraded sample
+    run_window(flight, store, q_pool, rng, rate=400.0, max_batch=32,
+               nprobe=2)
+    handles, post_fault = run_window(flight, store, q_pool, rng, rate=400.0,
+                                     max_batch=4, nprobe=8,
+                                     arm_fault_at=N_REQ // 2)
+    resilience.clear_faults()
+
+    records = obs_flight.read_recording(path)
+    wins = [r for r in records if r.get("type") == "flight_window"]
+    assert flight.rec.windows_recorded >= 3 and len(wins) >= 3, len(wins)
+    assert any(r.get("type") == "clock_offset" for r in records), \
+        "recording missing the clock-offset handshake"
+    assert wins[0].get("window") == 0 and "health" in wins[0], wins[0]
+
+    # the armed fault degraded exactly one window, classified oom — and
+    # serving continued: every post-fault request still completed ok
+    degraded = [r for r in wins
+                if (r.get("errors") or {}).get("sample") == resilience.OOM]
+    assert len(degraded) == 1, [r.get("errors") for r in wins]
+    after = [r for r in wins if r["window"] > degraded[0]["window"]]
+    assert after and all("sample" not in (r.get("errors") or {})
+                         for r in after), "recorder did not recover"
+    assert post_fault and all(h.verdict == "ok" for h in post_fault), \
+        [h.verdict for h in post_fault]
+
+    fps = {(r.get("fingerprint") or {}).get("fp") for r in wins
+           if isinstance(r.get("fingerprint"), dict)}
+    fps.discard(None)
+    assert len(fps) >= 2, f"expected 2+ fingerprint groups, got {fps}"
+    assert obs_flight.validate(records) == [], obs_flight.validate(records)
+
+    # the real CLI, as a subprocess: validate + frontier must both pass
+    fpath = os.path.join(os.path.dirname(path), "frontier.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.flight", path,
+         "--validate", "--frontier", fpath],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    frontier = json.load(open(fpath))
+    assert frontier["pareto_points"] >= 1, frontier
+    assert frontier["points"] >= 2, frontier
+    assert all(g["fp"] and g["windows"] >= 1 for g in frontier["groups"])
+
+    ok = sum(1 for h in handles if h.verdict == "ok")
+    print(f"flight smoke: OK ({len(wins)} windows, {len(fps)} fingerprints, "
+          f"{frontier['pareto_points']} pareto point(s), 1 classified "
+          f"oom-degraded window, {ok}/{N_REQ} ok in the faulted load)")
+
+
+if __name__ == "__main__":
+    main()

@@ -231,3 +231,198 @@ def test_aggregate_cli_no_records(tmp_path):
     )
     assert proc.returncode == 2
     assert "no parseable records" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# clock-offset handshakes in the merge (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_clock_offsets_fold_is_order_invariant():
+    """The handshake fold is a key-wise max-by-t: any ordering (and any
+    grouping — it is a pointwise max, hence associative) yields the same
+    ``clock_offsets`` map, and handshakes NEVER displace the metrics
+    snapshots they share a process with."""
+    recs = [
+        {"_source": "f0", "process_index": 0, "t": 5.0,
+         "counters": {"rows": 10}},
+        {"type": "clock_offset", "process_index": 0, "t": 6.0,
+         "offset_s": 0.25, "t_epoch": 100.0, "t_mono": 50.0},
+        {"type": "clock_offset", "process_index": 0, "t": 2.0,
+         "offset_s": 0.99},  # older handshake: superseded
+        {"type": "clock_offset", "process_index": 1, "t": 3.0,
+         "offset_s": -0.5, "t_epoch": 101.0, "t_mono": 51.0},
+        {"_source": "f1", "process_index": 1, "t": 1.0,
+         "counters": {"rows": 7}},
+    ]
+    import itertools
+
+    outs = [aggregate.merge_records(list(p))
+            for p in itertools.permutations(recs)]
+    first = outs[0]
+    assert all(o["clock_offsets"] == first["clock_offsets"] for o in outs)
+    assert all(o["counters"] == first["counters"] for o in outs)
+    # newest handshake per process won; metrics snapshots intact
+    assert first["clock_offsets"]["p0"]["offset_s"] == 0.25
+    assert first["clock_offsets"]["p1"]["offset_s"] == -0.5
+    assert first["counters"]["rows"] == 17
+    assert first["processes"] == [0, 1]
+
+
+def test_merge_without_handshakes_has_no_offsets_key():
+    out = aggregate.merge_records(
+        [{"_source": "f0", "process_index": 0, "t": 1.0,
+          "counters": {"rows": 1}}])
+    assert "clock_offsets" not in out
+
+
+def test_clock_handshake_record_shape(monkeypatch):
+    from raft_tpu.obs import tracing
+
+    monkeypatch.setenv("RAFT_TPU_PROCESS_INDEX", "3")
+    monkeypatch.setenv("RAFT_TPU_PROCESS_COUNT", "8")
+    hs = tracing.clock_handshake()
+    assert hs["type"] == "clock_offset"
+    assert hs["process_index"] == 3 and hs["process_count"] == 8
+    assert hs["offset_s"] == 0.0  # no shared reference epoch configured
+    monkeypatch.setenv("RAFT_TPU_FLEET_EPOCH", str(hs["t_epoch"] - 2.5))
+    hs2 = tracing.clock_handshake()
+    assert hs2["offset_s"] == pytest.approx(2.5, abs=0.5)
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace stitching (ISSUE 16): two processes, same seed ->
+# distinct host tracks, ONE fleet trace
+# ---------------------------------------------------------------------------
+
+
+def _host_trace(monkeypatch, pi, site="distributed.tiled_search"):
+    """One fake host's Chrome-trace export: same seed/site per host, so
+    host-local id counters collide by construction."""
+    from raft_tpu import obs
+    from raft_tpu.obs import tracing
+
+    monkeypatch.setenv("RAFT_TPU_PROCESS_INDEX", str(pi))
+    monkeypatch.setenv("RAFT_TPU_PROCESS_COUNT", "2")
+    tracing.clear_spans()
+    tracing.reset_fleet_ids()  # same deterministic counter on every host
+    with obs.record_span(
+            "distributed::tiled_search",
+            attrs={"fleet_trace_id": tracing.fleet_trace_id(site)}):
+        pass
+    return obs.chrome_trace(extra={"run": "stitch-test"})
+
+
+@pytest.fixture
+def _telemetry_on():
+    from raft_tpu import obs
+
+    obs.reset()
+    obs.enable()
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_stitch_two_hosts_one_fleet_trace(_telemetry_on, monkeypatch):
+    docs = [_host_trace(monkeypatch, 0), _host_trace(monkeypatch, 1)]
+    doc = aggregate.stitch_traces(docs)
+    # ONE loadable Chrome-trace file: a JSON dict with a traceEvents list
+    text = json.dumps(doc)
+    assert isinstance(json.loads(text)["traceEvents"], list)
+    ev = doc["traceEvents"]
+    # distinct per-host tracks, each labeled by process_name metadata
+    assert {e["pid"] for e in ev} == {0, 1}
+    meta = [e for e in ev if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in meta} == {"host0", "host1"}
+    assert ev[:len(meta)] == meta  # metadata sorts first
+    spans = [e for e in ev if e.get("ph") == "X"]
+    assert len(spans) == 2
+    # host-LOCAL ids are namespaced p<i>/... so the same-seed counters
+    # stay distinct; the fleet trace id is left VERBATIM — the cross-host
+    # join key, one fleet trace spanning both tracks
+    assert {s["args"]["span_id"].split("/")[0] for s in spans} == \
+        {"p0", "p1"}
+    assert len({s["args"]["span_id"] for s in spans}) == 2
+    fleet_ids = {s["args"]["fleet_trace_id"] for s in spans}
+    assert fleet_ids == {"fleet:distributed.tiled_search:1"}
+    assert doc["otherData"]["stitched"] is True
+    assert doc["otherData"]["processes"] == [0, 1]
+    assert doc["otherData"]["process_count"] == 2
+
+
+def test_stitch_rehomes_colliding_process_indices(_telemetry_on,
+                                                  monkeypatch):
+    """Two exports claiming the SAME process_index (a misconfigured fleet)
+    must land on distinct tracks, never merge."""
+    docs = [_host_trace(monkeypatch, 0), _host_trace(monkeypatch, 0)]
+    doc = aggregate.stitch_traces(docs)
+    assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len({s["args"]["span_id"] for s in spans}) == 2
+
+
+def test_stitch_applies_clock_offsets(_telemetry_on, monkeypatch):
+    docs = [_host_trace(monkeypatch, 0), _host_trace(monkeypatch, 1)]
+    base = aggregate.stitch_traces(docs)
+    shifted = aggregate.stitch_traces(
+        docs, clock_offsets={"p1": {"offset_s": 0.5}})
+
+    def ts_by_pid(doc, pid):
+        return [e["ts"] for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e["pid"] == pid]
+
+    assert ts_by_pid(shifted, 0) == ts_by_pid(base, 0)  # p0 unshifted
+    for t_base, t_shift in zip(ts_by_pid(base, 1), ts_by_pid(shifted, 1)):
+        assert t_shift == pytest.approx(t_base - 0.5e6, abs=1.0)
+
+
+def test_stitch_skips_dead_traces():
+    doc = aggregate.stitch_traces(
+        [None, {"traceEvents": [], "otherData": {"process_index": 4}}])
+    assert doc["otherData"]["processes"] == [4]
+
+
+def test_stitch_cli_end_to_end(_telemetry_on, monkeypatch, tmp_path):
+    files = []
+    for pi in (0, 1):
+        trace = _host_trace(monkeypatch, pi)
+        path = tmp_path / f"trace_bench_p{pi}.json"
+        path.write_text(json.dumps(trace))
+        files.append(str(path))
+    hs_path = tmp_path / "flight.jsonl"
+    hs_path.write_text(
+        json.dumps({"type": "clock_offset", "process_index": 1, "t": 1.0,
+                    "offset_s": 0.5}) + "\n")
+    (tmp_path / "garbage.json").write_text("{not json")
+    out_path = tmp_path / "trace_fleet.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.aggregate", "--stitch",
+         *files, str(tmp_path / "garbage.json"),
+         "--handshakes", str(hs_path), "--output", str(out_path)],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "found in sys.modules" not in proc.stderr
+    doc = json.load(open(out_path))
+    assert doc["otherData"]["stitched"] and \
+        doc["otherData"]["processes"] == [0, 1]
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {s["args"]["fleet_trace_id"] for s in spans} == \
+        {"fleet:distributed.tiled_search:1"}
+
+
+def test_stitch_cli_no_loadable_traces(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("nope")
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.aggregate", "--stitch",
+         str(bad)],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2
+    assert "no loadable traces" in proc.stderr

@@ -509,6 +509,70 @@ def pump(sampler):
         return {"error": repr(e)[:200], "kind": classify(e)}
 """,
     ),
+    # ISSUE 16 extension: the flight recorder (obs/flight.py) joins the
+    # obs-coverage scope — sample/render/extract_frontier are the timeline
+    # and frontier the autotuner consumes; maybe_sample (the serving
+    # loop's one-branch pump) and read/validate helpers stay exempt
+    (
+        "obs-coverage",
+        "raft_tpu/obs/flight.py",
+        """
+def extract_frontier(records):
+    return {"points": 0}
+""",
+        # near-miss: span-covered entry points + exempt pump/helpers
+        """
+from raft_tpu import obs
+
+class FlightRecorder:
+    def sample(self, now=None):
+        with obs.record_span("obs.flight::sample"):
+            return {}
+
+    def maybe_sample(self, now=None):
+        return None
+
+def extract_frontier(records):
+    with obs.record_span("obs.flight::frontier"):
+        return {"points": 0}
+
+def render(records):
+    with obs.record_span("obs.flight::render"):
+        return ""
+
+def read_recording(path):
+    return []
+
+def validate(records):
+    return []
+""",
+    ),
+    # ISSUE 16: flight spans obey the module::phase convention like every
+    # other raft_tpu/ module — a free-form window label would fork the
+    # flight.sample metric series across rounds
+    (
+        "span-name",
+        "raft_tpu/obs/flight.py",
+        """
+from raft_tpu import obs
+
+def sample(window):
+    with obs.record_span("Flight Window Sample"):
+        return {}
+""",
+        # near-miss: the convention-following names flight.py really uses
+        """
+from raft_tpu import obs
+
+def sample(window):
+    with obs.record_span("obs.flight::sample"):
+        return {}
+
+def extract_frontier(records):
+    with obs.record_span("obs.flight::frontier"):
+        return {}
+""",
+    ),
 ]
 
 

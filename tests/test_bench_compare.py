@@ -287,3 +287,53 @@ def test_capacity_plane_improvements_not_regressions(tmp_path):
     assert rows["capacity.promote_p50_s"] == "improved"
     assert rows["capacity.tenants_resident_hot"] == "·"
     assert "regression" not in rows.values(), proc.stdout
+
+
+def test_flight_plane_direction_rules(tmp_path):
+    """Round 19 (ISSUE 16 satellite): `shard_skew` and `straggler_events`
+    gate DOWNWARD (a hot shard is a fleet regression; one sustained
+    straggler excursion is zero-tolerance), while `flight_windows` and
+    `frontier_points` carry up-polarity — shrinking timeline coverage or
+    a collapsing Pareto set is worth a regression row."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"serving": {"shard_skew": 1.5,
+                                  "straggler_events": 0,
+                                  "flight_windows": 12,
+                                  "frontier_points": 3}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"serving": {"shard_skew": 9.0,
+                                  "straggler_events": 2,
+                                  "flight_windows": 4,
+                                  "frontier_points": 1}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["serving.shard_skew"] == "regression"
+    # 0 -> 2 is a from-zero transition: direction still decides (down)
+    assert rows["serving.straggler_events"] == "regression"
+    assert rows["serving.flight_windows"] == "regression"
+    assert rows["serving.frontier_points"] == "regression"
+
+
+def test_flight_plane_improvements_not_regressions(tmp_path):
+    """Both polarities pinned: skew dropping, stragglers clearing and the
+    timeline/frontier growing must render as improvements, never
+    regressions."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"serving": {"shard_skew": 9.0,
+                                  "straggler_events": 2,
+                                  "flight_windows": 4,
+                                  "frontier_points": 1}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"serving": {"shard_skew": 1.5,
+                                  "straggler_events": 0,
+                                  "flight_windows": 12,
+                                  "frontier_points": 3}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["serving.shard_skew"] == "improved"
+    assert rows["serving.straggler_events"] == "improved"
+    assert rows["serving.flight_windows"] == "improved"
+    assert rows["serving.frontier_points"] == "improved"
+    assert "regression" not in rows.values(), proc.stdout

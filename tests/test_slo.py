@@ -584,3 +584,38 @@ def test_report_cli_rejects_empty(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 2
     assert "no obs_report records" in proc.stderr
+
+
+def test_report_stamps_schema_version_and_window():
+    """ISSUE 16 satellite: every collect() record carries the explicit
+    schema_version stamp plus a window id — the flight recorder passes its
+    own, a bare collect draws from the process-local counter."""
+    rep = obs_report.collect(window=7)
+    assert rep["schema_version"] == obs_report.SCHEMA_VERSION
+    assert rep["window"] == 7
+    a, b = obs_report.collect(), obs_report.collect()
+    assert b["window"] == a["window"] + 1  # counter orders a bare stream
+
+
+def test_report_validate_leniency_keyed_off_version():
+    """Version-keyed leniency replaces the ad-hoc pre-round probing: an
+    UNVERSIONED (legacy) record missing whole sections passes, a v4 record
+    missing a section it declares fails — unless that section degraded
+    classified, which is the recorder doing its job."""
+    row = {"state": "ok", "burn_fast": 0.1, "burn_slow": 0.1}
+    base = {"type": "obs_report",
+            "slo": {"a": dict(row, kind="latency"),
+                    "b": dict(row, kind="availability"),
+                    "c": dict(row, kind="recall")},
+            "recall": {"recall": 0.95, "ci_low": 0.9, "ci_high": 0.99},
+            "memory": {"memory.x": {"value": 1, "max": 1}},
+            "verdicts": {"ok": 1}}
+    assert obs_report.validate(dict(base)) == []  # legacy: lenient
+    v4 = dict(base, schema_version=obs_report.SCHEMA_VERSION)
+    problems = "\n".join(obs_report.validate(v4))
+    assert "compile section" in problems
+    assert "roofline section" in problems
+    # classified degradation explains the absence — no problem rows
+    degraded = dict(v4, errors={"compile": "transient",
+                                "roofline": "transient"})
+    assert obs_report.validate(degraded) == []
