@@ -284,15 +284,13 @@ def run_suite():
     # (bench/io.py resolves TEXMEX / big-ann / hdf5 layouts under
     # RAFT_TPU_DATA_DIR; no egress on this machine, so presence is up to
     # the operator — the fallback is the siftlike generator)
+    from raft_tpu.bench.datasets import data_dir
     from raft_tpu.bench.io import load_real_dataset
 
     real = None
     if not on_cpu:
         try:
-            real = load_real_dataset(
-                os.environ.get("RAFT_TPU_DATA_DIR", os.path.join(
-                    os.path.expanduser("~"), ".cache", "raft_tpu_data")),
-                "sift", max_rows=N)
+            real = load_real_dataset(data_dir(), "sift", max_rows=N)
         except Exception as e:
             # classified fallback-to-synthetic (the kind disambiguates a
             # transient read from a genuinely absent dataset)

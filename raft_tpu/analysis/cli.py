@@ -6,6 +6,11 @@ Examples::
     python -m raft_tpu.analysis --json raft_tpu/neighbors
     python -m raft_tpu.analysis --list-rules
     python -m raft_tpu.analysis --select mutable-default,banned-api raft_tpu
+    python -m raft_tpu.analysis --rule guarded-state --graph out.json raft_tpu
+
+``--rule`` is an alias for ``--select``; ``--graph`` dumps the repo-wide
+lock-acquisition graph (locks, held->taken edges with example sites,
+cycles, self-deadlocks) as JSON alongside whatever rules run.
 
 Exit codes: 0 = clean (no findings outside the baseline), 1 = new findings,
 2 = bad invocation. ``--write-baseline`` exists for
@@ -53,8 +58,12 @@ def _build_parser() -> argparse.ArgumentParser:
                         "(use scripts/analysis_baseline.py)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit JSON instead of text")
-    p.add_argument("--select", default=None,
+    p.add_argument("--select", "--rule", default=None, dest="select",
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--graph", default=None, metavar="PATH",
+                   help="dump the repo-wide lock-acquisition graph (locks, "
+                        "held->taken edges with example sites, cycles) as "
+                        "JSON to PATH")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -91,6 +100,32 @@ def main(argv=None) -> int:
 
     root = Path(args.root).resolve()
     baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
+
+    if args.graph:
+        import json
+
+        from raft_tpu.analysis.projectgraph import ProjectContext
+        from raft_tpu.analysis.walker import parse_module
+
+        try:
+            files = collect_files(args.paths, root=root)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        contexts = []
+        for path in files:
+            try:
+                contexts.append(parse_module(path, root))
+            except SyntaxError:
+                pass  # the lint pass below reports it as parse-error
+        project = ProjectContext(contexts, root)
+        payload = project.lock_graph_json()
+        Path(args.graph).write_text(
+            json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+        print(f"graftlint: lock graph ({len(payload['locks'])} locks, "
+              f"{len(payload['edges'])} edges, "
+              f"{len(payload['cycles'])} cycle(s)) -> {args.graph}",
+              file=sys.stderr)
 
     t0 = time.monotonic()
     try:

@@ -28,14 +28,30 @@ Catalog (id — what it catches):
   that neither routes through ``resilience.classify()`` nor re-raises
   (the failure class must survive for recovery to see it)
 * ``unused-import``       — dead imports (non-``__init__`` modules)
+
+Concurrency-discipline family (round 19, interprocedural — these consult
+the repo-wide :mod:`~raft_tpu.analysis.projectgraph` built per scan):
+
+* ``guarded-state``       — access to a ``# guarded-by:`` annotated field
+  outside its lock and outside any lock-held-on-entry method
+* ``lock-order``          — cycles in the repo-wide lock-acquisition graph,
+  and non-reentrant self-acquisition
+* ``faultpoint-contract`` — library faultpoints no tier-1 test arms, and
+  arming strings naming nonexistent sites
+* ``env-knob``            — ``RAFT_TPU_*`` knobs missing from the README
+  knob table or defaulted in more than one module
 """
 
 from raft_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
     banned_api,
     bench_io,
+    env_knob,
     exceptions,
+    faultpoint_contract,
+    guarded_state,
     host_sync,
     imports,
+    lock_order,
     mutable_defaults,
     obs_coverage,
     recompile,

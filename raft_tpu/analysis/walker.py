@@ -41,6 +41,9 @@ class ModuleContext:
     lines: List[str]
     imports: Dict[str, str] = field(default_factory=dict)
     _jit: Optional[JitRegions] = None
+    #: the repo-wide ProjectContext for this scan (set by analyze_paths);
+    #: None when a rule is driven over a lone hand-built context
+    project: Optional[object] = None
 
     @property
     def jit(self) -> JitRegions:
@@ -133,18 +136,26 @@ def analyze_paths(paths: Sequence, rules: Optional[Iterable] = None,
     """Run ``rules`` (default: the full registry) over ``paths``."""
     from raft_tpu.analysis.registry import all_rules
 
+    from raft_tpu.analysis.projectgraph import ProjectContext
+
     root = Path(root) if root else Path.cwd()
     active = list(rules) if rules is not None else all_rules()
     findings: List[Finding] = []
+    # two-phase: parse everything first so interprocedural rules see the
+    # whole scan set (call graph, lock table, faultpoint/arming inventory)
+    # through ctx.project, then dispatch rules file by file as before
+    contexts: List[ModuleContext] = []
     for path in collect_files(paths, root):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         try:
-            ctx = parse_module(path, root)
+            contexts.append(parse_module(path, root))
         except SyntaxError as e:
             findings.append(Finding(
                 path=rel, line=e.lineno or 0, rule="parse-error",
                 severity="error", message=f"cannot parse: {e.msg}"))
-            continue
+    project = ProjectContext(contexts, root)
+    for ctx in contexts:
+        ctx.project = project
         for rule in active:
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.line, f.rule):

@@ -27,11 +27,19 @@ import os
 import numpy as np
 
 
-def _cache_dir() -> str:
-    d = os.environ.get(
+def data_dir() -> str:
+    """The dataset root: ``RAFT_TPU_DATA_DIR``, default
+    ``~/.cache/raft_tpu_data`` — the ONE registered default every
+    consumer (bench real-data loaders, cached synthetic sets) resolves
+    through (env-knob drift gate)."""
+    return os.environ.get(
         "RAFT_TPU_DATA_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu_data"),
     )
+
+
+def _cache_dir() -> str:
+    d = data_dir()
     os.makedirs(d, exist_ok=True)
     return d
 

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import time
 from typing import Dict, List
 
@@ -63,10 +62,11 @@ def _load_dataset(spec: Dict):
     if kind == "real":
         # resolve a standard dataset directory (TEXMEX / big-ann / hdf5);
         # errors out rather than silently benching synthetic data
+        from raft_tpu.bench.datasets import data_dir
         from raft_tpu.bench.io import load_real_dataset
 
         found = load_real_dataset(
-            spec.get("root", os.environ.get("RAFT_TPU_DATA_DIR", "")),
+            spec.get("root") or data_dir(),
             spec.get("name", "sift"), spec.get("max_rows"))
         if found is None:
             raise FileNotFoundError(

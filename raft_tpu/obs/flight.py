@@ -183,16 +183,16 @@ class FlightRecorder:
                             else _env_float(INTERVAL_ENV,
                                             _DEFAULT_INTERVAL_S))
         self._ring: deque = deque(
-            maxlen=cap if cap else _env_int(CAP_ENV, _DEFAULT_CAP))
+            maxlen=cap if cap else _env_int(CAP_ENV, _DEFAULT_CAP))  # guarded-by: _lock
         self._ratio = _env_float(RATIO_ENV, _DEFAULT_RATIO)
         self._hot_needed = _env_int(WINDOWS_ENV, _DEFAULT_WINDOWS)
-        self._hot = 0
-        self._straggler_events = 0
-        self._window = 0
-        self._t_last: Optional[float] = None
-        self._prev_ops: Optional[dict] = None
-        self._last_event_t = 0.0
-        self._wrote_handshake = False
+        self._hot = 0                  # guarded-by: _lock
+        self._straggler_events = 0     # guarded-by: _lock
+        self._window = 0               # guarded-by: _lock
+        self._t_last: Optional[float] = None   # guarded-by: _lock
+        self._prev_ops: Optional[dict] = None  # guarded-by: _lock
+        self._last_event_t = 0.0       # guarded-by: _lock
+        self._wrote_handshake = False  # guarded-by: _lock
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop_ev = threading.Event()
@@ -204,11 +204,17 @@ class FlightRecorder:
 
     @property
     def windows_recorded(self) -> int:
-        return self._window if self._enabled else 0
+        if not self._enabled:
+            return 0
+        with self._lock:
+            return self._window
 
     @property
     def straggler_events(self) -> int:
-        return self._straggler_events if self._enabled else 0
+        if not self._enabled:
+            return 0
+        with self._lock:
+            return self._straggler_events
 
     def records(self) -> list:
         """Snapshot of the bounded window ring, oldest first."""
@@ -223,7 +229,9 @@ class FlightRecorder:
         if not self._enabled:
             return None
         now = time.monotonic() if now is None else now
-        if self._t_last is not None and now - self._t_last < self._interval_s:
+        with self._lock:
+            t_last = self._t_last
+        if t_last is not None and now - t_last < self._interval_s:
             return None
         return self.sample(now=now)
 
@@ -297,10 +305,10 @@ class FlightRecorder:
                 lambda: self._ops(snap, rec["interval_s"]), "ops", errors)
             rec["ops"] = ops if ops is not None else {}
             rec["events"] = _classified(
-                self._new_events, "events", errors) or []
+                lambda: self._new_events(), "events", errors) or []
             if self._window == 0:
                 rec["health"] = _classified(
-                    self._health_verdict, "health", errors)
+                    lambda: self._health_verdict(), "health", errors)
             self._straggler_check(rec)
         except Exception as e:
             # the armed-faultpoint path (and any residue the per-provider

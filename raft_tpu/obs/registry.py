@@ -227,10 +227,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict = {}
-        self._timers: dict = {}
-        self._hists: dict = {}
-        self._gauges: dict = {}
+        self._counters: dict = {}  # guarded-by: _lock
+        self._timers: dict = {}    # guarded-by: _lock
+        self._hists: dict = {}     # guarded-by: _lock
+        self._gauges: dict = {}    # guarded-by: _lock
 
     # -- writes -------------------------------------------------------------
     def add(self, name: str, value: float = 1) -> None:

@@ -113,15 +113,15 @@ class ShadowSampler:
         self.seed = int(seed)
         self.timeout_s = float(timeout_s)
         self._lock = threading.Lock()
-        self._pending: deque = deque()
+        self._pending: deque = deque()  # guarded-by: _lock
         self._max_pending = max(1, int(max_pending))
-        self._seq = 0
-        self._matched = 0
-        self._total = 0
-        self._samples = 0
-        self._dropped = 0
-        self._errors = 0
-        self._stale = True  # no data yet: stale until the first sample
+        self._seq = 0      # guarded-by: _lock
+        self._matched = 0  # guarded-by: _lock
+        self._total = 0    # guarded-by: _lock
+        self._samples = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._errors = 0   # guarded-by: _lock
+        self._stale = True  # guarded-by: _lock -- no data yet: stale until the first sample
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
 

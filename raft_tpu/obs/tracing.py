@@ -175,7 +175,7 @@ def _ring_cap() -> int:
     return 4096
 
 
-_SPANS: deque = deque(maxlen=_ring_cap())
+_SPANS: deque = deque(maxlen=_ring_cap())  # guarded-by: _LOCK
 _LOCK = threading.Lock()
 
 
@@ -247,8 +247,8 @@ def exit_span(ids, token, *, name: str, t0: float, dur_s: float,
     return rec
 
 
-#: per-site fleet dispatch counters (``fleet_trace_id``), guarded by _LOCK
-_fleet_ids: dict = {}
+#: per-site fleet dispatch counters (``fleet_trace_id``)
+_fleet_ids: dict = {}  # guarded-by: _LOCK
 
 
 def fleet_trace_id(site: str) -> str:

@@ -76,9 +76,9 @@ class ShardHealth:
         self.min_coverage = (_env_min_coverage() if min_coverage is None
                              else min(max(float(min_coverage), 0.0), 1.0))
         self._lock = threading.Lock()
-        self._states: Dict[int, str] = {}
-        self._strikes: Dict[int, int] = {}
-        self._last_kind: Dict[int, str] = {}
+        self._states: Dict[int, str] = {}     # guarded-by: _lock
+        self._strikes: Dict[int, int] = {}    # guarded-by: _lock
+        self._last_kind: Dict[int, str] = {}  # guarded-by: _lock
 
     # -- queries ------------------------------------------------------------
 
@@ -155,12 +155,12 @@ class ShardHealth:
         with self._lock:
             was = self._states.get(shard, HEALTHY)
             self._states[shard] = LOST
-            self._last_kind.setdefault(shard, FATAL)
+            kind = self._last_kind.setdefault(shard, FATAL)
         if was != LOST:
             obs.add("distributed.shard_lost")
             record_event("shard_lost", site=f"shard[{shard}]",
-                         kind=self._last_kind.get(shard, FATAL),
-                         reason=reason, recovery=RECOVERY_ACTION)
+                         kind=kind, reason=reason,
+                         recovery=RECOVERY_ACTION)
 
     def mark_recovered(self, shard: int) -> None:
         """The shard's data is back (snapshot reload): full reinstatement."""
@@ -196,7 +196,7 @@ class ShardHealth:
 # process-global registry (one mesh per process in practice)
 # ---------------------------------------------------------------------------
 
-_GLOBAL: Optional[ShardHealth] = None
+_GLOBAL: Optional[ShardHealth] = None  # guarded-by: _GLOBAL_LOCK
 _GLOBAL_LOCK = threading.Lock()
 
 

@@ -199,7 +199,7 @@ class QueryQueue:
         # eviction never demotes the tenant whose dispatch it is sizing,
         # and the verdict lands in that tenant's per-tenant counts
         self._tenant = str(tenant)
-        self._hold_until = 0.0
+        self._hold_until = 0.0  # guarded-by: _cv
         self.slo_s = float(slo_s)
         self.max_batch = int(max_batch)
         self.buckets = _buckets(self.max_batch)
@@ -207,14 +207,14 @@ class QueryQueue:
                             else self.slo_s / 2.0)
         self.default_timeout_s = default_timeout_s
         self.pressure_margin_s = float(pressure_margin_s)
-        self._pending: deque = deque()
+        self._pending: deque = deque()  # guarded-by: _cv
         self._cv = threading.Condition()
-        self._lat_ewma: Dict[int, float] = {}  # bucket -> s
-        self._batch_cap = self.max_batch  # halved on OOM
+        self._lat_ewma: Dict[int, float] = {}  # guarded-by: _cv -- bucket -> s
+        self._batch_cap = self.max_batch  # guarded-by: _cv, reads-ok -- halved on OOM
         self._worker: Optional[threading.Thread] = None
-        self._stopping = False
-        self.batches = 0
-        self.multi_batches = 0
+        self._stopping = False  # guarded-by: _cv, reads-ok
+        self.batches = 0        # guarded-by: _cv, reads-ok
+        self.multi_batches = 0  # guarded-by: _cv, reads-ok
 
     # -- intake -------------------------------------------------------------
     def submit(self, query, timeout_s: Optional[float] = None) -> RequestHandle:
@@ -441,8 +441,9 @@ class QueryQueue:
                     # drain classified (never a hang)
                     if obs.enabled():
                         obs.add("serving.capacity.held")
-                    self._hold_until = time.monotonic() + max(
-                        self.pressure_margin_s, 1e-3)
+                    with self._cv:
+                        self._hold_until = time.monotonic() + max(
+                            self.pressure_margin_s, 1e-3)
                     self._requeue_front(batch, count=False)
                     return
                 if verdict_rec["verdict"] == costmodel.REJECT:
@@ -485,11 +486,13 @@ class QueryQueue:
             self._on_dispatch_error(batch, e, resilience.classify(e))
             return
         dt = time.monotonic() - now
-        prev = self._lat_ewma.get(bucket)
-        self._lat_ewma[bucket] = dt if prev is None else 0.7 * prev + 0.3 * dt
-        self.batches += 1
-        if n > 1:
-            self.multi_batches += 1
+        with self._cv:
+            prev = self._lat_ewma.get(bucket)
+            self._lat_ewma[bucket] = (dt if prev is None
+                                      else 0.7 * prev + 0.3 * dt)
+            self.batches += 1
+            if n > 1:
+                self.multi_batches += 1
         if obs.enabled():
             obs.observe("serving.batch_latency_s", dt)
             obs.observe("serving.batch.size", n)
@@ -544,9 +547,10 @@ class QueryQueue:
         if kind == resilience.OOM and self._batch_cap > 1:
             # adaptive degradation: halve the cap and requeue — the next
             # pumps re-dispatch the same requests in smaller batches
-            self._batch_cap = max(1, self._batch_cap // 2)
+            with self._cv:
+                cap = self._batch_cap = max(1, self._batch_cap // 2)
             obs.add("serving.dispatch.oom_halved")
-            record_event("serving_batch_halved", cap=self._batch_cap)
+            record_event("serving_batch_halved", cap=cap)
             self._requeue_front(batch)
             return
         if kind in (resilience.DEADLINE, resilience.TRANSIENT):
@@ -573,7 +577,8 @@ class QueryQueue:
         """Run the scheduler on a daemon worker thread."""
         if self._worker is not None and self._worker.is_alive():
             return
-        self._stopping = False
+        with self._cv:
+            self._stopping = False
         self._worker = threading.Thread(
             target=self._serve_loop, name="raft-tpu-serving", daemon=True)
         self._worker.start()
@@ -592,8 +597,8 @@ class QueryQueue:
         """Stop the worker; by default first drains queued requests."""
         if drain:
             self.drain(timeout=timeout)
-        self._stopping = True
         with self._cv:
+            self._stopping = True
             self._cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=5.0)

@@ -175,23 +175,102 @@ class Tenant:
         self.name = name
         self.kind = kind
         self.snapshot_dir = snapshot_dir
-        self.tier = HOT
-        self.hot_obj = None            # full index / paged store
-        self.warm_index = None         # IvfBqIndex (codes-only twin)
+        # the tenant's own leaf lock: serving threads bump stats while the
+        # promotion worker swaps tiers — every multi-field transition goes
+        # through the mutator methods below. Registration-time writes in
+        # TenantRegistry.register happen before the tenant is published
+        # (construction phase; the registry dict insert is the barrier).
+        self._lock = threading.Lock()
+        self.tier = HOT                # guarded-by: _lock, reads-ok
+        self.hot_obj = None            # guarded-by: _lock, reads-ok -- full index / paged store
+        self.warm_index = None         # guarded-by: _lock, reads-ok -- IvfBqIndex (codes-only twin)
         self.warm_enabled = False      # tenant HAS a warm tier at all
-        self.warm_ids: Optional[np.ndarray] = None  # warm pos -> source id
-        self.hot_bytes = 0             # predicted resident bytes of hot_obj
-        self.warm_bytes = 0            # predicted resident bytes of the twin
-        self.search_fn: Optional[Callable] = None   # hot-dispatch override
-        self.last_served = 0.0         # monotonic; the LRU eviction key
-        self.last_demoted = 0.0
-        self.serves = 0
-        self.degraded_serves = 0
-        self.demotions = 0
-        self.promotions = 0
-        self.verdicts: Dict[str, int] = {}
-        self.outcomes: Dict[str, int] = {}   # ok/rejected/deadline/... counts
-        self.lats: deque = deque(maxlen=256)  # served latencies (s)
+        self.warm_ids: Optional[np.ndarray] = None  # guarded-by: _lock, reads-ok -- warm pos -> id
+        self.hot_bytes = 0             # guarded-by: _lock, reads-ok -- predicted bytes of hot_obj
+        self.warm_bytes = 0            # guarded-by: _lock, reads-ok -- predicted bytes of the twin
+        self.search_fn: Optional[Callable] = None   # guarded-by: _lock, reads-ok
+        self.last_served = 0.0         # guarded-by: _lock, reads-ok -- monotonic; the LRU key
+        self.last_demoted = 0.0        # guarded-by: _lock, reads-ok
+        self.serves = 0                # guarded-by: _lock, reads-ok
+        self.degraded_serves = 0       # guarded-by: _lock, reads-ok
+        self.demotions = 0             # guarded-by: _lock, reads-ok
+        self.promotions = 0            # guarded-by: _lock, reads-ok
+        self.verdicts: Dict[str, int] = {}   # guarded-by: _lock
+        self.outcomes: Dict[str, int] = {}   # guarded-by: _lock -- ok/rejected/... counts
+        self.lats: deque = deque(maxlen=256)  # guarded-by: _lock -- served latencies (s)
+
+    # -- mutators (the only post-publication writers) -----------------------
+
+    def touch(self) -> None:
+        """Stamp the LRU eviction key with 'served now'."""
+        with self._lock:
+            self.last_served = time.monotonic()
+
+    def record_verdict(self, verdict: str) -> None:
+        with self._lock:
+            self.verdicts[verdict] = self.verdicts.get(verdict, 0) + 1
+
+    def record_serve(self, dt: float) -> None:
+        """One successful hot/warm serve: count, outcome, latency sample."""
+        with self._lock:
+            self.serves += 1
+            self.outcomes["ok"] = self.outcomes.get("ok", 0) + 1
+            self.lats.append(dt)
+
+    def record_outcome(self, outcome: str) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    def record_degraded(self) -> None:
+        with self._lock:
+            self.degraded_serves += 1
+
+    def set_search_fn(self, fn: Optional[Callable]) -> None:
+        with self._lock:
+            self.search_fn = fn
+
+    def adopt_warm(self, warm, ids, warm_bytes: int) -> None:
+        """Install loaded warm codes (COLD tenants step up to WARM)."""
+        with self._lock:
+            self.warm_index = warm
+            self.warm_ids = ids
+            self.warm_bytes = int(warm_bytes)
+            if self.tier == COLD:
+                self.tier = WARM
+
+    def adopt_hot(self, hot, hot_bytes: int) -> None:
+        """Install a promoted hot object: tier up + count the promotion."""
+        with self._lock:
+            self.hot_obj = hot
+            self.hot_bytes = int(hot_bytes)
+            self.tier = HOT
+            self.promotions += 1
+
+    def demote_one_tier(self, now: float) -> Optional[dict]:
+        """One atomic tier-down transition; returns the demotion record
+        (None when the tenant already holds nothing). HOT drops the full
+        index (warm codes stay resident — the instant path); WARM drops
+        the codes."""
+        with self._lock:
+            if self.tier == HOT:
+                freed = self.hot_bytes if self.hot_obj is not None else 0
+                self.hot_obj = None
+                to = WARM if self.warm_index is not None else COLD
+                if to == COLD and self.warm_index is not None:
+                    freed += self.warm_bytes
+                    self.warm_index = None
+            elif self.tier == WARM:
+                freed = self.warm_bytes if self.warm_index is not None else 0
+                self.warm_index = None
+                to = COLD
+            else:
+                return None
+            rec = {"tenant": self.name, "from": self.tier, "to": to,
+                   "freed_bytes": int(freed)}
+            self.tier = to
+            self.demotions += 1
+            self.last_demoted = now
+        return rec
 
     @property
     def hot_path(self) -> str:
@@ -209,24 +288,27 @@ class Tenant:
         """Predicted bytes this tenant holds resident at its current tier
         (HOT keeps the warm codes too — the always-resident demotion
         fast path)."""
-        total = 0
-        if self.hot_obj is not None:
-            total += self.hot_bytes
-        if self.warm_index is not None:
-            total += self.warm_bytes
-        return total
+        with self._lock:
+            total = 0
+            if self.hot_obj is not None:
+                total += self.hot_bytes
+            if self.warm_index is not None:
+                total += self.warm_bytes
+            return total
 
     def slo_row(self) -> dict:
         """Per-tenant SLO row: serve counts by outcome + latency
         percentiles over the recent window (the per-tenant half of the
         acceptance's 'per-tenant SLO rows exported')."""
-        row = {
-            "served": int(self.serves),
-            "degraded": int(self.degraded_serves),
-            **{k: int(v) for k, v in sorted(self.outcomes.items())},
-        }
-        if self.lats:
-            lats = np.asarray(self.lats, dtype=np.float64)
+        with self._lock:
+            row = {
+                "served": int(self.serves),
+                "degraded": int(self.degraded_serves),
+                **{k: int(v) for k, v in sorted(self.outcomes.items())},
+            }
+            lats = (np.asarray(self.lats, dtype=np.float64)
+                    if self.lats else None)
+        if lats is not None:
             row["p50_ms"] = round(float(np.percentile(lats, 50)) * 1e3, 3)
             row["p99_ms"] = round(float(np.percentile(lats, 99)) * 1e3, 3)
         return row
@@ -353,7 +435,7 @@ class TenantRegistry:
                 **costmodel.index_layout(warm_index))
         if save_snapshots:
             self._save_snapshots(tenant, index)
-        tenant.last_served = time.monotonic()
+        tenant.touch()
         with self._lock:
             # re-check at insert: a concurrent same-name registration
             # must lose LOUDLY, not silently replace the winner's ledger
@@ -400,7 +482,7 @@ class TenantRegistry:
             return list(self._tenants.values())
 
     def touch(self, name: str) -> None:
-        self.get(name).last_served = time.monotonic()
+        self.get(name).touch()
 
     def resident_bytes(self) -> int:
         """The budgeter's ledger: predicted resident bytes across every
@@ -524,9 +606,7 @@ class CapacityController:
                         rec["demoted"] = [d["tenant"] for d in demoted]
             if tenant:
                 try:
-                    t = self.registry.get(tenant)
-                    t.verdicts[rec["verdict"]] = \
-                        t.verdicts.get(rec["verdict"], 0) + 1
+                    self.registry.get(tenant).record_verdict(rec["verdict"])
                 except KeyError:
                     pass
             if obs.enabled():
@@ -560,24 +640,9 @@ class CapacityController:
         tenant already holds nothing). HOT drops the full index (the warm
         codes stay resident — the instant path); WARM drops the codes."""
         now = time.monotonic()
-        if tenant.tier == HOT:
-            freed = tenant.hot_bytes if tenant.hot_obj is not None else 0
-            tenant.hot_obj = None
-            to = WARM if tenant.warm_index is not None else COLD
-            if to == COLD and tenant.warm_index is not None:
-                freed += tenant.warm_bytes
-                tenant.warm_index = None
-        elif tenant.tier == WARM:
-            freed = tenant.warm_bytes if tenant.warm_index is not None else 0
-            tenant.warm_index = None
-            to = COLD
-        else:
+        rec = tenant.demote_one_tier(now)
+        if rec is None:
             return None
-        rec = {"tenant": tenant.name, "from": tenant.tier, "to": to,
-               "freed_bytes": int(freed)}
-        tenant.tier = to
-        tenant.demotions += 1
-        tenant.last_demoted = now
         with self._lock:
             self._counts["demotions"] += 1
             self._demotion_times.append(now)
@@ -659,7 +724,7 @@ class CapacityController:
             cls = {"ivf_flat": ivf_flat.IvfFlatIndex,
                    "ivf_pq": ivf_pq.IvfPqIndex,
                    "ivf_bq": ivf_bq.IvfBqIndex}[kind]
-            tenant.search_fn = _default_search_fn(kind)
+            tenant.set_search_fn(_default_search_fn(kind))
         return cls.load(tenant.hot_path)
 
     def _load_warm(self, tenant: Tenant) -> None:
@@ -679,12 +744,8 @@ class CapacityController:
         if os.path.exists(tenant.warm_ids_path):
             _, arrays = load_arrays(tenant.warm_ids_path)
             ids = np.asarray(arrays["ids"], dtype=np.int64)
-        tenant.warm_index = warm
-        tenant.warm_ids = ids
-        tenant.warm_bytes = costmodel.predict_index_bytes(
-            **costmodel.index_layout(warm))
-        if tenant.tier == COLD:
-            tenant.tier = WARM
+        tenant.adopt_warm(warm, ids, costmodel.predict_index_bytes(
+            **costmodel.index_layout(warm)))
 
     def promote(self, name: str) -> dict:
         """Restore tenant ``name``'s snapshot to full HOT residency with
@@ -732,15 +793,12 @@ class CapacityController:
                 return {"status": "error", "tenant": name, "tier": prior,
                         "kind": kind, "error": repr(e)[:200]}
             dt = time.perf_counter() - t0
-            tenant.hot_obj = hot
             # re-predict: the restored object can differ from what was
             # registered (a paged-store tenant promotes to its COMPACTED
             # packed snapshot) — a stale ledger entry would mis-project
             # every later admission
-            tenant.hot_bytes = costmodel.predict_index_bytes(
-                **costmodel.index_layout(hot))
-            tenant.tier = HOT
-            tenant.promotions += 1
+            tenant.adopt_hot(hot, costmodel.predict_index_bytes(
+                **costmodel.index_layout(hot)))
             with self._lock:
                 self._counts["promotions"] += 1
                 self._promote_lats.append(dt)
@@ -797,7 +855,7 @@ class CapacityController:
             ids = np.concatenate(
                 [ids, np.full((ids.shape[0], pad), -1, dtype=ids.dtype)],
                 axis=1)
-        tenant.degraded_serves += 1
+        tenant.record_degraded()
         if obs.enabled():
             obs.add("capacity.serves.degraded")
             obs.add(f"capacity.tenant.{tenant.name}.degraded")
@@ -845,8 +903,7 @@ class CapacityController:
                 kind = resilience.classify(e)
                 outcome = REJECTED if isinstance(e, CapacityRejected) \
                     else kind
-                tenant.outcomes[outcome] = \
-                    tenant.outcomes.get(outcome, 0) + 1
+                tenant.record_outcome(outcome)
                 if outcome == REJECTED:
                     with self._lock:
                         self._counts["rejections"] += 1
@@ -857,9 +914,7 @@ class CapacityController:
                              error=repr(e)[:200])
                 raise
             dt = time.monotonic() - t0
-            tenant.serves += 1
-            tenant.outcomes["ok"] = tenant.outcomes.get("ok", 0) + 1
-            tenant.lats.append(dt)
+            tenant.record_serve(dt)
             if obs.enabled():
                 obs.observe("capacity.serve_latency_s", dt)
                 if result.degraded:

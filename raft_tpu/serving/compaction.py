@@ -96,12 +96,16 @@ class CompactionManager:
         self.interval_s = float(interval_s if interval_s is not None
                                 else _env_float(COMPACT_INTERVAL_ENV,
                                                 _DEFAULT_INTERVAL_S))
-        self.cycles = 0          # completed (swapped) cycles
-        self.stale_swaps = 0     # aborted swaps (mutation raced the fold)
-        self.failures = 0        # classified cycle failures
-        self.last_status: Optional[str] = None
-        self.last_duration_s: Optional[float] = None
-        self.tombstone_ratio_peak = 0.0
+        # counter plane: mutated by whichever thread wins _busy (and by
+        # should_compact from ANY caller), read by stats() from serving
+        # threads — its own leaf lock, never held across store calls
+        self._stats_lock = threading.Lock()
+        self.cycles = 0          # guarded-by: _stats_lock, reads-ok
+        self.stale_swaps = 0     # guarded-by: _stats_lock, reads-ok
+        self.failures = 0        # guarded-by: _stats_lock, reads-ok
+        self.last_status: Optional[str] = None      # guarded-by: _stats_lock, reads-ok
+        self.last_duration_s: Optional[float] = None  # guarded-by: _stats_lock, reads-ok
+        self.tombstone_ratio_peak = 0.0  # guarded-by: _stats_lock, reads-ok
         self._busy = threading.Lock()
         self._worker: Optional[threading.Thread] = None
         self._stopping = False
@@ -110,8 +114,9 @@ class CompactionManager:
     def should_compact(self) -> bool:
         """True when the store's tombstone load crosses the trigger."""
         ratio = self.store.tombstone_ratio
-        if ratio > self.tombstone_ratio_peak:
-            self.tombstone_ratio_peak = ratio
+        with self._stats_lock:
+            if ratio > self.tombstone_ratio_peak:
+                self.tombstone_ratio_peak = ratio
         return (self.store.tombstones >= self.min_tombstones
                 and ratio > self.ratio)
 
@@ -149,29 +154,33 @@ class CompactionManager:
                     swapped = store.compact_swap(packed, v0)
         except Exception as e:
             kind = resilience.classify(e)
-            self.failures += 1
-            self.last_status = kind
-            self.last_duration_s = time.perf_counter() - t0
+            with self._stats_lock:
+                self.failures += 1
+                self.last_status = kind
+                self.last_duration_s = time.perf_counter() - t0
             obs.add(f"serving.compact.{kind.lower()}")
             record_event("serving_compact_error", kind=kind,
                          tombstones=tombstones0, error=repr(e)[:200])
             return {"status": kind, "tombstones": tombstones0,
                     "duration_s": self.last_duration_s}
         dt = time.perf_counter() - t0
-        self.last_duration_s = dt
         if not swapped:
             # a mutation landed between the snapshot and the swap: the
             # cycle's work is discarded, nothing changed, the next pump
             # retries against the new version — classified, never silent
-            self.stale_swaps += 1
-            self.last_status = "stale"
+            with self._stats_lock:
+                self.last_duration_s = dt
+                self.stale_swaps += 1
+                self.last_status = "stale"
             obs.add("serving.compact.stale")
             record_event("serving_compact_stale", tombstones=tombstones0,
                          version=v0)
             return {"status": "stale", "tombstones": tombstones0,
                     "duration_s": dt}
-        self.cycles += 1
-        self.last_status = "ok"
+        with self._stats_lock:
+            self.last_duration_s = dt
+            self.cycles += 1
+            self.last_status = "ok"
         if obs.enabled():
             obs.add("serving.compact.cycles")
             obs.observe("serving.compact.duration_s", dt)
@@ -215,14 +224,16 @@ class CompactionManager:
             self._worker = None
 
     def stats(self) -> dict:
-        return {
-            "cycles": self.cycles,
-            "stale_swaps": self.stale_swaps,
-            "failures": self.failures,
-            "last_status": self.last_status,
-            "last_duration_s": self.last_duration_s,
-            "tombstone_ratio": self.store.tombstone_ratio,
-            "tombstone_ratio_peak": round(self.tombstone_ratio_peak, 4),
-            "ratio_threshold": self.ratio,
-            "deadline_s": self.deadline_s,
-        }
+        ratio = self.store.tombstone_ratio  # store call OUTSIDE the lock
+        with self._stats_lock:
+            return {
+                "cycles": self.cycles,
+                "stale_swaps": self.stale_swaps,
+                "failures": self.failures,
+                "last_status": self.last_status,
+                "last_duration_s": self.last_duration_s,
+                "tombstone_ratio": ratio,
+                "tombstone_ratio_peak": round(self.tombstone_ratio_peak, 4),
+                "ratio_threshold": self.ratio,
+                "deadline_s": self.deadline_s,
+            }

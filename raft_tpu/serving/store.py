@@ -198,18 +198,23 @@ class PagedListStore:
         n_lists = int(self.centers.shape[0])
         cap = max(8, _pow2_at_least(initial_pages or n_lists))
         R = self.page_rows
-        self.pages = jnp.zeros((cap, R, payload_width), payload_dtype)
-        self.page_ids = jnp.full((cap, R), -1, jnp.int32)
+        # Device pools are IMMUTABLE arrays reassigned whole under _lock;
+        # off-lock reads (dtype/shape probes, snapshot references) see a
+        # consistent old-or-new array — hence reads-ok. The host tables
+        # below them are mutated IN PLACE and carry no reads-ok: every
+        # read must hold the lock (or come through a locked snapshot).
+        self.pages = jnp.zeros((cap, R, payload_width), payload_dtype)  # guarded-by: _lock, reads-ok
+        self.page_ids = jnp.full((cap, R), -1, jnp.int32)  # guarded-by: _lock, reads-ok
         # aux init +inf: matches the packed b_sum's +inf-at-padding
         # convention (the flat scan masks on ids, so +inf is inert there)
-        self.page_aux = jnp.full((cap, R), jnp.inf, jnp.float32)
+        self.page_aux = jnp.full((cap, R), jnp.inf, jnp.float32)  # guarded-by: _lock, reads-ok
         # scan-bias pool for the paged Pallas engines: +inf everywhere a
         # row is absent/dead, the per-row additive term where live
-        self.page_bias = jnp.full((cap, R), jnp.inf, jnp.float32)
+        self.page_bias = jnp.full((cap, R), jnp.inf, jnp.float32)  # guarded-by: _lock, reads-ok
         # kind-specific second pool: PQ int8 decoded-residual cache rows
         # (the strip kernel's MXU operand), BQ per-row RaBitQ scale
-        self.page_cache = None
-        self.page_scale = None
+        self.page_cache = None  # guarded-by: _lock, reads-ok
+        self.page_scale = None  # guarded-by: _lock, reads-ok
         if kind == "ivf_pq":
             dsub = int(self.codebooks.shape[2])
             self._cache_dim = self.pq_dim * dsub
@@ -221,17 +226,17 @@ class PagedListStore:
         elif kind == "ivf_bq":
             self.page_scale = jnp.zeros((cap, R), jnp.float32)
 
-        self._table = np.full((n_lists, 4), -1, np.int32)
-        self._list_pages = np.zeros(n_lists, np.int32)  # chain length
-        self._fill = np.zeros(cap, np.int32)  # rows ever appended per page
-        self._page_list = np.full(cap, -1, np.int32)  # owning list, -1 free
-        self._free: List[int] = list(range(cap))
-        self._id_loc: Dict[int, Tuple[int, int]] = {}
-        self._tombstones = 0
-        self._dev_table = None  # device mirror, invalidated on table change
-        self._dev_lens = None   # device chain-length mirror (paged Pallas)
-        self._version = 0       # bumped on every committed mutation
-        self._growths = 0
+        self._table = np.full((n_lists, 4), -1, np.int32)  # guarded-by: _lock
+        self._list_pages = np.zeros(n_lists, np.int32)  # guarded-by: _lock -- chain length
+        self._fill = np.zeros(cap, np.int32)  # guarded-by: _lock -- rows ever appended per page
+        self._page_list = np.full(cap, -1, np.int32)  # guarded-by: _lock -- owning list, -1 free
+        self._free: List[int] = list(range(cap))  # guarded-by: _lock
+        self._id_loc: Dict[int, Tuple[int, int]] = {}  # guarded-by: _lock
+        self._tombstones = 0  # guarded-by: _lock
+        self._dev_table = None  # guarded-by: _lock -- device mirror, invalidated on table change
+        self._dev_lens = None   # guarded-by: _lock -- device chain-length mirror (paged Pallas)
+        self._version = 0       # guarded-by: _lock -- bumped on every committed mutation
+        self._growths = 0       # guarded-by: _lock
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -269,13 +274,17 @@ class PagedListStore:
             store._ingest_packed(index)
         return store
 
-    def _ingest_packed(self, index) -> None:
+    def _ingest_packed(self, index) -> None:  # holds: _lock
         """Bulk-append the packed index's live rows, per-list in slot
         order (the arrival order a from-scratch upsert stream would have
         produced). Payloads, aux, scan bias and the kind-specific extra
         pool rows are copied (or derived exactly the way the packed scan
         derives them), not recomputed: the packed build's values ARE the
-        parity reference."""
+        parity reference.
+
+        Callers own exclusivity: both call sites (``from_index``,
+        ``compact_swap``'s staging clone) ingest into a store no other
+        thread can see yet — construction-phase, declared via ``holds``."""
         extra2 = None
         if self.kind == "ivf_flat":
             payload3, ids2 = index.list_data, index.list_ids
@@ -337,25 +346,30 @@ class PagedListStore:
     @property
     def size(self) -> int:
         """Live (non-tombstoned) rows."""
-        return len(self._id_loc)
+        with self._lock:
+            return len(self._id_loc)
 
     @property
     def tombstones(self) -> int:
-        return self._tombstones
+        with self._lock:
+            return self._tombstones
 
     @property
     def pages_used(self) -> int:
-        return self.capacity_pages - len(self._free)
+        with self._lock:
+            return self.capacity_pages - len(self._free)
 
     @property
     def table_width(self) -> int:
-        return int(self._table.shape[1])
+        with self._lock:
+            return int(self._table.shape[1])
 
     @property
     def growth_events(self) -> int:
         """Capacity growths (page pool or table width) since creation —
         each one retraces the scan; steady-state serving should hold at 0."""
-        return self._growths
+        with self._lock:
+            return self._growths
 
     @property
     def mutation_version(self) -> int:
@@ -627,22 +641,27 @@ class PagedListStore:
         if self.metric == "cosine":
             work = work / jnp.maximum(
                 jnp.linalg.norm(work, axis=1, keepdims=True), 1e-30)
-        if ids is None:
-            start = (max(self._id_loc) + 1) if self._id_loc else 0
-            ids_np = np.arange(start, start + n, dtype=np.int64)
-        else:
+        if ids is not None:
             ids_np = np.asarray(ids, np.int64)
             if ids_np.shape != (n,):
                 raise ValueError(f"ids must be ({n},), got {ids_np.shape}")
             if len(set(ids_np.tolist())) != n:
                 raise ValueError("duplicate ids within one upsert batch")
-        if n and (ids_np.min() < 0 or ids_np.max() >= 2**31 - 1):
-            raise ValueError("ids must fit int32 and be >= 0")
+            if ids_np.min() < 0 or ids_np.max() >= 2**31 - 1:
+                raise ValueError("ids must fit int32 and be >= 0")
 
         labels_np = self._assign_labels(work)
         payload, aux, bias, extra = self._prepare_payload(work, labels_np)
 
         with self._lock:
+            if ids is None:
+                # auto-id generation INSIDE the lock: reading max(_id_loc)
+                # before it races a concurrent upsert into minting the
+                # same ids twice (silent replacement of the other batch)
+                start = (max(self._id_loc) + 1) if self._id_loc else 0
+                ids_np = np.arange(start, start + n, dtype=np.int64)
+                if ids_np.max() >= 2**31 - 1:
+                    raise ValueError("ids must fit int32 and be >= 0")
             # replaced ids: capture the OLD slots now, tombstone them only
             # AFTER the append lands — tombstoning first would turn a
             # failed append (FATAL fault, dispatch error) into silent data
@@ -888,17 +907,23 @@ class PagedListStore:
         capacity and table width — the staging target a background
         compaction repages into before the atomic swap (same capacity ⇒
         same operand shapes ⇒ the swap never retraces the scans)."""
+        with self._lock:
+            # one consistent (pool, capacity, width) triple — unlocked
+            # property reads could pair a post-growth width with a
+            # pre-growth capacity and stage a retracing clone
+            pages = self.pages
+            cap = self.capacity_pages
+            width = self.table_width
         clone = PagedListStore(
             self.kind, self.centers, self.metric, page_rows=self.page_rows,
-            payload_width=int(self.pages.shape[2]),
-            payload_dtype=self.pages.dtype, rotation=self.rotation,
+            payload_width=int(pages.shape[2]),
+            payload_dtype=pages.dtype, rotation=self.rotation,
             codebooks=self.codebooks, pq_bits=self.pq_bits,
             pq_dim=self.pq_dim, codebook_kind=self.codebook_kind,
             bq_bits=self.bq_bits, rotation_kind=self.rotation_kind,
-            initial_pages=self.capacity_pages, res=self._res)
-        if clone.table_width < self.table_width:
-            clone._table = np.full((self.n_lists, self.table_width), -1,
-                                   np.int32)
+            initial_pages=cap, res=self._res)
+        if clone.table_width < width:
+            clone._table = np.full((self.n_lists, width), -1, np.int32)
         return clone
 
     _SWAP_FIELDS = ("pages", "page_ids", "page_aux", "page_bias",

@@ -14,8 +14,11 @@ cd "$(dirname "$0")/.."
 fail=0
 
 echo "== graftlint (raft_tpu.analysis) =="
+# full rule set (incl. the ISSUE 17 interprocedural concurrency rules:
+# guarded-state, lock-order, faultpoint-contract, env-knob); --graph drops
+# the repo-wide lock-acquisition graph as an inspectable artifact
 JAX_PLATFORMS=cpu python -m raft_tpu.analysis raft_tpu tests bench.py scripts \
-    || fail=1
+    --graph /tmp/_check_lock_graph.json || fail=1
 
 echo
 echo "== bench_compare (BENCH_r04 → BENCH_r05 trajectory diff) =="

@@ -573,6 +573,98 @@ def extract_frontier(records):
         return {}
 """,
     ),
+    # ISSUE 17: the deliberately racy two-thread fixture the guarded-state
+    # rule MUST flag — two serving threads bump an annotated counter with
+    # no lock (record is passed as a Thread target, so the held-on-entry
+    # fixed point has no dominated call site to infer from)
+    (
+        "guarded-state",
+        "raft_tpu/serving/window.py",
+        """
+import threading
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0  # guarded-by: _lock
+
+    def record(self):
+        self._hits += 1
+
+    def run(self):
+        workers = [threading.Thread(target=self.record) for _ in range(2)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+""",
+        # near-miss: same shape, mutation locked; plus a reads-ok field
+        # whose unlocked snapshot read is the tolerated escape pattern
+        """
+import threading
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0       # guarded-by: _lock
+        self._last = 0.0     # guarded-by: _lock, reads-ok
+
+    def record(self, now):
+        with self._lock:
+            self._hits += 1
+            self._last = now
+
+    def last_seen(self):
+        return self._last
+
+    def run(self):
+        workers = [threading.Thread(target=self.record, args=(1.0,))
+                   for _ in range(2)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+""",
+    ),
+    # ISSUE 17: the two-lock cycle fixture for lock-order — transfer takes
+    # A then B while audit takes B then A; some interleaving deadlocks
+    (
+        "lock-order",
+        "raft_tpu/serving/ledger.py",
+        """
+import threading
+
+_ACCOUNTS = threading.Lock()
+_AUDIT = threading.Lock()
+
+def transfer(ledger, rec):
+    with _ACCOUNTS:
+        with _AUDIT:
+            ledger.append(rec)
+
+def audit(ledger):
+    with _AUDIT:
+        with _ACCOUNTS:
+            return list(ledger)
+""",
+        # near-miss: both paths impose the same global order
+        """
+import threading
+
+_ACCOUNTS = threading.Lock()
+_AUDIT = threading.Lock()
+
+def transfer(ledger, rec):
+    with _ACCOUNTS:
+        with _AUDIT:
+            ledger.append(rec)
+
+def audit(ledger):
+    with _ACCOUNTS:
+        with _AUDIT:
+            return list(ledger)
+""",
+    ),
 ]
 
 
@@ -581,6 +673,20 @@ def _run_fixture(tmp_path, relpath, source):
     target.parent.mkdir(parents=True, exist_ok=True)
     target.write_text(source)
     return analyze_paths([target], root=tmp_path)
+
+
+def _run_tree(tmp_path, files):
+    """Multi-file fixture runner for the interprocedural rules: writes every
+    {relpath: source} under ``tmp_path`` and scans the .py files against it
+    as root (non-.py entries — a fixture README — shape the tree only)."""
+    targets = []
+    for relpath, source in files.items():
+        target = tmp_path / relpath
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(source)
+        if relpath.endswith(".py"):
+            targets.append(target)
+    return analyze_paths(targets, root=tmp_path)
 
 
 @pytest.mark.parametrize(
@@ -597,6 +703,144 @@ def test_rule_fixtures(tmp_path, rule_id, relpath, positive, negative):
     assert not any(f.rule == rule_id for f in misses), \
         f"{rule_id}: near-miss fixture wrongly produced " \
         f"{[f for f in misses if f.rule == rule_id]!r}"
+
+
+def test_faultpoint_contract_both_directions(tmp_path):
+    """The faultpoint-contract rule needs lib AND tests in one scan, so it
+    lives outside the single-file FIXTURES table: an unarmed library
+    faultpoint is loud, a stale arming string is loud, and the matched
+    pair is silent."""
+    lib = """
+from raft_tpu import resilience
+
+def drain(batch):
+    resilience.faultpoint("pump.drain")
+    return list(batch)
+"""
+    armed = """
+from raft_tpu import resilience
+
+def test_drain_recovers():
+    resilience.arm_faults("pump.drain=transient:1")
+"""
+    bystander = """
+def test_unrelated():
+    assert True
+"""
+    # library faultpoint nobody arms -> loud, anchored at the lib site
+    hits = _run_tree(tmp_path / "unarmed", {
+        "raft_tpu/serving/pump.py": lib,
+        "tests/test_pump.py": bystander,
+    })
+    hits = [f for f in hits if f.rule == "faultpoint-contract"]
+    assert len(hits) == 1 and "pump.drain" in hits[0].message, hits
+    assert hits[0].path.endswith("pump.py")
+
+    # arming string naming a site no library file declares -> loud, anchored
+    # at the test (the stale test silently stopped testing anything)
+    stale = _run_tree(tmp_path / "stale", {
+        "raft_tpu/serving/pump.py": "def drain(batch):\n    return list(batch)\n",
+        "tests/test_pump.py": armed,
+    })
+    stale = [f for f in stale if f.rule == "faultpoint-contract"]
+    assert len(stale) == 1 and stale[0].path.endswith("test_pump.py"), stale
+
+    # matched contract -> silent
+    misses = _run_tree(tmp_path / "armed", {
+        "raft_tpu/serving/pump.py": lib,
+        "tests/test_pump.py": armed,
+    })
+    assert not any(f.rule == "faultpoint-contract" for f in misses), misses
+
+
+def test_env_knob_double_default(tmp_path):
+    """Two modules each supplying a default for the same knob is the drift
+    class the rule exists for; routing one consumer through the other's
+    registered default is the fix shape and must be silent."""
+    registered = """
+import os
+
+def cap():
+    return int(os.environ.get("RAFT_TPU_FIX_CAP", "8"))
+"""
+    twin = """
+import os
+
+def cap():
+    return int(os.getenv("RAFT_TPU_FIX_CAP", "8"))
+"""
+    hits = _run_tree(tmp_path / "pos", {
+        "raft_tpu/alpha.py": registered,
+        "raft_tpu/beta.py": twin,
+    })
+    drift = [f for f in hits if f.rule == "env-knob"]
+    assert drift and all("more than one" in f.message for f in drift), hits
+
+    misses = _run_tree(tmp_path / "neg", {
+        "raft_tpu/alpha.py": registered,
+        "raft_tpu/beta.py": (
+            "from raft_tpu.alpha import cap\n\n\n"
+            "def twice():\n    return 2 * cap()\n"),
+    })
+    assert not any(f.rule == "env-knob" for f in misses), misses
+
+
+def test_env_knob_readme_documentation(tmp_path):
+    """A knob read that never appears in a README table row at the scan
+    root is loud; the documented near-miss is silent; and a tree with NO
+    README (every other fixture here) skips the documentation check."""
+    src = """
+import os
+
+def cap():
+    return int(os.environ.get("RAFT_TPU_FIX_CAP", "8"))
+"""
+    table_without = "| `RAFT_TPU_OTHER` | `1` | some other knob |\n"
+    table_with = table_without + \
+        "| `RAFT_TPU_FIX_CAP` | `8` | fixture capacity knob |\n"
+    hits = _run_tree(tmp_path / "pos", {
+        "raft_tpu/alpha.py": src,
+        "README.md": table_without,
+    })
+    undoc = [f for f in hits if f.rule == "env-knob"]
+    assert len(undoc) == 1 and "no README knob-table row" in undoc[0].message, \
+        hits
+    misses = _run_tree(tmp_path / "neg", {
+        "raft_tpu/alpha.py": src,
+        "README.md": table_with,
+    })
+    assert not any(f.rule == "env-knob" for f in misses), misses
+
+
+def test_guarded_state_lock_graph_dump(tmp_path):
+    """`--graph out.json` writes the lock-acquisition graph artifact the
+    ISSUE pins: nodes, edges with held/taken/site, and cycles."""
+    src = """
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+def forward(items):
+    with _A:
+        with _B:
+            return list(items)
+
+def backward(items):
+    with _B:
+        with _A:
+            return list(items)
+"""
+    mod = tmp_path / "m.py"
+    mod.write_text(src)
+    out = tmp_path / "lock_graph.json"
+    rc = cli_main([str(mod), "--root", str(tmp_path),
+                   "--select", "lock-order", "--graph", str(out)])
+    assert rc == 1  # the cycle is a finding AND the artifact still lands
+    data = json.loads(out.read_text())
+    locks = {n for e in data["edges"] for n in (e["held"], e["taken"])}
+    assert {"m.py::_A", "m.py::_B"} <= locks, data
+    assert data["cycles"], data
 
 
 def test_shard_map_body_is_a_traced_region(tmp_path):

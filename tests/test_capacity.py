@@ -24,6 +24,7 @@ Tier-1 contracts:
   ``rejected`` verdict, and ``obs.report`` counts it as known residue.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -683,3 +684,47 @@ def test_pq_tenant_without_raw_rows_demotes_to_cold(tmp_path, rng):
     assert t.tier == cap.HOT and t.warm_index is None
     ctrl.demote("pq")
     assert t.tier == cap.COLD
+
+
+# ---------------------------------------------------------------------------
+# Tenant mutator thread-safety (ISSUE 17 guarded-state fixes)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_mutators_survive_concurrent_serving(tmp_path):
+    """Four serving threads hammer the stat mutators while a fifth swaps
+    tiers through adopt_hot/demote_one_tier — the guarded-state fix moved
+    every one of these multi-field transitions under the tenant's leaf
+    lock, so each counter must land exact (no lost increments) and each
+    tier swap atomic."""
+    tenant = cap.Tenant("hammer", "ivf_flat", str(tmp_path))
+    n, swaps = 300, 50
+
+    def serve():
+        for _ in range(n):
+            tenant.touch()
+            tenant.record_serve(0.001)
+            tenant.record_outcome("rejected")
+            tenant.record_verdict("ADMIT")
+            tenant.record_degraded()
+
+    def swap():
+        for i in range(swaps):
+            tenant.adopt_hot(object(), 64)
+            tenant.demote_one_tier(float(i))
+
+    threads = [threading.Thread(target=serve) for _ in range(4)]
+    threads.append(threading.Thread(target=swap))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert tenant.serves == 4 * n
+    assert tenant.outcomes == {"ok": 4 * n, "rejected": 4 * n}
+    assert tenant.verdicts == {"ADMIT": 4 * n}
+    assert tenant.degraded_serves == 4 * n
+    assert tenant.promotions == swaps
+    assert tenant.demotions == swaps
+    assert tenant.tier in (cap.HOT, cap.WARM, cap.COLD)
+    assert tenant.last_served > 0.0

@@ -1,0 +1,217 @@
+"""Faultpoint-contract coverage: every library faultpoint armed in tier-1.
+
+The ``faultpoint-contract`` graftlint rule (raft_tpu/analysis) cross-
+references every ``resilience.faultpoint("site")`` in library code against
+the arming strings tier-1 tests pass through ``RAFT_TPU_FAULTS`` /
+``resilience.arm_faults`` — a faultpoint nobody arms is a recovery path
+nobody exercises. This module is the arming side for the sites the rest of
+the suite does not already cover: each test arms the site, proves the
+injected failure surfaces CLASSIFIED (never a silent pass, never an
+unclassified crash), and proves the entry point works normally once the
+fault is consumed — the site stays live AND harmless.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import resilience
+from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def _data(rng, n=600, dim=16, q=8):
+    return (rng.normal(size=(n, dim)).astype(np.float32),
+            rng.normal(size=(q, dim)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# clustering
+# ---------------------------------------------------------------------------
+
+def test_kmeans_fit_em_faultpoint(rng):
+    """``kmeans.fit.em`` sits at the n_init restart checkpoint: an armed
+    transient surfaces classified from fit(), and the next fit (fault
+    consumed) converges normally."""
+    from raft_tpu.cluster import kmeans
+
+    X, _ = _data(rng, n=400)
+    resilience.arm_faults("kmeans.fit.em=transient:1")
+    with pytest.raises(Exception) as ei:
+        kmeans.fit(X, kmeans.KMeansParams(n_clusters=8, max_iter=5))
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    out = kmeans.fit(X, kmeans.KMeansParams(n_clusters=8, max_iter=5))
+    assert np.asarray(out.centroids).shape == (8, X.shape[1])
+
+
+def test_kmeans_balanced_fit_em_faultpoint(rng):
+    """``kmeans_balanced.fit.em`` guards the single long balanced-EM
+    dispatch — the host checkpoint a cancel or injected failure lands on."""
+    from raft_tpu.cluster import kmeans_balanced
+
+    X, _ = _data(rng, n=400)
+    params = kmeans_balanced.KMeansBalancedParams(n_iters=4)
+    resilience.arm_faults("kmeans_balanced.fit.em=fatal:1")
+    with pytest.raises(Exception) as ei:
+        kmeans_balanced.fit(X, 8, params)
+    assert resilience.classify(ei.value) == resilience.FATAL
+    centers = kmeans_balanced.fit(X, 8, params)
+    assert np.asarray(centers).shape == (8, X.shape[1])
+
+
+# ---------------------------------------------------------------------------
+# cagra
+# ---------------------------------------------------------------------------
+
+def _cagra_index(rng):
+    from raft_tpu.neighbors import cagra
+
+    X, _ = _data(rng, n=500)
+    return cagra, X, cagra.CagraParams(
+        graph_degree=8, intermediate_graph_degree=16)
+
+
+def test_cagra_build_faultpoint(rng):
+    """``cagra.build`` is the build entry's injectable failure: armed OOM
+    classifies; the disarmed rebuild produces a servable graph."""
+    cagra, X, params = _cagra_index(rng)
+    resilience.arm_faults("cagra.build=oom:1")
+    with pytest.raises(Exception) as ei:
+        cagra.build(X, params)
+    assert resilience.classify(ei.value) == resilience.OOM
+    idx = cagra.build(X, params)
+    assert idx.graph_degree == 8
+
+
+def test_cagra_search_faultpoint(rng):
+    """``cagra.search`` fires before the tile loop: an armed transient
+    surfaces classified, and the retried search matches the unarmed run
+    exactly (the fault left no partial state behind)."""
+    cagra, X, params = _cagra_index(rng)
+    idx = cagra.build(X, params)
+    Q = np.asarray(rng.normal(size=(32, X.shape[1])), np.float32)
+    sp = cagra.CagraSearchParams(itopk_size=32)
+    gt_v, gt_i = cagra.search(idx, Q, 5, sp)
+    resilience.arm_faults("cagra.search=transient:1")
+    with pytest.raises(Exception) as ei:
+        cagra.search(idx, Q, 5, sp)
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    resilience.clear_faults()
+    v, i = cagra.search(idx, Q, 5, sp)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(gt_i))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(gt_v),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packed ivf scans
+# ---------------------------------------------------------------------------
+
+def test_ivf_flat_search_scan_faultpoint(rng):
+    X, Q = _data(rng)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8))
+    resilience.arm_faults("ivf_flat.search.scan=oom:1")
+    with pytest.raises(Exception) as ei:
+        ivf_flat.search(idx, Q, 5, n_probes=4)
+    assert resilience.classify(ei.value) == resilience.OOM
+    v, i = ivf_flat.search(idx, Q, 5, n_probes=4)
+    assert np.asarray(i).shape == (Q.shape[0], 5)
+
+
+def test_ivf_pq_search_scan_faultpoint(rng):
+    X, Q = _data(rng)
+    idx = ivf_pq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8))
+    resilience.arm_faults("ivf_pq.search.scan=transient:1")
+    with pytest.raises(Exception) as ei:
+        ivf_pq.search(idx, Q, 5, n_probes=4)
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    v, i = ivf_pq.search(idx, Q, 5, n_probes=4)
+    assert np.asarray(i).shape == (Q.shape[0], 5)
+
+
+# ---------------------------------------------------------------------------
+# paged scans (serving stores)
+# ---------------------------------------------------------------------------
+
+def test_ivf_pq_search_paged_scan_faultpoint(rng):
+    """Both ``ivf_pq.search_paged.scan`` dispatch branches (fused and
+    gather) share the site name — one arming proves the paged entry
+    classifies rather than crashing mid-scan."""
+    from raft_tpu import serving
+
+    X, Q = _data(rng)
+    idx = ivf_pq.build(X, ivf_pq.IvfPqParams(n_lists=8, pq_dim=8))
+    store = serving.PagedListStore.from_index(idx, page_rows=32)
+    resilience.arm_faults("ivf_pq.search_paged.scan=oom:1")
+    with pytest.raises(Exception) as ei:
+        ivf_pq.search_paged(store, Q, 5, n_probes=4)
+    assert resilience.classify(ei.value) == resilience.OOM
+    resilience.clear_faults()
+    v, i = ivf_pq.search_paged(store, Q, 5, n_probes=4)
+    assert np.asarray(i).shape == (Q.shape[0], 5)
+
+
+def test_ivf_bq_search_paged_scan_faultpoint(rng):
+    from raft_tpu import serving
+
+    X, Q = _data(rng)
+    idx = ivf_bq.build(X, ivf_bq.IvfBqParams(n_lists=8))
+    store = serving.PagedListStore.from_index(idx, page_rows=32)
+    resilience.arm_faults("ivf_bq.search_paged.scan=oom:1")
+    with pytest.raises(Exception) as ei:
+        ivf_bq.search_paged(store, Q, 5, n_probes=4)
+    assert resilience.classify(ei.value) == resilience.OOM
+    resilience.clear_faults()
+    v, i = ivf_bq.search_paged(store, Q, 5, n_probes=4)
+    assert np.asarray(i).shape == (Q.shape[0], 5)
+
+
+# ---------------------------------------------------------------------------
+# distributed phases (8-virtual-device mesh, conftest pattern)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def comms():
+    from raft_tpu.comms import Comms, local_mesh
+
+    return Comms(local_mesh(8))
+
+
+def test_distributed_assign_phase_faultpoint(comms):
+    """``distributed.assign_phase`` guards the sharded coarse-assignment
+    dispatch inside the MNMG ivf builds."""
+    from raft_tpu.distributed import ivf_flat as divf
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((4000, 16)).astype(np.float32)
+    resilience.arm_faults("distributed.assign_phase=transient:1")
+    with pytest.raises(Exception) as ei:
+        divf.build(X, divf.IvfFlatParams(n_lists=16), comms=comms)
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    resilience.clear_faults()
+    idx = divf.build(X, divf.IvfFlatParams(n_lists=16), comms=comms)
+    assert idx.n_total == 4000
+
+
+def test_distributed_tiled_search_tile_faultpoint(comms):
+    """``distributed.tiled_search.tile`` is the per-tile checkpoint of the
+    MNMG search loop: the injected failure lands between tile dispatches,
+    classified, and the retried search serves full coverage."""
+    from raft_tpu.distributed import ivf_flat as divf
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((4000, 16)).astype(np.float32)
+    Q = rng.standard_normal((16, 16)).astype(np.float32)
+    idx = divf.build(X, divf.IvfFlatParams(n_lists=16), comms=comms)
+    resilience.arm_faults("distributed.tiled_search.tile=oom:1")
+    with pytest.raises(Exception) as ei:
+        divf.search(idx, Q, 10, n_probes=16)
+    assert resilience.classify(ei.value) == resilience.OOM
+    resilience.clear_faults()
+    v, i = divf.search(idx, Q, 10, n_probes=16)
+    assert np.asarray(i).shape == (16, 10)

@@ -433,3 +433,50 @@ def test_streaming_bench_records_frontier_end_to_end(
     assert cli_frontier["pareto_points"] >= 1
     groups = {g["fp"] for g in cli_frontier["groups"]}
     assert groups == fps  # grouped BY fingerprint, all of them
+
+
+# ---------------------------------------------------------------------------
+# sampler vs span-feeder race (ISSUE 17 guarded-state fixes)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_and_span_feeders_race_cleanly(telemetry, tmp_path):
+    """Two forced samplers race two span feeders: every window lands
+    exactly once (the window counter, ring append, and helper reads all
+    sit under the recorder lock — the guarded-state fix routed the event
+    and health helpers through visible call sites inside it) and no
+    window degrades."""
+    import threading
+
+    path = str(tmp_path / "race.jsonl")
+    rec = flight.FlightRecorder(path, knobs={"algo": "x"},
+                                interval_s=1e9, cap=64)
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            with obs.record_span("serving.queue::serve"):
+                obs.add("flight.fixture_feed")
+
+    def pump(k):
+        for _ in range(k):
+            rec.sample()
+
+    feeders = [threading.Thread(target=feed) for _ in range(2)]
+    pumps = [threading.Thread(target=pump, args=(10,)) for _ in range(2)]
+    for t in feeders + pumps:
+        t.start()
+    for t in pumps:
+        t.join()
+    stop.set()
+    for t in feeders:
+        t.join()
+
+    assert rec.windows_recorded == 20
+    ring = rec.records()
+    assert sorted(r["window"] for r in ring) == list(range(20))
+    assert not any("errors" in r for r in ring), ring
+    # the JSONL stream holds the same 20 windows, once each
+    on_disk = [r for r in flight.read_recording(path)
+               if r.get("type") == "flight_window"]
+    assert sorted(r["window"] for r in on_disk) == list(range(20))
