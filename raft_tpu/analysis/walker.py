@@ -25,7 +25,7 @@ from raft_tpu.analysis.findings import Finding, sort_findings
 from raft_tpu.analysis.jit_regions import JitRegions
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "results",
-              "build", "dist", ".eggs"}
+              "build", "dist", ".eggs", "archive"}
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")

@@ -1063,3 +1063,25 @@ def search_refined(
                      filter=filter, res=res)
     return refine_mod.refine(dataset, queries, cand, int(k),
                              metric=index.metric, res=res)
+
+
+def reconstruct_rows(centers, rotation, codes, scale, labels, bits: int = 1,
+                     rotation_kind: str = "dense", dim: Optional[int] = None):
+    """Approximate original vectors from packed BQ codes:
+    ``x̂ = c_label + R⁻¹(f·L)``, where ``f·L`` is the RaBitQ estimator's
+    projection of the rotated residual onto its own code direction — the
+    best reconstruction the code carries. Assignment-grade (maintenance
+    re-clustering's row source when the raw vectors are gone), NOT
+    bit-exact: re-encoding a reconstruction is near-idempotent but the
+    scan estimates remain approximate either way."""
+    from raft_tpu.ops.bq_scan import unpack_code_levels, unpack_sign_bits
+
+    rot_dim = int(rotation.shape[-1])
+    if bits == 1:
+        levels = unpack_sign_bits(jnp.asarray(codes), rot_dim)
+    else:
+        levels = unpack_code_levels(jnp.asarray(codes), rot_dim, bits)
+    u_hat = jnp.asarray(scale, jnp.float32)[:, None] * levels.astype(jnp.float32)
+    resid = linalg.unrotate_rows(u_hat, rotation, rotation_kind)
+    d = int(centers.shape[1]) if dim is None else int(dim)
+    return centers[jnp.asarray(labels, jnp.int32)] + resid[:, :d]

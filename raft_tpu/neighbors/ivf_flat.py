@@ -31,6 +31,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.obs import compile as obs_compile
@@ -927,3 +928,39 @@ def search_paged(
                 filter, int(k), n_probes, store.metric,
                 q_tile, select_algo, res.compute_dtype,
             )
+
+
+def split_list_rows(rows, n_iter: int = 8):
+    """Deterministic 2-means split of one overfull list's rows — the
+    maintenance re-cluster's hot-list splitter (serving/maintenance.py).
+
+    Seeding is data-derived (the two extreme rows along the max-variance
+    coordinate) and Lloyd runs a few rounds on the host: the input is one
+    list (thousands of rows at most), so there is nothing worth
+    dispatching, and no RNG keeps the split reproducible across runs —
+    the same no-clock/no-global-RNG determinism contract as the shadow
+    sampler's hashing.
+
+    Returns ``(centers (2, dim) float32, assign (n,) int32)``. Degenerate
+    inputs (all rows identical) collapse onto one side; callers skip the
+    split when ``assign`` is constant.
+    """
+    rows = np.asarray(rows, np.float32)
+    if rows.ndim != 2 or rows.shape[0] < 2:
+        raise ValueError("split_list_rows needs a (n >= 2, dim) row matrix")
+    mu = rows.mean(axis=0)
+    coord = rows[:, int(((rows - mu) ** 2).mean(axis=0).argmax())]
+    centers = np.stack([rows[int(coord.argmin())], rows[int(coord.argmax())]])
+    assign = np.zeros(rows.shape[0], np.int32)
+    for it in range(max(1, int(n_iter))):
+        d0 = ((rows - centers[0]) ** 2).sum(axis=1)
+        d1 = ((rows - centers[1]) ** 2).sum(axis=1)
+        new = (d1 < d0).astype(np.int32)
+        if it > 0 and np.array_equal(new, assign):
+            break
+        assign = new
+        for side in (0, 1):
+            sel = rows[assign == side]
+            if sel.shape[0]:
+                centers[side] = sel.mean(axis=0)
+    return centers, assign

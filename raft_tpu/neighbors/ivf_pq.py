@@ -1890,3 +1890,26 @@ def search_paged(
     if store.metric == "cosine":
         vals = jnp.where(ids >= 0, 1.0 - vals, jnp.inf)
     return vals, ids
+
+
+def reconstruct_rows(centers, rotation, codebooks, codes, labels,
+                     pq_dim: int, pq_bits: int, dim: Optional[int] = None):
+    """Approximate original vectors from packed PQ codes: the exact float
+    codeword per subspace (NOT the int8 scan cache), un-rotated back to
+    the input space and re-centered by each row's list centroid.
+    Assignment-grade — the maintenance re-cluster's row source when the
+    raw vectors are gone. Re-encoding a reconstruction against the SAME
+    centers reproduces the codes exactly (the codeword is each subspace's
+    nearest codeword to itself); against moved centers it is the
+    principled nearest re-quantization."""
+    codes = jnp.asarray(codes)
+    labels = jnp.asarray(labels, jnp.int32)
+    n_codes, dsub = int(codebooks.shape[1]), int(codebooks.shape[2])
+    cb_flat = jnp.asarray(codebooks).reshape(pq_dim * n_codes, dsub)
+    s_off = (jnp.arange(pq_dim, dtype=jnp.int32) * n_codes)[None, :]
+    cv = _codes_view(codes, pq_dim, pq_bits).astype(jnp.int32)
+    resid_rot = jnp.take(cb_flat, cv + s_off, axis=0).reshape(
+        codes.shape[0], pq_dim * dsub)
+    resid = linalg.unrotate_rows(resid_rot, rotation, "dense")
+    d = int(centers.shape[1]) if dim is None else int(dim)
+    return centers[labels] + resid[:, :d]

@@ -215,11 +215,13 @@ def _predict_paged_store(*, n_lists: int, dim: int, capacity_pages: int,
     total += n_lists * table_width * 4                        # device table
     # host bookkeeping (counted by index_bytes too — numpy arrays carry
     # nbytes): page table + per-list chain lengths + per-page fill counts
-    # + page→list ownership
+    # + page→list ownership + per-list live-row counters (round 19 drift
+    # detection)
     total += n_lists * table_width * 4                          # host _table
     total += n_lists * 4                                        # _list_pages
     total += capacity_pages * 4                                 # _fill
     total += capacity_pages * 4                                 # _page_list
+    total += n_lists * 8                                        # _list_live
     if paged_plan_cache:
         # the paged Pallas path's device chain-length mirror (_dev_lens),
         # materialized on its first search

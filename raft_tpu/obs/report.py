@@ -46,10 +46,11 @@ __all__ = ["SCHEMA_VERSION", "collect", "export", "main", "render",
 #: 1 = SLO/recall/queue/memory/shard_health/verdicts (rounds ≤10);
 #: 2 = + compile ledger and admission sections (round 11);
 #: 3 = + roofline section (round 15);
-#: 4 = + capacity section, explicit version + window stamps (round 19).
+#: 4 = + capacity section, explicit version + window stamps (round 19);
+#: 5 = + maintenance section (round 19 — drift/re-clustering manager).
 #: Records with NO version field are legacy streams: every later section
 #: is lenient-on-absence for them, exactly as before the stamp existed.
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 #: monotonic window id for records collect() stamps itself (a caller-run
 #: windowed sampler — obs/flight.py — passes its own instead)
@@ -95,6 +96,7 @@ def _classified(fn, label: str, out_errors: dict):
 
 
 def collect(engine=None, sampler=None, queue=None, capacity=None,
+            maintenance=None,
             snapshot: Optional[dict] = None,
             extra: Optional[dict] = None,
             window: Optional[int] = None) -> dict:
@@ -162,6 +164,12 @@ def collect(engine=None, sampler=None, queue=None, capacity=None,
             # chaos rung's acceptance record
             "capacity": (_classified(capacity.report, "capacity", errors)
                          if capacity is not None else None),
+            # maintenance plane (schema v5): drift score + incremental
+            # re-clustering cycle counts — the always-live index's
+            # "is recall holding without a rebuild" record
+            "maintenance": (_classified(maintenance.report, "maintenance",
+                                        errors)
+                            if maintenance is not None else None),
             "verdicts": {
                 **verdicts,
                 "unclassified": int(sum(
@@ -334,6 +342,25 @@ def validate(report: dict,
             if not isinstance(row.get("slo"), dict):
                 problems.append(
                     f"capacity.tenants[{name}] carries no SLO row")
+    # maintenance plane (schema v5): a populated section must carry a
+    # finite non-negative drift score, integral cycle accounting, and a
+    # recall record. Lenient on absence at every version (None = no
+    # manager wired — the static-index shape), and lenient on SHAPE below
+    # v5: an older stream replaying through a newer validator must not
+    # fail on a section its writer never promised.
+    maint = report.get("maintenance")
+    if isinstance(maint, dict) and version >= 5:
+        score = maint.get("drift_score")
+        if not (_finite(score) and score >= 0):
+            problems.append(
+                f"maintenance.drift_score not finite: {score!r}")
+        for key in ("cycles", "stale_aborts", "failures"):
+            v = maint.get(key)
+            if not (isinstance(v, int) and v >= 0):
+                problems.append(
+                    f"maintenance.{key} not a non-negative int: {v!r}")
+        if not isinstance(maint.get("recall"), dict):
+            problems.append("maintenance section carries no recall record")
     return problems
 
 

@@ -459,6 +459,23 @@ def _fb_serving_scatter(*, n_rows, dim, payload_width,
     return 0, br, bw
 
 
+def _fb_maint_reencode(*, n_rows, dim, rot_dim=0, pq_dim=0, n_codes=0):
+    """One maintenance re-encode pass over the cycle's affected rows
+    (serving/maintenance.py): the residual rotation (2·n·rot_dim·dim
+    MACs → 2 flops each; rot_dim = 0 for flat stores, which re-encode
+    nothing) plus, for PQ, the per-subspace nearest-codeword search
+    (n·pq_dim·n_codes·dsub MACs with dsub = rot_dim/pq_dim). Traffic:
+    the float32 rows in, the rotated residual out — the code packing
+    rides the same dispatch and is byte-noise next to it."""
+    flops = 2 * n_rows * rot_dim * dim
+    if pq_dim and n_codes:
+        dsub = rot_dim // max(1, pq_dim)
+        flops += 2 * n_rows * pq_dim * n_codes * dsub
+    br = n_rows * dim * 4
+    bw = n_rows * rot_dim * 4
+    return flops, br, bw
+
+
 _MODELS = {
     "brute_force.search": _fb_brute_force_search,
     "ivf_flat.search": _fb_ivf_flat_search,
@@ -471,6 +488,7 @@ _MODELS = {
     "ivf_bq.paged_pallas": _fb_ivf_bq_paged_pallas,
     "cagra.fused_hop": _fb_cagra_fused_hop,
     "serving.scatter": _fb_serving_scatter,
+    "serving.maintenance.reencode": _fb_maint_reencode,
     "linalg.srht_apply": _fb_srht_apply,
     "ivf_flat.build": _fb_ivf_flat_build,
     "ivf_pq.build": _fb_ivf_pq_build,
@@ -491,6 +509,7 @@ _SPAN_OF = {
     "ivf_bq.paged_pallas": "ivf_bq::paged_pallas",
     "cagra.fused_hop": "cagra::hop",
     "serving.scatter": "serving::upsert",
+    "serving.maintenance.reencode": "serving::maintenance_recluster",
 }
 
 # opt the modeled spans into the registry's sync-mode dispatch fold —

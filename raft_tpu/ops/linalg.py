@@ -204,6 +204,25 @@ def rotate_rows(x, rotation, kind: str = "dense") -> jax.Array:
                      f"{ROTATION_KINDS})")
 
 
+def unrotate_rows(y, rotation, kind: str = "dense") -> jax.Array:
+    """Inverse of :func:`rotate_rows`, back onto the (padded) input space.
+    Both representations are exactly orthogonal, so the inverse is the
+    transpose: ``y @ R`` for the dense matrix, ``D * fwht(y) / sqrt(d)``
+    for the SRHT (H is symmetric and D its own inverse). Callers slice
+    ``[..., :dim]`` to drop the zero-padded coordinates. This is what lets
+    maintenance re-clustering reconstruct assignment-grade vectors from
+    encoded residuals when the raw rows are gone."""
+    y = jnp.asarray(y)
+    if kind == "dense":
+        return y @ rotation
+    if kind == "hadamard":
+        d = rotation.shape[-1]
+        inv = jnp.asarray(1.0 / math.sqrt(d), jnp.float32)
+        return hadamard_transform(y) * inv * rotation
+    raise ValueError(f"unknown rotation kind {kind!r} (expected one of "
+                     f"{ROTATION_KINDS})")
+
+
 def rotation_matrix_of(rotation, kind: str = "dense") -> jax.Array:
     """The explicit (rot_dim, rot_dim) matrix of either representation —
     for oracles/tests and the rare consumer that genuinely needs the dense

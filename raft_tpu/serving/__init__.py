@@ -11,7 +11,11 @@ back to the packed snapshot layout by :meth:`~PagedListStore.compact` —
 a :class:`QueryQueue` coalesces one-at-a-time requests with per-request
 deadlines into dynamically sized device batches under a latency SLO, and
 a :class:`CompactionManager` reclaims tombstones off the hot path when
-the tombstone ratio crosses ``RAFT_TPU_SERVING_COMPACT_RATIO``.
+the tombstone ratio crosses ``RAFT_TPU_SERVING_COMPACT_RATIO``, and a
+:class:`MaintenanceManager` generalizes it into the always-live index
+loop: drift detection (fill skew + tombstones + shadow recall trend) and
+incremental online re-clustering (split hot lists / merge cold ones,
+re-encode only the affected rows, swap atomically — zero recompiles).
 
 Usage::
 
@@ -58,6 +62,19 @@ from raft_tpu.serving.compaction import (
     CompactionManager,
     default_compact_deadline,
     default_compact_ratio,
+)
+from raft_tpu.serving.maintenance import (
+    MAINT_DEADLINE_ENV,
+    MAINT_DRIFT_ENV,
+    MAINT_INTERVAL_ENV,
+    MAINT_PAIRS_ENV,
+    MAINT_SKEW_ENV,
+    MaintenanceManager,
+    default_drift_threshold,
+    default_maintenance_deadline,
+    default_maintenance_interval,
+    default_max_pairs,
+    default_split_skew,
 )
 from raft_tpu.serving.store import (
     PAGE_ROWS_ENV,
@@ -115,7 +132,13 @@ __all__ = [
     "CapacityRejected",
     "CompactionManager",
     "HOT",
+    "MAINT_DEADLINE_ENV",
+    "MAINT_DRIFT_ENV",
+    "MAINT_INTERVAL_ENV",
+    "MAINT_PAIRS_ENV",
+    "MAINT_SKEW_ENV",
     "MAX_DEMOTIONS_ENV",
+    "MaintenanceManager",
     "PAGE_ROWS_ENV",
     "PROMOTE_DEADLINE_ENV",
     "PagedListStore",
@@ -127,7 +150,12 @@ __all__ = [
     "WINDOW_ENV",
     "default_compact_deadline",
     "default_compact_ratio",
+    "default_drift_threshold",
+    "default_maintenance_deadline",
+    "default_maintenance_interval",
+    "default_max_pairs",
     "default_page_rows",
+    "default_split_skew",
     "paged_engine",
     "scan_trace_count",
     "search",

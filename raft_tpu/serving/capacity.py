@@ -198,6 +198,14 @@ class Tenant:
         self.verdicts: Dict[str, int] = {}   # guarded-by: _lock
         self.outcomes: Dict[str, int] = {}   # guarded-by: _lock -- ok/rejected/... counts
         self.lats: deque = deque(maxlen=256)  # guarded-by: _lock -- served latencies (s)
+        # mutability across the tier cycle (paged-store tenants only):
+        # WARM/COLD upserts buffer here and replay on promote; the page
+        # plan preserves the store's compiled-shape envelope over the
+        # demote→promote round trip (zero growth retraces mid-traffic)
+        self.pending: list = []        # guarded-by: _lock -- [(rows f32, ids i64)] in arrival order
+        self.pending_deletes: set = set()  # guarded-by: _lock -- ids whose latest op is a delete
+        self.pending_rows = 0          # guarded-by: _lock, reads-ok
+        self.page_plan: Optional[dict] = None  # guarded-by: _lock, reads-ok -- snapshot page layout
 
     # -- mutators (the only post-publication writers) -----------------------
 
@@ -246,13 +254,131 @@ class Tenant:
             self.tier = HOT
             self.promotions += 1
 
-    def demote_one_tier(self, now: float) -> Optional[dict]:
+    # -- mutability across the tier cycle -----------------------------------
+
+    def apply_upsert(self, vectors, ids=None) -> dict:
+        """Accept an upsert at ANY tier. HOT applies straight to the live
+        paged store (under the tenant lock, so a concurrent demotion's
+        hibernation snapshot can never lose the rows); WARM/COLD buffers
+        the batch for replay at the next promote — those rows still serve
+        (exactly) through the warm tier's pending merge. Buffered rows
+        REQUIRE explicit ids: auto-assignment is only stable against the
+        live store."""
+        rows = np.asarray(vectors, dtype=np.float32)
+        if rows.ndim != 2:
+            raise ValueError(f"vectors must be 2-D, got shape {rows.shape}")
+        with self._lock:
+            if self.tier == HOT and self.hot_obj is not None:
+                if not hasattr(self.hot_obj, "upsert"):
+                    raise TypeError(
+                        f"tenant {self.name!r} ({self.kind}) serves a "
+                        f"packed index — register a paged store for live "
+                        f"mutation")
+                self.hot_obj.upsert(rows, ids)
+                return {"tier": HOT, "applied": int(rows.shape[0]),
+                        "buffered": 0}
+            if self.kind != "paged_store":
+                raise TypeError(
+                    f"tenant {self.name!r} ({self.kind}) is immutable — "
+                    f"only paged-store tenants accept upserts across the "
+                    f"tier cycle")
+            if ids is None:
+                raise ValueError(
+                    f"tenant {self.name!r} is {self.tier} — buffered "
+                    f"upserts require explicit ids")
+            ids_np = np.asarray(ids, dtype=np.int64).reshape(-1)
+            if ids_np.shape[0] != rows.shape[0]:
+                raise ValueError(
+                    f"ids shape {ids_np.shape} does not match "
+                    f"{rows.shape[0]} rows")
+            # an upsert supersedes any earlier buffered delete of its id
+            self.pending_deletes.difference_update(ids_np.tolist())
+            self.pending.append((rows, ids_np))
+            self.pending_rows += int(rows.shape[0])
+            return {"tier": self.tier, "applied": 0,
+                    "buffered": int(rows.shape[0])}
+
+    def apply_delete(self, ids) -> dict:
+        """Delete at ANY tier: HOT tombstones in the live store; WARM/COLD
+        drops matching buffered rows and records the ids for replay."""
+        ids_np = np.unique(np.asarray(ids, dtype=np.int64).reshape(-1))
+        with self._lock:
+            if self.tier == HOT and self.hot_obj is not None:
+                if not hasattr(self.hot_obj, "delete"):
+                    raise TypeError(
+                        f"tenant {self.name!r} ({self.kind}) serves a "
+                        f"packed index — register a paged store for live "
+                        f"mutation")
+                removed = int(self.hot_obj.delete(ids_np))
+                return {"tier": HOT, "removed": removed, "buffered": 0}
+            if self.kind != "paged_store":
+                raise TypeError(
+                    f"tenant {self.name!r} ({self.kind}) is immutable — "
+                    f"only paged-store tenants accept deletes across the "
+                    f"tier cycle")
+            dropped = 0
+            batches = []
+            for rows, bids in self.pending:
+                keep = ~np.isin(bids, ids_np)
+                dropped += int(bids.size - keep.sum())
+                if keep.all():
+                    batches.append((rows, bids))
+                elif keep.any():
+                    batches.append((rows[keep], bids[keep]))
+            self.pending = batches
+            self.pending_rows -= dropped
+            self.pending_deletes.update(ids_np.tolist())
+            return {"tier": self.tier, "removed": dropped,
+                    "buffered": int(ids_np.size)}
+
+    def pending_view(self) -> Optional[tuple]:
+        """Deduplicated snapshot of the buffered mutations for the warm
+        tier's exact merge: ``(rows, ids, deletes)`` with keep-LAST id
+        semantics (a later upsert supersedes); None when nothing is
+        pending."""
+        with self._lock:
+            if not self.pending and not self.pending_deletes:
+                return None
+            batches = list(self.pending)
+            deletes = set(self.pending_deletes)
+        if batches:
+            rows = np.concatenate([b[0] for b in batches])
+            ids_np = np.concatenate([b[1] for b in batches])
+            _, last_rev = np.unique(ids_np[::-1], return_index=True)
+            keep = np.sort(ids_np.size - 1 - last_rev)
+            rows, ids_np = rows[keep], ids_np[keep]
+        else:
+            rows = ids_np = None
+        return rows, ids_np, deletes
+
+    def drain_pending(self) -> tuple:
+        """Atomically take (and clear) the buffered mutations —
+        ``(batches, deletes)`` for replay into a freshly promoted store.
+        Upserts replay in arrival order before the deletes (the buffer
+        invariants make that ordering exact: an id in ``deletes`` has no
+        buffered row, and a re-upserted id left ``deletes`` on arrival)."""
+        with self._lock:
+            batches = self.pending
+            deletes = sorted(self.pending_deletes)
+            self.pending = []
+            self.pending_deletes = set()
+            self.pending_rows = 0
+        return batches, deletes
+
+    def demote_one_tier(self, now: float, snapshot_cb=None) -> Optional[dict]:
         """One atomic tier-down transition; returns the demotion record
         (None when the tenant already holds nothing). HOT drops the full
         index (warm codes stay resident — the instant path); WARM drops
-        the codes."""
+        the codes. ``snapshot_cb(hot_obj)`` runs BEFORE the drop, under
+        the tenant lock (mutually exclusive with :meth:`apply_upsert`, so
+        a hibernation snapshot can never miss accepted rows); its return
+        value becomes the tenant's ``page_plan``."""
         with self._lock:
             if self.tier == HOT:
+                if snapshot_cb is not None and self.hot_obj is not None:
+                    plan = snapshot_cb(self.hot_obj)
+                    if plan is not None:
+                        self.page_plan = plan
                 freed = self.hot_bytes if self.hot_obj is not None else 0
                 self.hot_obj = None
                 to = WARM if self.warm_index is not None else COLD
@@ -370,6 +496,43 @@ def _warm_twin(index, warm_params=None):
             kmeans_n_iters=5, list_size_cap=0)
     warm = ivf_bq.build(rows, warm_params)
     return warm, ids
+
+
+def _merge_pending(queries, vals, ids, k, metric, rows_p, ids_p,
+                   deletes) -> Tuple[np.ndarray, np.ndarray]:
+    """Fold a tenant's buffered mutations into a warm-tier result: mask
+    pending-deleted ids out, score the pending rows EXACTLY (they are
+    fp32 in the buffer — no BQ quantization), and re-select top-k over
+    the union. Keeps the degraded serve read-your-writes: a row upserted
+    while the tenant is WARM is visible to the very next query."""
+    bigger = metric == "inner_product"   # brute_force._MAX_METRICS shape
+    worst = -np.inf if bigger else np.inf
+    vals = np.where(ids < 0, worst, vals)   # pads must never win a merge
+    if deletes:
+        dead = np.isin(ids, np.fromiter(deletes, dtype=np.int64))
+        vals = np.where(dead, worst, vals)
+        ids = np.where(dead, -1, ids)
+    if rows_p is not None:
+        q = np.ascontiguousarray(queries, dtype=np.float32)
+        ip = q @ rows_p.T
+        if metric == "inner_product":
+            scores = ip
+        elif metric == "cosine":
+            qn = np.linalg.norm(q, axis=1, keepdims=True)
+            rn = np.linalg.norm(rows_p, axis=1)[None, :]
+            scores = 1.0 - ip / np.maximum(qn * rn, 1e-30)
+        else:
+            d = np.maximum((q ** 2).sum(1, keepdims=True)
+                           + (rows_p ** 2).sum(1)[None, :] - 2.0 * ip, 0.0)
+            scores = np.sqrt(d) if metric == "euclidean" else d
+        vals = np.concatenate([vals, scores.astype(vals.dtype)], axis=1)
+        ids = np.concatenate(
+            [ids, np.broadcast_to(ids_p, scores.shape).astype(ids.dtype)],
+            axis=1)
+    order = np.argsort(-vals if bigger else vals, axis=1,
+                       kind="stable")[:, :k]
+    return (np.take_along_axis(vals, order, axis=1),
+            np.take_along_axis(ids, order, axis=1))
 
 
 def _default_search_fn(kind: str) -> Callable:
@@ -550,7 +713,8 @@ class CapacityController:
         self._promote_lats: deque = deque(maxlen=256)
         self._counts = {"demotions": 0, "promotions": 0, "rejections": 0,
                         "promote_failures": 0, "promote_denied": 0,
-                        "queued_degraded": 0}
+                        "queued_degraded": 0, "upserts": 0, "deletes": 0,
+                        "buffered_upserts": 0, "replays": 0}
 
     # -- registration -------------------------------------------------------
     def register(self, name: str, index, snapshot_dir, **kw) -> Tenant:
@@ -630,17 +794,102 @@ class CapacityController:
 
         return cost
 
+    # -- mutation (any tier) -------------------------------------------------
+    def upsert(self, name: str, vectors, ids=None) -> dict:
+        """Upsert rows into tenant ``name`` at WHATEVER tier it occupies:
+        HOT applies to the live paged store; WARM/COLD buffers for replay
+        at promote (explicit ids required) while the warm tier serves the
+        buffered rows exactly. A HOT apply re-predicts the ledger — live
+        growth changes every later admission projection."""
+        tenant = self.registry.get(name)
+        attrs = {"tenant": name, "tier": tenant.tier} \
+            if obs.enabled() else None
+        with obs.record_span("capacity::upsert", attrs=attrs):
+            rec = tenant.apply_upsert(vectors, ids)
+            if rec["applied"] and tenant.hot_obj is not None:
+                with tenant._lock:
+                    tenant.hot_bytes = costmodel.predict_index_bytes(
+                        **costmodel.index_layout(tenant.hot_obj))
+            with self._lock:
+                self._counts["upserts"] += 1
+                if rec["buffered"]:
+                    self._counts["buffered_upserts"] += 1
+            if obs.enabled():
+                obs.add("capacity.upserts")
+                if rec["buffered"]:
+                    obs.add("capacity.upserts.buffered")
+            if rec["buffered"]:
+                record_event("capacity_upsert_buffered", tenant=name,
+                             tier=rec["tier"], rows=rec["buffered"])
+            return rec
+
+    def delete(self, name: str, ids) -> dict:
+        """Delete ids from tenant ``name`` at any tier (the buffered half
+        mirrors :meth:`upsert`)."""
+        tenant = self.registry.get(name)
+        attrs = {"tenant": name, "tier": tenant.tier} \
+            if obs.enabled() else None
+        with obs.record_span("capacity::delete", attrs=attrs):
+            rec = tenant.apply_delete(ids)
+            with self._lock:
+                self._counts["deletes"] += 1
+            if obs.enabled():
+                obs.add("capacity.deletes")
+            return rec
+
     # -- eviction (tier-down) -----------------------------------------------
     def _window_demotions(self, now: float) -> int:
         return sum(1 for t in self._demotion_times
                    if now - t <= self.window_s)
 
+    def _hibernate_paged(self, tenant: Tenant) -> Optional[Callable]:
+        """The HOT→WARM snapshot callback for a paged (mutable) tenant:
+        compact the live store, overwrite the hot snapshot with its
+        CURRENT rows (the registration-time snapshot is stale the moment
+        the first upsert lands), and capture the page plan —
+        ``restore_shape`` on promote re-creates the same compiled-shape
+        envelope so the round trip costs zero growth retraces. Non-paged
+        tenants return None: their registration snapshot is still exact."""
+        if tenant.kind != "paged_store":
+            return None
+
+        def snap(hot_obj) -> Optional[dict]:
+            from raft_tpu.serving.store import PagedListStore
+
+            if not isinstance(hot_obj, PagedListStore):
+                return None
+            packed = hot_obj.compact()
+            packed.save(tenant.hot_path)
+            if obs.enabled():
+                obs.add("capacity.hibernates")
+            record_event("capacity_hibernate", tenant=tenant.name,
+                         rows=int(hot_obj.size))
+            return {"kind": _family_of(packed),
+                    "page_rows": int(hot_obj.page_rows),
+                    "capacity_pages": int(hot_obj.capacity_pages),
+                    "table_width": int(hot_obj.table_width)}
+
+        return snap
+
     def _demote_one(self, tenant: Tenant) -> Optional[dict]:
         """One tier down; returns the demotion record (None when the
         tenant already holds nothing). HOT drops the full index (the warm
-        codes stay resident — the instant path); WARM drops the codes."""
+        codes stay resident — the instant path); WARM drops the codes. A
+        paged tenant hibernates first (fresh snapshot + page plan); a
+        FAILED hibernation aborts the demotion classified — dropping the
+        only copy of accepted mutations is never an eviction option."""
         now = time.monotonic()
-        rec = tenant.demote_one_tier(now)
+        try:
+            rec = tenant.demote_one_tier(
+                now, snapshot_cb=self._hibernate_paged(tenant))
+        except Exception as e:
+            kind = resilience.classify(e)
+            if obs.enabled():
+                obs.add("capacity.demote.failed")
+                obs.add(f"capacity.demote.failed.{kind}")
+            record_event("capacity_demote_failed", tenant=tenant.name,
+                         kind=kind, error=repr(e)[:200])
+            return None
         if rec is None:
             return None
         with self._lock:
@@ -715,8 +964,8 @@ class CapacityController:
                "cagra": cagra_mod.CagraIndex}.get(tenant.kind)
         if cls is None:
             # a paged store compacts to ivf_flat/pq/bq for its snapshot;
-            # the promoted object is the packed index (mutations belong
-            # to HOT tenancy — tiering freezes them)
+            # a paged TENANT rehydrates back to a PagedListStore on the
+            # hibernation page plan — mutability survives the tier cycle
             from raft_tpu.core.serialize import load_arrays
 
             meta, _ = load_arrays(tenant.hot_path)
@@ -724,7 +973,18 @@ class CapacityController:
             cls = {"ivf_flat": ivf_flat.IvfFlatIndex,
                    "ivf_pq": ivf_pq.IvfPqIndex,
                    "ivf_bq": ivf_bq.IvfBqIndex}[kind]
+            packed = cls.load(tenant.hot_path)
+            if tenant.kind == "paged_store":
+                from raft_tpu.serving.store import PagedListStore
+
+                plan = tenant.page_plan or {}
+                store = PagedListStore.from_index(
+                    packed, page_rows=plan.get("page_rows"))
+                store.restore_shape(plan.get("capacity_pages", 0),
+                                    plan.get("table_width", 0))
+                return store
             tenant.set_search_fn(_default_search_fn(kind))
+            return packed
         return cls.load(tenant.hot_path)
 
     def _load_warm(self, tenant: Tenant) -> None:
@@ -799,6 +1059,10 @@ class CapacityController:
             # every later admission
             tenant.adopt_hot(hot, costmodel.predict_index_bytes(
                 **costmodel.index_layout(hot)))
+            # mutations accepted while demoted replay into the restored
+            # store AFTER the tier flip: once the tenant is HOT no new
+            # batch can buffer, so one drain here catches everything
+            replay = self._replay_pending(tenant)
             with self._lock:
                 self._counts["promotions"] += 1
                 self._promote_lats.append(dt)
@@ -809,7 +1073,44 @@ class CapacityController:
             record_event("capacity_promote", tenant=name,
                          promote_s=round(dt, 6))
             return {"status": "ok", "tenant": name, "tier": HOT,
-                    "promote_s": dt, "from": prior}
+                    "promote_s": dt, "from": prior,
+                    "replayed_rows": replay["rows"],
+                    "replayed_deletes": replay["deletes"]}
+
+    def _replay_pending(self, tenant: Tenant) -> dict:
+        """Apply the drained WARM/COLD mutation buffer to the freshly
+        promoted store: upsert batches in arrival order, then the
+        tombstones (:meth:`Tenant.drain_pending` documents why that
+        ordering is exact). The ledger re-predicts afterwards — replayed
+        rows change the resident footprint."""
+        batches, deletes = tenant.drain_pending()
+        if not batches and not deletes:
+            return {"rows": 0, "deletes": 0}
+        store = tenant.hot_obj
+        rows_n = 0
+        try:
+            for rows, ids_np in batches:
+                store.upsert(rows, ids_np)
+                rows_n += int(rows.shape[0])
+            if deletes:
+                store.delete(np.asarray(deletes, dtype=np.int64))
+        except Exception as e:
+            kind = resilience.classify(e)
+            if obs.enabled():
+                obs.add(f"capacity.replay.failed.{kind}")
+            record_event("capacity_replay_failed", tenant=tenant.name,
+                         kind=kind, error=repr(e)[:200])
+            return {"rows": rows_n, "deletes": 0}
+        with tenant._lock:
+            tenant.hot_bytes = costmodel.predict_index_bytes(
+                **costmodel.index_layout(store))
+        with self._lock:
+            self._counts["replays"] += 1
+        if obs.enabled():
+            obs.add("capacity.replays")
+        record_event("capacity_replay", tenant=tenant.name, rows=rows_n,
+                     deletes=len(deletes))
+        return {"rows": rows_n, "deletes": len(deletes)}
 
     def autopromote(self, max_promotions: int = 1) -> list:
         """Opportunistic tier-up of the most-recently-served non-HOT
@@ -855,6 +1156,11 @@ class CapacityController:
             ids = np.concatenate(
                 [ids, np.full((ids.shape[0], pad), -1, dtype=ids.dtype)],
                 axis=1)
+        pend = tenant.pending_view()
+        if pend is not None:
+            vals, ids = _merge_pending(np.asarray(queries, np.float32),
+                                       vals, ids, int(k), warm.metric,
+                                       *pend)
         tenant.record_degraded()
         if obs.enabled():
             obs.add("capacity.serves.degraded")
@@ -1013,6 +1319,7 @@ class CapacityController:
                 "warm_bytes": int(t.warm_bytes),
                 "demotions": int(t.demotions),
                 "promotions": int(t.promotions),
+                "pending_rows": int(t.pending_rows),
                 "verdicts": {k: int(v)
                              for k, v in sorted(t.verdicts.items())},
                 "slo": t.slo_row(),

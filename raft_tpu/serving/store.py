@@ -233,6 +233,11 @@ class PagedListStore:
         self._free: List[int] = list(range(cap))  # guarded-by: _lock
         self._id_loc: Dict[int, Tuple[int, int]] = {}  # guarded-by: _lock
         self._tombstones = 0  # guarded-by: _lock
+        # live (non-tombstoned) rows per list, maintained incrementally by
+        # append/tombstone — the drift detector's skew source; an O(n)
+        # recount per detect tick would put the hot path's lock under a
+        # scan-sized critical section
+        self._list_live = np.zeros(n_lists, np.int64)  # guarded-by: _lock
         self._dev_table = None  # guarded-by: _lock -- device mirror, invalidated on table change
         self._dev_lens = None   # guarded-by: _lock -- device chain-length mirror (paged Pallas)
         self._version = 0       # guarded-by: _lock -- bumped on every committed mutation
@@ -386,6 +391,23 @@ class PagedListStore:
         with self._lock:
             return self._tombstones / max(1, len(self._id_loc))
 
+    def list_fill_counts(self) -> np.ndarray:
+        """Live (non-tombstoned) rows per list — a copy of the host
+        counters append/tombstone maintain incrementally, so the drift
+        detector's tick costs O(n_lists), never a pool scan."""
+        with self._lock:
+            return self._list_live.copy()
+
+    def list_skew(self) -> float:
+        """``max / mean`` live rows over all lists — 1.0 is perfectly
+        balanced, 0.0 is empty. The maintenance split trigger compares
+        this against ``RAFT_TPU_MAINT_SPLIT_SKEW``."""
+        counts = self.list_fill_counts()
+        total = int(counts.sum())
+        if total <= 0:
+            return 0.0
+        return float(counts.max() * counts.shape[0] / total)
+
     def stats(self) -> dict:
         with self._lock:
             used = self.pages_used
@@ -398,6 +420,7 @@ class PagedListStore:
                 "fill_fraction": (self.size / max(1, used * self.page_rows)),
                 "tombstone_ratio": (self._tombstones
                                     / max(1, len(self._id_loc))),
+                "list_skew": round(self.list_skew(), 4),
                 "growth_events": self._growths,
                 "mutation_version": self._version,
             }
@@ -758,6 +781,7 @@ class PagedListStore:
             self.page_scale = extra_pool
         for i in range(m):
             self._id_loc[int(ids_np[i])] = (int(pp[i]), int(rr[i]))
+        np.add.at(self._list_live, np.asarray(labels_np, np.int64)[:m], 1)
         self._version += 1
 
     def _tombstone_slots(self, locs: List[Tuple[int, int]]) -> None:
@@ -766,6 +790,8 @@ class PagedListStore:
         reused — compact() reclaims them."""
         pp = np.array([p for p, _ in locs], np.int64)
         rr = np.array([r for _, r in locs], np.int64)
+        labs = self._page_list[pp]
+        np.subtract.at(self._list_live, labs[labs >= 0], 1)
         bucket = _pow2_at_least(len(locs))
         if bucket != len(locs):
             pad = bucket - len(locs)
@@ -902,11 +928,23 @@ class PagedListStore:
             obs.add("serving.store.compactions")
         return out
 
-    def _empty_clone(self) -> "PagedListStore":
+    def _empty_clone(self, centers=None) -> "PagedListStore":
         """A row-free store with the SAME quantizers, page height, pool
         capacity and table width — the staging target a background
         compaction repages into before the atomic swap (same capacity ⇒
-        same operand shapes ⇒ the swap never retraces the scans)."""
+        same operand shapes ⇒ the swap never retraces the scans).
+
+        ``centers`` (same shape/dtype) replaces the coarse centroids for
+        a maintenance re-clustering clone: the centers operand keeps its
+        shape, so the coarse gemm re-dispatches its compiled program."""
+        if centers is None:
+            centers = self.centers
+        else:
+            centers = jnp.asarray(centers, self.centers.dtype)
+            if centers.shape != self.centers.shape:
+                raise ValueError(
+                    f"replacement centers must be {self.centers.shape}, "
+                    f"got {centers.shape}")
         with self._lock:
             # one consistent (pool, capacity, width) triple — unlocked
             # property reads could pair a post-growth width with a
@@ -915,7 +953,7 @@ class PagedListStore:
             cap = self.capacity_pages
             width = self.table_width
         clone = PagedListStore(
-            self.kind, self.centers, self.metric, page_rows=self.page_rows,
+            self.kind, centers, self.metric, page_rows=self.page_rows,
             payload_width=int(pages.shape[2]),
             payload_dtype=pages.dtype, rotation=self.rotation,
             codebooks=self.codebooks, pq_bits=self.pq_bits,
@@ -928,7 +966,38 @@ class PagedListStore:
 
     _SWAP_FIELDS = ("pages", "page_ids", "page_aux", "page_bias",
                     "page_cache", "page_scale", "_table", "_list_pages",
-                    "_fill", "_page_list", "_free", "_id_loc")
+                    "_fill", "_page_list", "_free", "_id_loc", "_list_live")
+
+    def _adopt_clone(self, clone: "PagedListStore", expected_version: int,
+                     tag: str) -> bool:
+        """The one atomic-swap critical section compaction and maintenance
+        share: re-validate ``mutation_version`` against
+        ``expected_version`` (a mutation that landed after the caller's
+        snapshot aborts — returns False, nothing changed, counted as
+        ``serving.store.<tag>_stale``), refuse a clone whose staging grew
+        the operand shapes (``<tag>_regrown``), then adopt the clone's
+        pools, host tables AND centers wholesale. Centers adoption is what
+        lets a re-clustering swap move centroids without touching the
+        compiled scan layout — same shapes, new values."""
+        with self._lock:
+            if self._version != int(expected_version):
+                obs.add(f"serving.store.{tag}_stale")
+                return False
+            if (clone.capacity_pages != self.capacity_pages
+                    or clone.table_width != self.table_width):
+                # the staging itself grew (a pathological fill pattern):
+                # adopting it would change operand shapes mid-serving, so
+                # refuse — the caller retries after its next snapshot
+                obs.add(f"serving.store.{tag}_regrown")
+                return False
+            for name in self._SWAP_FIELDS:
+                setattr(self, name, getattr(clone, name))
+            self.centers = clone.centers
+            self._tombstones = 0
+            self._dev_table = None
+            self._dev_lens = None
+            self._version += 1
+        return True
 
     def compact_swap(self, compacted, expected_version: int) -> bool:
         """Adopt a compacted index as this store's new paged state:
@@ -946,23 +1015,55 @@ class PagedListStore:
         either way."""
         clone = self._empty_clone()
         clone._ingest_packed(compacted)
-        with self._lock:
-            if self._version != int(expected_version):
-                obs.add("serving.store.compact_swap_stale")
-                return False
-            if (clone.capacity_pages != self.capacity_pages
-                    or clone.table_width != self.table_width):
-                # the repage itself grew (a pathological fill pattern):
-                # adopting it would change operand shapes mid-serving, so
-                # refuse — the caller retries after the next compact()
-                obs.add("serving.store.compact_swap_regrown")
-                return False
-            for name in self._SWAP_FIELDS:
-                setattr(self, name, getattr(clone, name))
-            self._tombstones = 0
-            self._dev_table = None
-            self._dev_lens = None
-            self._version += 1
+        if not self._adopt_clone(clone, expected_version, "compact_swap"):
+            return False
         if obs.enabled():
             obs.add("serving.store.compact_swaps")
         return True
+
+    def recluster_swap(self, clone: "PagedListStore",
+                       expected_version: int) -> bool:
+        """Adopt a maintenance staging clone — same capacity, table width
+        and operand shapes, possibly NEW centers — atomically. The clone
+        must hold the FULL surviving row set (the maintenance cycle stages
+        every live row, re-encoded only where its assignment moved);
+        racing mutations abort exactly like :meth:`compact_swap` and the
+        caller classifies the ``stale`` outcome."""
+        if not self._adopt_clone(clone, expected_version, "recluster_swap"):
+            return False
+        if obs.enabled():
+            obs.add("serving.store.recluster_swaps")
+        return True
+
+    def _ingest_rows(self, payload, ids_np, aux, labels_np, bias, extra,
+                     chunk_rows: int = 65536) -> None:  # holds: _lock
+        """Construction-phase bulk append for maintenance staging clones:
+        pre-encoded rows arrive in final per-list order (the caller's
+        snapshot order) and land through the same pow2-bucketed scatter as
+        serving upserts, chunked so one giant ingest never compiles a
+        bucket far above the serving sizes. Callers own exclusivity — the
+        clone is unpublished, the :meth:`_ingest_packed` contract."""
+        n = int(np.asarray(ids_np).shape[0])
+        for s in range(0, n, int(chunk_rows)):
+            e = min(n, s + int(chunk_rows))
+            self._append(payload[s:e], ids_np[s:e], aux[s:e],
+                         labels_np[s:e], bias[s:e],
+                         None if extra is None else extra[s:e])
+
+    def restore_shape(self, capacity_pages: int, table_width: int) -> None:
+        """Pre-grow to a previously captured ``(capacity_pages,
+        table_width)`` — the page plan the capacity plane preserves across
+        a tier round-trip, so a promoted store re-dispatches the same
+        compiled scan programs it had before demotion instead of paying
+        the growth retraces again mid-traffic."""
+        with self._lock:
+            if int(capacity_pages) > self.capacity_pages:
+                self._grow_pages(int(capacity_pages))
+            if int(table_width) > self.table_width:
+                self._grow_table(int(table_width))
+            # materialize the device table mirror eagerly: promotion is
+            # the off-path moment to pay the transfer, not the first
+            # post-promote search (and the capacity ledger's predicted
+            # footprint counts the mirror unconditionally)
+            if self._dev_table is None:
+                self._dev_table = jnp.asarray(self._table)

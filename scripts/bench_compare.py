@@ -202,6 +202,18 @@ def direction(metric: str) -> str:
         return "up"
     if tail == "tombstone_ratio_peak":
         return "down"
+    # maintenance plane (round 19): drift score and the maintained-vs-
+    # control recall decay shrink toward good (the always-live index is
+    # holding recall without a rebuild); completed re-clustering cycles
+    # grow toward good (recall_estimate is caught by the SLO-plane rule
+    # above); stale aborts are the optimistic-concurrency protocol
+    # WORKING — load-dependent, informational, never a verdict
+    if tail in ("drift_score", "recall_decay"):
+        return "down"
+    if tail == "maintenance_cycles":
+        return "up"
+    if tail == "stale_aborts":
+        return "info"
     # capacity plane (round 18): an OOM verdict in the oversubscribed
     # chaos rung means the admission controller failed its one job —
     # shrinking toward good at zero tolerance; the measured hot-swap

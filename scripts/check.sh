@@ -192,6 +192,17 @@ echo "== flight-recorder smoke (operating-point timeline + frontier, ISSUE 16) =
 JAX_PLATFORMS=cpu python scripts/flight_smoke.py || fail=1
 
 echo
+echo "== maintenance smoke (always-live index drift + re-clustering, ISSUE 18) =="
+# Paged ivf_pq store under an induced distribution shift: the drift
+# detector fires (classified drift_detected event), >=1 incremental
+# re-clustering cycle completes under an armed serving.maintenance.detect
+# delay fault, the scan-trace delta stays ZERO across every swap
+# (capacity-shaped operands), every aborted phase lands classified (zero
+# unclassified residue), and the obs report carries the maintenance
+# section through the real CLI subprocess.
+JAX_PLATFORMS=cpu python scripts/maintenance_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

@@ -23,7 +23,7 @@ run() {
 }
 
 # 1. 1M CAGRA compressed-vs-exact validation (PCA projection)
-run 2400 python scripts/cagra_r5_exp.py results/cagra_r5_exp4.jsonl
+run 2400 python scripts/archive/cagra_r5_exp.py results/cagra_r5_exp4.jsonl
 # 2. driver-format bench (headline + ladder + 10M crossover); keep its
 # stdout JSON line as its own artifact too
 echo "$(date) RUN: bench.py" >> "$LOG"
@@ -34,9 +34,9 @@ run 4200 python scripts/deep100m.py
 # 4. 1M frontier sweep
 run 3600 python -m raft_tpu.bench.runner results/sweep_r5_config.json -o results/sweep_r5.json
 # 5. CAGRA stage microbench (diagnostics)
-run 1500 python scripts/cagra_stage_micro.py 4096 4
+run 1500 python scripts/archive/cagra_stage_micro.py 4096 4
 # 5b. merge-strategy A/B: slack+re-select everywhere vs all-pairs dedup
-run 1800 env RAFT_TPU_CAGRA_DEDUP_LIMIT=0 python scripts/cagra_r5_exp.py results/cagra_r5_exp5_dedup0.jsonl
+run 1800 env RAFT_TPU_CAGRA_DEDUP_LIMIT=0 python scripts/archive/cagra_r5_exp.py results/cagra_r5_exp5_dedup0.jsonl
 # 6. 10M IVF-PQ curve
 run 3600 python -m raft_tpu.bench.runner results/sweep_r5_10m_config.json -o results/sweep_r5_10m.json
 echo "$(date) pipeline done" >> "$LOG"

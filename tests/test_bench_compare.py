@@ -337,3 +337,59 @@ def test_flight_plane_improvements_not_regressions(tmp_path):
     assert rows["serving.flight_windows"] == "improved"
     assert rows["serving.frontier_points"] == "improved"
     assert "regression" not in rows.values(), proc.stdout
+
+
+def test_maintenance_plane_direction_rules(tmp_path):
+    """Round 19 (ISSUE 18 satellite): `drift_score` and `recall_decay`
+    gate DOWNWARD (a maintained index drifting toward a rebuild is the
+    regression the manager exists to prevent); completed maintenance
+    cycles and the live recall estimate gate UPWARD; `stale_aborts` is
+    the optimistic-concurrency protocol working under load —
+    informational, never a verdict."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"maintenance": {"drift_score": 0.3,
+                                      "recall_decay": 0.005,
+                                      "maintenance_cycles": 3,
+                                      "recall_estimate": 0.96,
+                                      "stale_aborts": 0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"maintenance": {"drift_score": 2.5,
+                                      "recall_decay": 0.08,
+                                      "maintenance_cycles": 0,
+                                      "recall_estimate": 0.85,
+                                      "stale_aborts": 7}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["maintenance.drift_score"] == "regression"
+    assert rows["maintenance.recall_decay"] == "regression"
+    assert rows["maintenance.maintenance_cycles"] == "regression"
+    assert rows["maintenance.recall_estimate"] == "regression"
+    assert rows["maintenance.stale_aborts"] == "·"
+
+
+def test_maintenance_plane_improvements_not_regressions(tmp_path):
+    """Both polarities pinned: drift falling, decay shrinking, cycles
+    completing and recall recovering must render as improvements, and a
+    stale-abort count moving in EITHER direction stays informational."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"maintenance": {"drift_score": 2.5,
+                                      "recall_decay": 0.08,
+                                      "maintenance_cycles": 0,
+                                      "recall_estimate": 0.85,
+                                      "stale_aborts": 7}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"maintenance": {"drift_score": 0.3,
+                                      "recall_decay": 0.005,
+                                      "maintenance_cycles": 3,
+                                      "recall_estimate": 0.96,
+                                      "stale_aborts": 0}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["maintenance.drift_score"] == "improved"
+    assert rows["maintenance.recall_decay"] == "improved"
+    assert rows["maintenance.maintenance_cycles"] == "improved"
+    assert rows["maintenance.recall_estimate"] == "improved"
+    assert rows["maintenance.stale_aborts"] == "·"
+    assert "regression" not in rows.values(), proc.stdout

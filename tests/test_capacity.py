@@ -666,7 +666,10 @@ def test_paged_store_tenant_ledger_repredicted_on_promote(tmp_path, rng):
     assert not hot_res.degraded
     ctrl.demote("store")
     assert ctrl.promote("store")["status"] == "ok"
-    # the resident object is now the packed index; the ledger follows it
+    # the resident object is a REHYDRATED paged store (round 19 mutable
+    # tiering: the page plan survives the round trip); the ledger follows
+    # whatever is actually resident
+    assert isinstance(t.hot_obj, serving.PagedListStore)
     assert t.hot_bytes == obs_memory.index_bytes(t.hot_obj)
     assert t.resident_bytes() == t.hot_bytes + t.warm_bytes
     res = ctrl.search("store", Q, 5, n_probes=8)
@@ -728,3 +731,107 @@ def test_tenant_mutators_survive_concurrent_serving(tmp_path):
     assert tenant.demotions == swaps
     assert tenant.tier in (cap.HOT, cap.WARM, cap.COLD)
     assert tenant.last_served > 0.0
+
+
+# ---------------------------------------------------------------------------
+# mutable tiering (ISSUE 18): paged tenants accept mutations in any tier
+# ---------------------------------------------------------------------------
+
+
+def _paged_tenant(tmp_path, seed=3, n=900, dim=16):
+    r = np.random.default_rng(seed)
+    X = r.standard_normal((n, dim)).astype(np.float32)
+    idx = ivf_flat.build(X, ivf_flat.IvfFlatParams(n_lists=8,
+                                                   list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=64)
+    ctrl = cap.CapacityController(budget_bytes=1 << 40)
+    return ctrl, ctrl.register("t", store, tmp_path), store, r
+
+
+def test_tier_cycle_preserves_page_plan_and_mutations(tmp_path):
+    """The full WARM round trip: upsert while HOT, demote (hibernation
+    snapshot + captured page plan), upsert/delete while WARM (buffered,
+    but served EXACTLY), promote — rehydrated paged store with the same
+    compiled-shape operands, buffers replayed, deletes applied."""
+    ctrl, t, store, r = _paged_tenant(tmp_path)
+    dim = store.dim
+    plan0 = (store.page_rows, store.capacity_pages, store.table_width)
+
+    hot_rows = r.standard_normal((4, dim)).astype(np.float32) + 50.0
+    rec = ctrl.upsert("t", hot_rows, ids=np.arange(90_000, 90_004))
+    assert rec["tier"] == cap.HOT and rec["applied"] == 4
+
+    ctrl.demote("t")
+    assert t.tier == cap.WARM and t.page_plan is not None
+    assert t.page_plan["page_rows"] == plan0[0]
+
+    warm_rows = r.standard_normal((3, dim)).astype(np.float32) + 100.0
+    rec = ctrl.upsert("t", warm_rows, ids=np.array([91_000, 91_001, 91_002]))
+    assert rec["buffered"] == 3 and t.pending_rows == 3
+    # buffered rows are served exactly from the WARM (degraded) tier
+    res = ctrl.search("t", warm_rows[:1], k=3, n_probes=8)
+    assert res.degraded and int(np.asarray(res.indices)[0, 0]) == 91_000
+    # a WARM delete drops the buffered row AND tombstones a live id
+    ctrl.delete("t", [91_002, 90_003])
+    res = ctrl.search("t", warm_rows[2:3], k=3, n_probes=8)
+    assert 91_002 not in np.asarray(res.indices)[0]
+    # upsert-after-delete supersedes the tombstone
+    ctrl.upsert("t", np.full((1, dim), 7.0, np.float32),
+                ids=np.array([91_002]))
+
+    out = ctrl.promote("t")
+    assert out["status"] == "ok"
+    assert out["replayed_rows"] == 3 and out["replayed_deletes"] == 1
+    assert isinstance(t.hot_obj, serving.PagedListStore)
+    assert (t.hot_obj.page_rows, t.hot_obj.table_width) == (
+        plan0[0], plan0[2])
+    assert t.hot_obj.capacity_pages >= plan0[1]
+    assert t.pending_rows == 0
+
+    res = ctrl.search("t", np.full((1, dim), 7.0, np.float32), k=3,
+                      n_probes=8)
+    assert not res.degraded and int(np.asarray(res.indices)[0, 0]) == 91_002
+    res = ctrl.search("t", hot_rows[3:4], k=5, n_probes=8)
+    assert 90_003 not in np.asarray(res.indices)[0]
+    res = ctrl.search("t", hot_rows[:1], k=3, n_probes=8)
+    assert int(np.asarray(res.indices)[0, 0]) == 90_000
+
+
+def test_buffered_upsert_keeps_last_write_and_counts(tmp_path, telemetry):
+    """Same-id re-upserts while WARM keep the LAST write (pending_view
+    dedup), and the counter plane tracks buffered vs applied."""
+    ctrl, t, store, r = _paged_tenant(tmp_path, seed=5)
+    dim = store.dim
+    ctrl.demote("t")
+    first = np.full((1, dim), 20.0, np.float32)
+    last = np.full((1, dim), -20.0, np.float32)
+    ctrl.upsert("t", first, ids=np.array([91_000]))
+    ctrl.upsert("t", last, ids=np.array([91_000]))
+    assert t.pending_rows == 2  # raw buffer: dedup happens at view/replay
+    rows, ids, _deletes = t.pending_view()
+    assert ids.tolist() == [91_000] and rows.shape == (1, dim)
+    np.testing.assert_array_equal(rows[0], last[0])
+    res = ctrl.search("t", last, k=1, n_probes=8)
+    assert int(np.asarray(res.indices)[0, 0]) == 91_000
+    assert ctrl.promote("t")["status"] == "ok"
+    res = ctrl.search("t", last, k=1, n_probes=8)
+    assert int(np.asarray(res.indices)[0, 0]) == 91_000
+    rep = ctrl.report()
+    assert rep["buffered_upserts"] == 2 and rep["replays"] == 1
+
+
+def test_warm_mutation_rejects_non_paged_and_anonymous_rows(tmp_path):
+    """Buffered mutation needs ids (there is no live store to assign
+    them) and only paged-store tenants are mutable — both misuses fail
+    loudly, neither corrupts the buffer."""
+    ctrl, t, store, r = _paged_tenant(tmp_path, seed=7)
+    ctrl.demote("t")
+    with pytest.raises(ValueError):
+        ctrl.upsert("t", np.zeros((2, store.dim), np.float32))
+    assert t.pending_rows == 0
+    X, idx = _make_index(seed=11)
+    packed = ctrl.register("packed", idx, tmp_path)
+    ctrl.demote("packed")
+    with pytest.raises(TypeError):
+        ctrl.upsert("packed", X[:2], ids=np.array([1, 2]))
+    assert packed.pending_rows == 0

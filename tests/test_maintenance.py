@@ -1,0 +1,351 @@
+"""Always-live index maintenance: drift detection + online re-clustering.
+
+Tier-1 contracts (ISSUE 18):
+
+* the drift detector folds fill skew / tombstones / recall trend into one
+  normalized score, fires a classified ``drift_detected`` event, and the
+  ``serving.maintenance.{detect,recluster,swap}`` faultpoints surface
+  injected failures CLASSIFIED (never silent, never unclassified) with
+  the entry point healthy once the fault is consumed;
+* recluster parity — after a split/merge cycle the paged store scans
+  bit-identically to a from-scratch ``pack_lists`` rebuild over its own
+  ``_live_rows()`` with the post-cycle centers (the swap changed the
+  layout, never the answers' ground truth);
+* zero recompiles — a maintenance cycle re-dispatches the compiled paged
+  scan (capacity-shaped clone operands), asserted on the
+  ``serving.scan_trace_count`` delta;
+* racing mutations abort classified-``stale`` and the next cycle goes
+  through; the obs report's ``maintenance`` section (schema v5)
+  validates positively and traps corruption.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import obs, resilience, serving
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+from raft_tpu.neighbors._packing import pack_lists
+from raft_tpu.obs import report as obs_report
+from raft_tpu.ops import distance as dist_mod
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def _skewed(rng, kind="ivf_flat", n=900, dim=16, n_lists=8, blob=400):
+    """A paged store with an induced far-away blob piling onto one stale
+    list — returns ``(store, rows_all)`` with ids positional in
+    ``rows_all`` (the exact row_source pq/bq cycles use)."""
+    base = rng.standard_normal((n, dim)).astype(np.float32)
+    if kind == "ivf_flat":
+        idx = ivf_flat.build(base, ivf_flat.IvfFlatParams(
+            n_lists=n_lists, list_size_cap=0))
+    elif kind == "ivf_pq":
+        idx = ivf_pq.build(base, ivf_pq.IvfPqParams(
+            n_lists=n_lists, pq_dim=8, list_size_cap=0))
+    else:
+        idx = ivf_bq.build(base, ivf_bq.IvfBqParams(
+            n_lists=n_lists, list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=64)
+    hot = rng.standard_normal((blob, dim)).astype(np.float32) * 0.2 + 6.0
+    store.upsert(hot, np.arange(n, n + blob, dtype=np.int64))
+    return store, np.concatenate([base, hot])
+
+
+def _mgr(store, rows_all=None, **kw):
+    kw.setdefault("compaction", None)
+    kw.setdefault("drift_threshold", 0.5)
+    kw.setdefault("split_skew", 1.5)
+    kw.setdefault("min_split_rows", 8)
+    if rows_all is not None:
+        kw.setdefault("row_source",
+                      lambda ids: rows_all[np.asarray(ids)])
+    return serving.MaintenanceManager(store, **kw)
+
+
+# ---------------------------------------------------------------------------
+# drift detection
+# ---------------------------------------------------------------------------
+
+
+def test_detect_scores_skew_and_fires_event(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store)
+    obs.reset()
+    obs.enable()
+    try:
+        sig = mgr.detect()
+    finally:
+        obs.disable()
+        obs.reset()
+    assert sig["drifted"] and sig["dominant"] == "skew"
+    assert sig["drift_score"] >= mgr.drift_threshold
+    assert sig["list_skew"] == pytest.approx(store.list_skew())
+    names = [e.get("event") for e in resilience.recent_events()]
+    assert "drift_detected" in names
+
+
+def test_detect_quiet_store_no_drift(rng):
+    base = rng.standard_normal((800, 16)).astype(np.float32)
+    idx = ivf_flat.build(base, ivf_flat.IvfFlatParams(n_lists=8,
+                                                      list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=64)
+    mgr = _mgr(store, drift_threshold=1.0, split_skew=4.0)
+    sig = mgr.detect()
+    assert not sig["drifted"]
+    assert mgr.pump()["status"] == "idle"
+
+
+def test_tombstone_dominant_drift_skips_recluster(rng):
+    """Tombstone-dominant drift is compaction's job: pump() must NOT
+    spend a re-clustering cycle on it."""
+    base = rng.standard_normal((800, 16)).astype(np.float32)
+    idx = ivf_flat.build(base, ivf_flat.IvfFlatParams(n_lists=8,
+                                                      list_size_cap=0))
+    store = serving.PagedListStore.from_index(idx, page_rows=64)
+    store.delete(np.arange(0, 500, dtype=np.int64))
+    mgr = _mgr(store, drift_threshold=0.5, split_skew=100.0)
+    out = mgr.pump()
+    assert out["drift"]["drifted"]
+    assert out["drift"]["dominant"] == "tombstones"
+    assert out["recluster"] is None and out["status"] == "idle"
+
+
+# ---------------------------------------------------------------------------
+# faultpoints: every phase surfaces injected failures classified
+# ---------------------------------------------------------------------------
+
+
+def test_detect_faultpoint_classifies(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store)
+    resilience.arm_faults("serving.maintenance.detect=transient:1")
+    with pytest.raises(Exception) as ei:
+        mgr.detect()
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    # pump() catches the same failure into a classified record
+    resilience.arm_faults("serving.maintenance.detect=transient:1")
+    out = mgr.pump()
+    assert out["status"] == resilience.TRANSIENT
+    assert out["phase"] == "detect"
+    assert mgr.report()["failures"] == 1
+    events = [e for e in resilience.recent_events()
+              if e.get("event") == "maintenance_error"]
+    assert events and events[-1]["kind"] == resilience.TRANSIENT
+    # fault consumed: the detector is healthy again
+    assert mgr.detect()["drifted"]
+
+
+def test_recluster_faultpoint_classifies_then_recovers(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store)
+    skew0 = store.list_skew()
+    resilience.arm_faults("serving.maintenance.recluster=oom:1")
+    out = mgr.recluster()
+    assert out["status"] == resilience.OOM
+    assert mgr.report()["failures"] == 1
+    assert store.list_skew() == pytest.approx(skew0)  # nothing half-done
+    out = mgr.recluster()
+    assert out["status"] == "ok" and out["pairs"] >= 1
+    assert store.list_skew() < skew0
+
+
+def test_swap_faultpoint_aborts_whole_cycle(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store)
+    v0 = store.mutation_version
+    resilience.arm_faults("serving.maintenance.swap=fatal:1")
+    out = mgr.recluster()
+    assert out["status"] == resilience.FATAL
+    # the staged clone was discarded unpublished: no store mutation
+    assert store.mutation_version == v0
+    assert mgr.report()["failures"] == 1 and mgr.report()["cycles"] == 0
+    assert mgr.recluster()["status"] == "ok"
+    assert store.mutation_version > v0
+
+
+def test_phase_deadline_bounds_injected_hang(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store, deadline_s=0.3)
+    resilience.arm_faults("serving.maintenance.recluster=hang:1")
+    t0 = time.perf_counter()
+    out = mgr.recluster()
+    assert time.perf_counter() - t0 < 10.0
+    assert out["status"] == resilience.DEADLINE
+    assert mgr.recluster()["status"] == "ok"
+
+
+def test_stale_abort_on_racing_mutation_then_next_cycle_lands(rng):
+    """A mutation landing between the version snapshot and the swap
+    aborts classified-``stale`` (staged work discarded, nothing torn),
+    and the NEXT cycle goes through against the new version."""
+    store, rows = _skewed(rng)
+    mgr = _mgr(store)
+    # hold the swap faultpoint for 0.4s; the racer upserts in the window
+    resilience.arm_faults("serving.maintenance.swap=delay:1:0.4")
+    racer = threading.Timer(0.05, lambda: store.upsert(
+        rows[:1] + 9.0, np.array([777_777], np.int64)))
+    racer.start()
+    try:
+        out = mgr.recluster()
+    finally:
+        racer.join()
+    assert out["status"] == "stale"
+    rep = mgr.report()
+    assert rep["stale_aborts"] == 1 and rep["failures"] == 0
+    events = [e.get("event") for e in resilience.recent_events()]
+    assert "maintenance_stale" in events
+    # the racing row is live and the retry cycle lands
+    assert mgr.recluster()["status"] == "ok"
+    _, got = serving.search(store, np.asarray(rows[:1] + 9.0), 1, n_probes=8)
+    assert int(np.asarray(got)[0, 0]) == 777_777
+
+
+# ---------------------------------------------------------------------------
+# recluster parity: the cycle changes the layout, never the ground truth
+# ---------------------------------------------------------------------------
+
+
+def _packed_oracle(store):
+    """From-scratch packed build over the maintained store's OWN live
+    rows and post-cycle centers: relabel by nearest center, pack_lists,
+    search packed — fully independent of the staging/swap machinery."""
+    payload, _aux, _extra, ids_np, _labels = store._live_rows()
+    rows = jnp.asarray(payload, jnp.float32)
+    labels = kmeans_balanced.predict(
+        rows, store.centers,
+        kmeans_balanced.KMeansBalancedParams(metric="sqeuclidean"))
+    list_data, list_ids = pack_lists(
+        rows, jnp.asarray(ids_np, jnp.int32), labels,
+        store.centers.shape[0], 64)
+    norms = dist_mod.sqnorm(list_data, axis=2)
+    return ivf_flat.IvfFlatIndex(store.centers, list_data, list_ids,
+                                 norms, "sqeuclidean", 64)
+
+
+def test_recluster_parity_with_packed_rebuild(rng):
+    """Property: after split/merge cycles, paged search over the
+    maintained store is bit-identical (ids AND values) to a packed
+    rebuild from its own live rows + centers."""
+    store, rows = _skewed(rng, blob=500)
+    mgr = _mgr(store)
+    Q = np.concatenate([
+        rng.standard_normal((6, 16)).astype(np.float32),
+        rng.standard_normal((6, 16)).astype(np.float32) * 0.2 + 6.0])
+    for _ in range(3):
+        if not mgr.detect()["drifted"]:
+            break
+        if mgr.recluster()["status"] != "ok":
+            break
+    assert mgr.report()["cycles"] >= 1
+    sv, si = serving.search(store, Q, 10, n_probes=8)
+    ov, oi = ivf_flat.search(_packed_oracle(store), Q, 10, n_probes=8,
+                             backend="gather")
+    np.testing.assert_array_equal(np.asarray(oi), np.asarray(si))
+    # values to float32 accumulation-order tolerance: the clone's aux is
+    # recomputed through _prepare_payload, the oracle's through sqnorm on
+    # the packed layout — same math, different reduction order
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(sv),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("kind", ["ivf_pq", "ivf_bq"])
+def test_recluster_encoded_kinds_keep_answers(rng, kind):
+    """pq/bq cycles re-encode the affected rows against the moved
+    centers (exact row_source): skew drops and the blob queries still
+    resolve to blob ids through the re-clustered layout."""
+    store, rows = _skewed(rng, kind=kind, blob=500)
+    mgr = _mgr(store, rows_all=rows)
+    skew0 = store.list_skew()
+    out = mgr.recluster()
+    assert out["status"] == "ok" and out["rows_moved"] > 0
+    assert store.list_skew() < skew0
+    Q = rows[-8:]
+    _, got = serving.search(store, Q, 5, n_probes=store.n_lists)
+    assert (np.asarray(got)[:, 0] >= 900).all()
+
+
+def test_recluster_reconstruction_row_source_default(rng):
+    """Without a caller row_source the pq cycle assigns from the codes'
+    own reconstruction — it must still land and reduce skew."""
+    store, _ = _skewed(rng, kind="ivf_pq", blob=500)
+    mgr = _mgr(store)
+    skew0 = store.list_skew()
+    assert mgr.recluster()["status"] == "ok"
+    assert store.list_skew() < skew0
+
+
+def test_zero_recompiles_across_cycles(rng):
+    """The swap publishes capacity-shaped clone operands: the compiled
+    paged scan re-dispatches across maintenance cycles — scan trace
+    delta must be exactly zero after warmup."""
+    store, rows = _skewed(rng)
+    mgr = _mgr(store)
+    Q = rows[-4:]
+    serving.search(store, Q, 5, n_probes=8)
+    tc0 = serving.scan_trace_count()
+    for _ in range(3):
+        rec = mgr.pump()
+        assert rec["status"] in ("ok", "idle", "noop")
+        serving.search(store, Q, 5, n_probes=8)
+        if not mgr.detect()["drifted"]:
+            break
+    assert mgr.report()["cycles"] >= 1
+    assert serving.scan_trace_count() - tc0 == 0
+
+
+# ---------------------------------------------------------------------------
+# obs report: the maintenance section (schema v5)
+# ---------------------------------------------------------------------------
+
+
+def test_report_maintenance_section_validates(rng):
+    store, _ = _skewed(rng)
+    mgr = _mgr(store)
+    assert mgr.pump()["status"] == "ok"
+    report = obs_report.collect(maintenance=mgr)
+    assert report["schema_version"] >= 5
+    maint = report["maintenance"]
+    assert maint["cycles"] == 1 and maint["failures"] == 0
+    assert isinstance(maint["recall"], dict)
+    assert not [p for p in obs_report.validate(report)
+                if "maintenance" in p]
+
+
+def test_report_without_maintenance_stays_valid():
+    report = obs_report.collect()
+    assert report["maintenance"] is None
+    assert not [p for p in obs_report.validate(report)
+                if "maintenance" in p]
+
+
+@pytest.mark.parametrize("mutate,fragment", [
+    (lambda m: m.__setitem__("drift_score", float("nan")), "drift_score"),
+    (lambda m: m.__setitem__("cycles", -2), "cycles"),
+    (lambda m: m.__setitem__("recall", "high"), "recall"),
+])
+def test_report_v5_traps_corrupt_maintenance(rng, mutate, fragment):
+    store, _ = _skewed(rng)
+    report = obs_report.collect(maintenance=_mgr(store))
+    mutate(report["maintenance"])
+    assert any(fragment in p for p in obs_report.validate(report))
+
+
+def test_report_v5_leniency_is_version_keyed(rng):
+    """The same malformed section must NOT fail a record stamped with a
+    pre-maintenance schema version — old archives stay readable."""
+    store, _ = _skewed(rng)
+    report = obs_report.collect(maintenance=_mgr(store))
+    report["maintenance"]["drift_score"] = float("nan")
+    report["schema_version"] = 4
+    assert not [p for p in obs_report.validate(report)
+                if "maintenance" in p]
