@@ -726,6 +726,148 @@ def run_suite():
             extras["ivf_bq"] = section_error(e)
         hb.section("ivf_bq", extras["ivf_bq"])
 
+    # --- Filtered & hybrid search (round 20) -------------------------------
+    # The selectivity ladder (unfiltered / 10% / 1%) on flat + bq, plus the
+    # fused dense+sparse rung. Three contracts measured per family:
+    # filtered_recall against brute force OVER THE SURVIVORS (what a
+    # filtered query means), filtered_to_unfiltered_qps_ratio (push-down
+    # means a filter costs VMEM masking + plan widening, never a second
+    # scan — the ratio is a standing zero-tolerance gate), and
+    # recompiles_during_filtered_search across mask-content mutations at
+    # fixed popcount (the zero-recompile contract; pass-rate CHANGES may
+    # legitimately retrace through the widened plan, so the ladder mutates
+    # permutations of one mask).
+    if section_on("filtered"):
+        hb.set_section("filtered")
+        try:
+            from raft_tpu.core.bitset import Bitset
+            from raft_tpu.neighbors import hybrid as hybrid_mod
+            from raft_tpu.obs import compile as fl_compile
+
+            hbm_section_start("filtered")
+            FN = int(min(N, 30_000 if on_cpu else 200_000))
+            f_nlist = int(min(NLIST, 64 if tiny else 256))
+            fdata = dataset[:FN]
+            f_rng = np.random.default_rng(13)
+            filt = {"n": FN, "n_lists": f_nlist, "nprobe": NPROBE0}
+
+            def _id_recall(ids, gt_global):
+                ids = np.asarray(ids)
+                return float(np.mean([
+                    len(set(ids[r]) & set(gt_global[r])) / K
+                    for r in range(ids.shape[0])]))
+
+            def _survivor_gt(surv):
+                bf = brute_force.build(fdata[jnp.asarray(surv)])
+                _, gi = brute_force.search(bf, queries, K,
+                                           select_algo="exact")
+                return surv[np.asarray(gi)]
+
+            fl_index = ivf_flat.build(fdata, ivf_flat.IvfFlatParams(
+                n_lists=f_nlist, kmeans_trainset_fraction=0.2))
+            fbq_index = ivf_bq.build(fdata, ivf_bq.IvfBqParams(
+                n_lists=f_nlist, kmeans_trainset_fraction=0.2))
+
+            def flat_run(f):
+                return lambda qs: ivf_flat.search(
+                    fl_index, qs, K, n_probes=NPROBE0, filter=f)
+
+            def bq_run(f):
+                kf = min(K * 4, 512)
+
+                def run(qs):
+                    _, cand = ivf_bq.search(fbq_index, qs, kf,
+                                            n_probes=NPROBE0, filter=f)
+                    return refine.refine(fdata, qs, cand, K)
+                return run
+
+            def flat_traces():
+                return (fl_compile.trace_count("ivf_flat.search")
+                        + fl_compile.trace_count("ivf_flat.search_ragged"))
+
+            for fam, mk_run, traces in (
+                    ("ivf_flat", flat_run, flat_traces),
+                    ("ivf_bq", bq_run, ivf_bq.scan_trace_count)):
+                row = {}
+                base_qps = None
+                base_mask = None
+                for sel, tag in ((None, "unfiltered"), (0.10, "sel10"),
+                                 (0.01, "sel01")):
+                    if sel is None:
+                        f, surv = None, np.arange(FN)
+                    else:
+                        mask = f_rng.random(FN) < sel
+                        mask[:K] = True  # >= K survivors at any FN
+                        f = Bitset.from_mask(mask)
+                        surv = np.flatnonzero(mask)
+                        if sel == 0.01:
+                            base_mask = mask
+                    run = mk_run(f)
+                    gt_glob = _survivor_gt(surv)
+                    _, ids = run(queries)
+                    rung = {"qps": round(_time_qps(
+                        run, queries, REPS,
+                        hist=f"bench.filtered.{fam}.{tag}_latency_s"), 1)}
+                    if sel is None:
+                        base_qps = rung["qps"]
+                        rung["recall"] = round(_id_recall(ids, gt_glob), 4)
+                    else:
+                        rung["filtered_recall"] = round(
+                            _id_recall(ids, gt_glob), 4)
+                        rung["selectivity"] = sel
+                        if base_qps:
+                            rung["filtered_to_unfiltered_qps_ratio"] = \
+                                round(rung["qps"] / base_qps, 3)
+                    row[tag] = rung
+                # zero-recompile: permute the 1% mask (same popcount ->
+                # same widened plan) and re-dispatch; any retrace is a
+                # contract violation
+                t0 = traces()
+                for _ in range(3):
+                    perm = f_rng.permutation(base_mask)
+                    perm[:K] = True
+                    vv, _ = mk_run(Bitset.from_mask(perm))(queries)
+                    _force(vv)
+                row["recompiles_during_filtered_search"] = traces() - t0
+                filt[fam] = row
+
+            # hybrid rung: fused dense+sparse vs exact fused ground truth
+            vocab, sdim = 1000, 128
+            sp_rows = ((f_rng.random((FN, vocab)) < 0.02)
+                       * f_rng.random((FN, vocab))).astype(np.float32)
+            hyb = hybrid_mod.build(
+                np.asarray(fdata), sp_rows,
+                ivf_bq.IvfBqParams(n_lists=f_nlist,
+                                   metric="inner_product",
+                                   kmeans_trainset_fraction=0.2),
+                sparse_dim=sdim)
+            FQ = int(min(Q, 256))
+            qd = np.asarray(queries[:FQ])
+            qs_sp = sp_rows[:FQ]
+            fused_q = hybrid_mod.fuse_queries(hyb, qd, qs_sp)
+            fused_rows = jnp.concatenate(
+                [fdata, hyb.beta * hybrid_mod.project_sparse(
+                    sp_rows, sdim)], axis=1)
+            exact = fused_q @ fused_rows.T
+            gt_h = np.asarray(
+                jax.lax.top_k(exact, K)[1])
+            hv, hi = hybrid_mod.search(hyb, qd, qs_sp, K,
+                                       n_probes=NPROBE0 * 2)
+            hrow = {"sparse_dim": sdim, "vocab": vocab,
+                    "hybrid_recall": round(_id_recall(hi, gt_h), 4),
+                    "qps": round(_time_qps(
+                        lambda q_: hybrid_mod.search(
+                            hyb, q_, qs_sp[: q_.shape[0]], K,
+                            n_probes=NPROBE0 * 2),
+                        jnp.asarray(qd), REPS,
+                        hist="bench.filtered.hybrid_latency_s"), 1)}
+            filt["hybrid"] = hrow
+            extras["filtered"] = filt
+            del fl_index, fbq_index, hyb
+        except Exception as e:
+            extras["filtered"] = section_error(e)
+        hb.section("filtered", extras["filtered"])
+
     # --- IVF-BQ build fast path (ROADMAP item 5, round 17) -----------------
     # Three rungs of the billion-scale build story: (a) the dense-vs-SRHT
     # rotation apply timing pair at d >= 512 (the O(d²)→O(d·log d) claim,

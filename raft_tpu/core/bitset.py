@@ -68,6 +68,40 @@ class Bitset:
     def count(self) -> jax.Array:
         return self.to_mask().sum()
 
+    def popcount(self) -> jax.Array:
+        """Number of set bits in ``[0, n_bits)`` — SWAR over the packed
+        words (O(n_words) VPU work, no unpack to a bool vector).
+
+        ``create(default=True)`` fills tail bits past ``n_bits`` in the
+        last word; those are masked off here so the count matches
+        :meth:`count` exactly.
+        """
+        x = self.bits
+        tail = self.n_bits % 32
+        if tail and x.shape[0]:
+            last = x[-1] & jnp.uint32((1 << tail) - 1)
+            x = x.at[-1].set(last)
+        x = x - ((x >> 1) & jnp.uint32(0x55555555))
+        x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+        x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+        per_word = (x * jnp.uint32(0x01010101)) >> 24
+        return per_word.astype(jnp.int32).sum()
+
+    def pass_rate(self) -> float:
+        """Fraction of ids in ``[0, n_bits)`` that pass — the planner's
+        selectivity estimate. Host float: syncs the device once per
+        distinct bitset object (cached on the instance), so call it from
+        planner code outside jit, never on a traced value."""
+        cached = getattr(self, "_pass_rate_cache", None)
+        if cached is None:
+            n = max(1, self.n_bits)
+            cached = float(self.popcount()) / float(n)
+            try:
+                self._pass_rate_cache = cached
+            except AttributeError:
+                pass
+        return cached
+
     # pytree protocol
     def tree_flatten(self):
         return (self.bits,), self.n_bits

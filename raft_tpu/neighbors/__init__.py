@@ -22,6 +22,7 @@ from raft_tpu.neighbors import (
     brute_force,
     cagra,
     epsilon_neighborhood,
+    hybrid,
     ivf_bq,
     ivf_flat,
     ivf_pq,
@@ -32,5 +33,6 @@ from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 
 __all__ = [
     "ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-    "eps_neighbors", "ivf_bq", "ivf_flat", "ivf_pq", "nn_descent", "refine",
+    "eps_neighbors", "hybrid", "ivf_bq", "ivf_flat", "ivf_pq", "nn_descent",
+    "refine",
 ]

@@ -1,0 +1,95 @@
+"""Shared filter plumbing for the IVF families.
+
+One module owns the two facts every family used to restate locally:
+
+- **The filter→bias rule** (:func:`apply_filter_bias`): a filtered-out row
+  is a ``+inf`` bias lane — the tombstone mechanism generalized. The bias
+  operand already rides every scan engine (packed strip, BQ, paged), so a
+  predicate needs no new kernel path: it is masked in VMEM before ranking,
+  and the kernels skip fully-dead sub-blocks (see
+  ``ops/strip_scan.py``'s ``sub_live`` operand). Out-of-range ids fail the
+  test (``Bitset.test``), so rows minted after the mask was built are
+  excluded rather than served unfiltered.
+
+- **The selectivity→widening rule** (:func:`widen_plan`): a scan at 1%
+  selectivity probes the same lists as the unfiltered scan but 99% of
+  their rows are masked, so k survivors only come back if the plan
+  over-probes. The widening factor is ``min(1/pass_rate,
+  RAFT_TPU_FILTER_MAX_WIDEN)``, applied to ``n_probes`` (every family) and
+  to refine-style over-fetch ``k_fetch`` (ivf_bq/ivf_pq re-rank rungs).
+  ``Bitset.pass_rate()`` is a host float cached per bitset instance, so
+  the plan costs one device sync per distinct filter object, not per
+  query batch.
+
+Families must not re-implement either rule (the three pre-round-19 copies
+in ivf_flat/ivf_pq/ivf_bq had already drifted in id-clamp handling).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+FILTER_MAX_WIDEN_ENV = "RAFT_TPU_FILTER_MAX_WIDEN"
+
+
+def default_filter_max_widen() -> float:
+    """Cap on the selectivity widening factor (``RAFT_TPU_FILTER_MAX_WIDEN``,
+    default 8): a 1/256 pass rate still only widens ``n_probes``/``k_fetch``
+    by this much — past it, recall is bought with a larger mask-aware
+    over-fetch at the caller, not an unbounded probe sweep."""
+    return float(os.environ.get(FILTER_MAX_WIDEN_ENV, "8"))
+
+
+def apply_filter_bias(bias, ids, filter):
+    """Fold ``filter`` into a scan bias: ``+inf`` where the row id fails.
+
+    ``bias`` is the engine's per-entry additive fp32 bias (already ``+inf``
+    at padding/tombstones); ``ids`` the matching source-row ids (``-1`` at
+    padding). Ids are clamped to 0 for the gather — a clamped padding slot
+    may *pass* the test, but its bias is already ``+inf`` and ``where``
+    keeps it, so padding stays dead either way. No-op when ``filter`` is
+    None.
+    """
+    if filter is None:
+        return bias
+    return jnp.where(filter.test(jnp.maximum(ids, 0)), bias, jnp.inf)
+
+
+def widen_plan(
+    filter,
+    n_probes: int,
+    n_lists: int,
+    k_fetch: Optional[int] = None,
+    k_cap: Optional[int] = None,
+    max_widen: Optional[float] = None,
+) -> Tuple[int, Optional[int], float, float]:
+    """Selectivity-aware plan widening.
+
+    Returns ``(n_probes_eff, k_fetch_eff, pass_rate, widen)``. With no
+    filter this is the identity (``pass_rate=1, widen=1``). Otherwise the
+    widening factor is ``min(1/pass_rate, max_widen)`` (knob default:
+    :func:`default_filter_max_widen`); ``n_probes`` is scaled and clamped
+    to ``n_lists``, and ``k_fetch`` (when given — the refine rungs'
+    over-fetch) is scaled and clamped to ``k_cap``. Callers stamp
+    ``pass_rate``/``widen`` on their search span and pass the *effective*
+    values to ``obs_roofline.note_dispatch`` so predicted-vs-measured
+    stays exact.
+    """
+    if filter is None:
+        return int(n_probes), k_fetch, 1.0, 1.0
+    rate = float(filter.pass_rate())
+    cap = default_filter_max_widen() if max_widen is None else float(max_widen)
+    widen = min(max(cap, 1.0), 1.0 / max(rate, 1e-9))
+    widen = max(widen, 1.0)
+    n_probes_eff = int(min(n_lists, math.ceil(n_probes * widen)))
+    k_fetch_eff = k_fetch
+    if k_fetch is not None:
+        k_fetch_eff = int(math.ceil(k_fetch * widen))
+        if k_cap is not None:
+            k_fetch_eff = min(int(k_cap), k_fetch_eff)
+        k_fetch_eff = max(int(k_fetch), k_fetch_eff)
+    return n_probes_eff, k_fetch_eff, rate, widen

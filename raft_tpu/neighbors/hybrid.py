@@ -1,0 +1,237 @@
+"""Hybrid dense+sparse retrieval as ONE fused BQ contraction.
+
+The production hybrid-search shape is "dense ANN + sparse term scores,
+merged": run a vector index and an inverted text index side by side, then
+reconcile two candidate lists with RRF or a learned mixer. That shape
+pays two scans, two top-k selects, and a host-side merge — and the merge
+sees only each side's survivors, so a row that is mediocre on both axes
+but strong combined is lost before reconciliation.
+
+This module folds the sparse side INTO the dense scan instead. Sparse
+rows (CSR/COO over a term vocabulary, :mod:`raft_tpu.sparse`) are
+sign-hashed into a fixed ``sparse_dim``-wide block — feature hashing
+(Weinberger et al.): term ``t`` lands in column ``h(t) mod sparse_dim``
+with sign ``±1`` from a second hash bit, so ``⟨proj(a), proj(b)⟩`` is an
+unbiased estimator of the sparse inner product ``⟨a, b⟩`` with collision
+variance ``O(‖a‖²‖b‖²/sparse_dim)``. The fused row is the concat
+
+    ``[ dense | β · proj(sparse) ]``
+
+and one IVF-BQ index over it under ``inner_product`` scores
+
+    ``⟨q_d, x_d⟩ + β² · ⟨proj(q_s), proj(x_s)⟩``
+
+— the dense score plus the β²-weighted sparse term score, ranked in ONE
+wider strip contraction feeding the same ``merge_strip_candidates``
+select the dense-only scan uses. No second index, no candidate-list
+reconciliation, and every first-class property of the BQ family rides
+along for free: predicate push-down (``filter=`` masks fused rows in
+VMEM before ranking), selectivity-aware widening, the paged mutable
+store (:func:`to_store` → ``serving.search`` with fused queries), and
+the distributed path — ``distributed.ivf_bq`` over the fused rows
+shards/merges/health-gates (``probe_shards``) the concat unchanged,
+because after :func:`build` a hybrid index IS an ``IvfBqIndex``.
+
+``sparse_dim`` defaults to ``RAFT_TPU_HYBRID_SPARSE_DIM`` (256): at BQ's
+1 bit/dim the sparse block adds 32 bytes/row. ``β`` tunes the
+dense↔sparse balance and is baked into the stored rows, so changing it
+is a rebuild (document-side weights are β-scaled at encode time).
+
+Persistence: a :class:`HybridIndex` is not in the v2 snapshot registry —
+serialize the wrapped ``.index`` (a plain ``IvfBqIndex``) and rewrap
+with the same ``(dense_dim, sparse_dim, beta, seed)``; the projection is
+stateless given those.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources
+from raft_tpu.core.trace import traced
+from raft_tpu.neighbors import ivf_bq
+from raft_tpu.sparse.types import COO, CSR
+
+HYBRID_SPARSE_DIM_ENV = "RAFT_TPU_HYBRID_SPARSE_DIM"
+
+
+def default_hybrid_sparse_dim() -> int:
+    """Width of the hashed sparse block (``RAFT_TPU_HYBRID_SPARSE_DIM``,
+    default 256 — lane-width aligned; collision variance on the sparse
+    score falls as 1/width, row cost grows as width·bits/8 bytes)."""
+    return int(os.environ.get(HYBRID_SPARSE_DIM_ENV, "256"))
+
+
+def _hash_cols_signs(term_ids, sparse_dim: int, seed: int):
+    """Deterministic term → (column, sign) feature hash.
+
+    One 32-bit finalizer-style integer mix (xorshift-multiply rounds) per
+    term id; the low bits pick the column, bit 31 the sign. Stateless —
+    the same (term, sparse_dim, seed) maps identically at build time,
+    query time, and on every shard."""
+    h = jnp.asarray(term_ids, jnp.uint32) ^ jnp.uint32(seed * 0x9E3779B9 + 1)
+    h ^= h >> 16
+    h *= jnp.uint32(0x7FEB352D)
+    h ^= h >> 15
+    h *= jnp.uint32(0x846CA68B)
+    h ^= h >> 16
+    col = (h % jnp.uint32(sparse_dim)).astype(jnp.int32)
+    sign = jnp.where((h >> 31) > 0, 1.0, -1.0).astype(jnp.float32)
+    return col, sign
+
+
+def project_sparse(sp, sparse_dim: Optional[int] = None,
+                   seed: int = 0) -> jax.Array:
+    """Sign-hash sparse rows into a dense ``(n, sparse_dim)`` fp32 block.
+
+    ``sp`` is a :class:`~raft_tpu.sparse.types.CSR` or
+    :class:`~raft_tpu.sparse.types.COO` (padding contributes zero, per the
+    sparse tier's contract) or an already-dense ``(n, vocab)`` array.
+    Colliding terms scatter-ADD with their hash signs — the unbiasedness
+    argument needs the signed sum, not overwrite."""
+    dim = default_hybrid_sparse_dim() if sparse_dim is None else int(sparse_dim)
+    if dim <= 0:
+        raise ValueError(f"sparse_dim must be positive, got {dim}")
+    if isinstance(sp, CSR):
+        rows, cols, vals = sp.row_ids(), sp.indices, sp.data
+        n = sp.shape[0]
+        valid = jnp.arange(sp.capacity) < sp.nnz()
+    elif isinstance(sp, COO):
+        rows, cols, vals = sp.rows, sp.cols, sp.vals
+        n = sp.shape[0]
+        valid = sp.valid
+    else:
+        dense = jnp.asarray(sp, jnp.float32)
+        if dense.ndim != 2:
+            raise ValueError(f"expected 2-D sparse rows, got {dense.shape}")
+        n, vocab = dense.shape
+        col, sign = _hash_cols_signs(jnp.arange(vocab), dim, seed)
+        proj = jnp.zeros((vocab, dim), jnp.float32)
+        proj = proj.at[jnp.arange(vocab), col].set(sign)
+        return dense @ proj
+    col, sign = _hash_cols_signs(jnp.clip(cols, 0), dim, seed)
+    v = jnp.where(valid, jnp.asarray(vals, jnp.float32) * sign, 0.0)
+    r = jnp.clip(jnp.asarray(rows, jnp.int32), 0, n - 1)
+    out = jnp.zeros((n, dim), jnp.float32)
+    return out.at[r, col].add(v)
+
+
+@dataclass(frozen=True)
+class HybridIndex:
+    """An :class:`~raft_tpu.neighbors.ivf_bq.IvfBqIndex` over fused
+    ``[dense | β·proj(sparse)]`` rows, plus the projection parameters a
+    query needs to land in the same space."""
+
+    index: ivf_bq.IvfBqIndex
+    dense_dim: int
+    sparse_dim: int
+    beta: float
+    seed: int = 0
+
+    @property
+    def n_lists(self) -> int:
+        return self.index.n_lists
+
+    @property
+    def dim(self) -> int:
+        return self.index.dim
+
+
+@traced("hybrid::build")
+def build(
+    dense,
+    sparse,
+    params: Optional[ivf_bq.IvfBqParams] = None,
+    beta: float = 1.0,
+    sparse_dim: Optional[int] = None,
+    seed: int = 0,
+    res: Optional[Resources] = None,
+) -> HybridIndex:
+    """Build the fused index: hash-project ``sparse``, β-scale, concat
+    onto ``dense``, and IVF-BQ-build the result under ``inner_product``
+    (the only metric where the concat's score decomposes into
+    dense + β²·sparse — a caller-side L2 request is rejected rather than
+    silently rescored)."""
+    dense = jnp.asarray(dense, jnp.float32)
+    if dense.ndim != 2:
+        raise ValueError(f"dense rows must be (n, d), got {dense.shape}")
+    sdim = default_hybrid_sparse_dim() if sparse_dim is None else int(sparse_dim)
+    params = params or ivf_bq.IvfBqParams(metric="inner_product")
+    if params.metric != "inner_product":
+        raise ValueError(
+            "hybrid fusion requires metric='inner_product' (the concat "
+            f"score only decomposes there), got {params.metric!r}")
+    proj = project_sparse(sparse, sdim, seed)
+    if proj.shape[0] != dense.shape[0]:
+        raise ValueError(
+            f"dense has {dense.shape[0]} rows, sparse {proj.shape[0]}")
+    fused = jnp.concatenate([dense, float(beta) * proj], axis=1)
+    if obs.enabled():
+        obs.add("hybrid.build.rows", int(fused.shape[0]))
+    with obs.record_span("hybrid::build",
+                         attrs={"rows": int(fused.shape[0]),
+                                "dense_dim": int(dense.shape[1]),
+                                "sparse_dim": sdim, "beta": float(beta)}):
+        inner = ivf_bq.build(fused, params, res=res)
+    return HybridIndex(inner, int(dense.shape[1]), sdim, float(beta),
+                       int(seed))
+
+
+def fuse_queries(hybrid: HybridIndex, dense_q, sparse_q) -> jax.Array:
+    """Project queries into the fused space: ``[q_d | β·proj(q_s)]``.
+
+    The serving entry for hybrid stores: ``serving.search(to_store(h),
+    fuse_queries(h, qd, qs), k)`` — the store is a plain ivf_bq store and
+    never learns about the fusion."""
+    dense_q = jnp.asarray(dense_q, jnp.float32)
+    if dense_q.ndim != 2 or dense_q.shape[1] != hybrid.dense_dim:
+        raise ValueError(
+            f"queries must be (q, {hybrid.dense_dim}), got {dense_q.shape}")
+    proj = project_sparse(sparse_q, hybrid.sparse_dim, hybrid.seed)
+    return jnp.concatenate([dense_q, hybrid.beta * proj], axis=1)
+
+
+@traced("hybrid::search")
+def search(
+    hybrid: HybridIndex,
+    dense_q,
+    sparse_q,
+    k: int,
+    n_probes: int = 20,
+    filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused hybrid k-NN: one BQ strip scan over the concat ranks
+    ``⟨q_d, x_d⟩ + β²·⟨proj(q_s), proj(x_s)⟩`` directly. Returns
+    (scores, indices), scores in ivf_bq's negated-inner-product order.
+    ``filter`` and every other ivf_bq search knob pass straight through —
+    push-down and selectivity widening apply to the fused scan
+    unchanged."""
+    fused_q = fuse_queries(hybrid, dense_q, sparse_q)
+    if obs.enabled():
+        obs.add("hybrid.searches")
+    with obs.record_span("hybrid::search",
+                         attrs={"queries": int(fused_q.shape[0]),
+                                "k": int(k), "n_probes": int(n_probes),
+                                "filtered": filter is not None}):
+        return ivf_bq.search(hybrid.index, fused_q, k, n_probes=n_probes,
+                             filter=filter, res=res, **kwargs)
+
+
+def to_store(hybrid: HybridIndex, **kwargs):
+    """Wrap the fused index as a paged serving store
+    (:class:`~raft_tpu.serving.PagedListStore`, kind ``"ivf_bq"``).
+    Upserts must be pre-fused rows (``[dense | β·proj(sparse)]`` — build
+    them with :func:`project_sparse` and the index's β/seed); queries go
+    through :func:`fuse_queries`."""
+    from raft_tpu.serving import PagedListStore
+
+    return PagedListStore.from_index(hybrid.index, **kwargs)

@@ -54,6 +54,7 @@ from raft_tpu.obs import compile as obs_compile
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import _filtering
 from raft_tpu.core.trace import traced
 from raft_tpu.core.resources import Resources, current_resources
 from raft_tpu.core.serialize import load_arrays, save_arrays
@@ -723,9 +724,7 @@ def _bq_search_prep(queries, centers, rotation, list_bias, list_ids, filter,
         queries, centers, rotation, n_probes, select_algo, l2,
         rotation_kind)
     qr = extend_query_planes(qr, bits)
-    bias = list_bias
-    if filter is not None:
-        bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
+    bias = _filtering.apply_filter_bias(list_bias, list_ids, filter)
     return probes, qr, bias, pair_const
 
 
@@ -809,6 +808,16 @@ def search(
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, index.n_lists))
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+
+        faultpoint("ivf_bq.search.filter")
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, index.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     if not 0 < k <= min(n_probes * index.max_list_size, 512):
         raise ValueError(
             f"k={k} out of range (1..min(n_probes·max_list_size, 512)) for "
@@ -830,6 +839,8 @@ def search(
         obs.add(f"ivf_bq.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         # roofline note (round 15): packed-scan FLOP/byte model + strip
         # occupancy at the scan's real planning width (rot_dim) when the
         # host already caches per-list lengths (no forced device sync)
@@ -975,6 +986,17 @@ def search_paged(
     if queries.ndim != 2 or queries.shape[1] != store.dim:
         raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, store.n_lists))
+    if filter is None:
+        filter = getattr(store, "filter", None)
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+        faultpoint("ivf_bq.search.filter")
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, store.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     from raft_tpu.neighbors.ivf_flat import (_paged_plan_static,
                                              paged_backend_auto)
 
@@ -1003,6 +1025,8 @@ def search_paged(
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k),
                       "table_width": width}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         from raft_tpu.ops.strip_scan import paged_occupancy_stats
         occ = obs_roofline.memo_occupancy(
             store,
@@ -1059,6 +1083,11 @@ def search_refined(
     if refine_ratio < 1:
         raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
     k_fetch = min(int(k) * int(refine_ratio), 512)
+    if filter is not None:
+        # widen the over-fetch too: at low pass rates k·refine_ratio
+        # candidates shrink to k·refine_ratio·pass_rate survivors
+        _, k_fetch, _, _ = _filtering.widen_plan(
+            filter, n_probes, index.n_lists, k_fetch=k_fetch, k_cap=512)
     _, cand = search(index, queries, k_fetch, n_probes=n_probes,
                      filter=filter, res=res)
     return refine_mod.refine(dataset, queries, cand, int(k),

@@ -38,6 +38,7 @@ from raft_tpu.obs import compile as obs_compile
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import _filtering
 from raft_tpu.neighbors import _packing
 from raft_tpu.neighbors._packing import pack_lists, unpack_lists
 from raft_tpu.core.trace import traced
@@ -342,12 +343,12 @@ def _coarse_probes(queries, centers, n_probes, metric, select_algo, compute_dtyp
 @functools.partial(jax.jit, static_argnames=("mode",))
 def _ragged_bias(list_ids, list_norms, filter, mode: str):
     """Per-entry additive bias for the ragged scan: ‖x‖² for L2, 0 for
-    ip/cosine; +inf at padding and filtered-out entries."""
-    valid = list_ids >= 0
-    if filter is not None:
-        valid = valid & filter.test(jnp.maximum(list_ids, 0))
+    ip/cosine; +inf at padding and filtered-out entries (the shared
+    :func:`_filtering.apply_filter_bias` rule — one copy across the
+    families)."""
     base = list_norms if mode == "l2" else jnp.zeros_like(list_ids, jnp.float32)
-    return jnp.where(valid, base, jnp.inf).astype(jnp.float32)
+    bias = jnp.where(list_ids >= 0, base, jnp.inf).astype(jnp.float32)
+    return _filtering.apply_filter_bias(bias, list_ids, filter)
 
 
 @functools.partial(jax.jit, static_argnames=("metric",))
@@ -566,6 +567,19 @@ def search(
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, index.n_lists))
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+
+        faultpoint("ivf_flat.search.filter")
+        # selectivity-aware widening: over-probe by ~1/pass_rate (capped)
+        # so k SURVIVORS come back at selective filters — the effective
+        # n_probes flows into validation, telemetry and the roofline model
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, index.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     if not 0 < k <= n_probes * index.max_list_size:
         raise ValueError(
             f"k={k} out of range for n_probes={n_probes} x max_list_size={index.max_list_size}"
@@ -592,6 +606,8 @@ def search(
         obs.add(f"ivf_flat.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         # roofline note (round 15): static FLOP/byte model of this
         # dispatch, plus the strip planner's occupancy stats when the
         # host already holds the per-list lengths (the ragged path's
@@ -765,12 +781,10 @@ def _paged_fused(queries, centers, pages, bias_pool, page_ids, table,
           and centers.shape[0] <= 4096 else select_algo)
     probes = _coarse_probes(queries, centers, n_probes, metric, sa,
                             compute_dtype)
-    bias = bias_pool
-    if filter is not None:
-        # the store's bias pool is already +inf at dead slots; the filter
-        # masks live rows by their source id (the _ragged_bias protocol)
-        bias = jnp.where(filter.test(jnp.maximum(page_ids, 0)), bias,
-                         jnp.inf)
+    # the store's bias pool is already +inf at dead slots; the filter
+    # masks live rows by their source id (the shared
+    # _filtering.apply_filter_bias rule)
+    bias = _filtering.apply_filter_bias(bias_pool, page_ids, filter)
     l2 = metric in ("sqeuclidean", "euclidean")
     vals, ids = paged_strip_search_traced(
         queries, probes, pages, bias, page_ids, table, chain_pages,
@@ -840,6 +854,20 @@ def search_paged(
     if queries.ndim != 2 or queries.shape[1] != store.dim:
         raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, store.n_lists))
+    if filter is None:
+        # a standing store-level filter (PagedListStore.set_filter) applies
+        # when the caller passes none — per-call filters take precedence
+        filter = getattr(store, "filter", None)
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+
+        faultpoint("ivf_flat.search.filter")
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, store.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     if backend == "auto":
         backend = paged_backend_auto(store, k)
     if backend not in ("gather", "paged_pallas", "paged_jnp"):
@@ -866,6 +894,8 @@ def search_paged(
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k),
                       "table_width": width}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         if backend == "gather":
             # roofline note (round 15): the gather scan's per-(query,
             # probe) capacity-padded chain cost — no cross-query sharing,

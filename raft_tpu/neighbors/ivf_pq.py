@@ -48,6 +48,7 @@ from raft_tpu.obs import compile as obs_compile
 from raft_tpu.obs import roofline as obs_roofline
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import _filtering
 from raft_tpu.neighbors import _packing
 from raft_tpu.core.logger import get_logger
 from raft_tpu.core.trace import traced
@@ -1045,9 +1046,7 @@ def _ragged_bias_pq(b_sum, centers, rotation, list_ids, filter, l2: bool):
         bias = rc2[:, None] + b_sum
     else:
         bias = b_sum
-    if filter is not None:
-        bias = jnp.where(filter.test(jnp.maximum(list_ids, 0)), bias, jnp.inf)
-    return bias
+    return _filtering.apply_filter_bias(bias, list_ids, filter)
 
 
 @functools.partial(
@@ -1418,6 +1417,16 @@ def search(
     if queries.ndim != 2 or queries.shape[1] != index.dim:
         raise ValueError(f"queries must be (q, {index.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, index.n_lists))
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+
+        faultpoint("ivf_pq.search.filter")
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, index.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     if not 0 < k <= n_probes * index.max_list_size:
         raise ValueError(f"k={k} out of range")
     if index.metric == "cosine":
@@ -1466,6 +1475,8 @@ def search(
         obs.add(f"ivf_pq.search.backend.{backend}", 1)
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k)}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         # roofline note (round 15): static FLOP/byte model + strip
         # occupancy when the host already caches per-list lengths (the
         # ragged path; telemetry must never force a device sync)
@@ -1753,10 +1764,7 @@ def _paged_fused_pq(queries, centers, rotation, cache_pool, bias_pool,
     probes, qr, pair_const = _pq_probe_prep(
         queries, centers, rotation, n_probes, sa, l2)
     alpha = -2.0 if l2 else -1.0
-    bias = bias_pool
-    if filter is not None:
-        bias = jnp.where(filter.test(jnp.maximum(page_ids, 0)), bias,
-                         jnp.inf)
+    bias = _filtering.apply_filter_bias(bias_pool, page_ids, filter)
     vals, ids = paged_strip_search_traced(
         qr * decoded_scale, probes, cache_pool, bias, page_ids, table,
         chain_pages, int(k), int(k), alpha, q_tile, interpret,
@@ -1794,6 +1802,18 @@ def search_paged(
     if queries.ndim != 2 or queries.shape[1] != store.dim:
         raise ValueError(f"queries must be (q, {store.dim}), got {queries.shape}")
     n_probes = int(min(n_probes, store.n_lists))
+    if filter is None:
+        filter = getattr(store, "filter", None)
+    filter_attrs = None
+    if filter is not None:
+        from raft_tpu.resilience import faultpoint
+
+        faultpoint("ivf_pq.search.filter")
+        n_probes, _, f_rate, f_widen = _filtering.widen_plan(
+            filter, n_probes, store.n_lists)
+        filter_attrs = {"filter_pass_rate": round(f_rate, 6),
+                        "filter_widen_x": round(f_widen, 4),
+                        "filter_n_probes": n_probes}
     from raft_tpu.neighbors.ivf_flat import (_paged_plan_static,
                                              paged_backend_auto)
 
@@ -1823,6 +1843,8 @@ def search_paged(
         scan_attrs = {"backend": backend, "queries": q_obs,
                       "probes": int(n_probes), "k": int(k),
                       "table_width": width}
+        if filter_attrs:
+            scan_attrs.update(filter_attrs)
         if backend == "gather":
             # roofline note (round 15): LUT-scan cost over the capacity-
             # padded page chains (no cross-query sharing on this path)

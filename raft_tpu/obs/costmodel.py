@@ -557,11 +557,23 @@ def estimate(entry: str, **shapes) -> dict:
 
 
 def estimate_search(index, q: int, k: int, n_probes: int = 0,
-                    workspace_bytes: Optional[int] = None) -> dict:
+                    workspace_bytes: Optional[int] = None,
+                    filter=None) -> dict:
     """:func:`estimate` with kwargs derived from a live index/store — the
-    bench-section and serving-dispatch convenience."""
+    bench-section and serving-dispatch convenience.
+
+    ``filter`` (a :class:`~raft_tpu.core.bitset.Bitset`) projects the
+    footprint of the plan the dispatch will ACTUALLY run: the families
+    widen ``n_probes`` by the selectivity factor
+    (``neighbors/_filtering.widen_plan``) before scanning, so a filtered
+    estimate widens here with the same rule — predicted-vs-measured
+    stays exact under push-down."""
     layout = index_layout(index)
     kind = layout.pop("kind")
+    if filter is not None and n_probes:
+        from raft_tpu.neighbors import _filtering
+        n_probes, _, _, _ = _filtering.widen_plan(
+            filter, n_probes, layout.get("n_lists", n_probes))
     ws = {"workspace_bytes": workspace_bytes}
     if kind == "ivf_flat":
         return estimate("ivf_flat.search", q=q, k=k, n_probes=n_probes,

@@ -171,16 +171,21 @@ def _score_topk(a, b_packed, scale_row, bias_row, alpha: float, kf: int,
     return ss._topk_block(s, kf, w, approx_ok)
 
 
-def _bq_strip_kernel(sl_ref, a_ref, b_ref, scale_ref, bias_ref, outv_ref,
-                     oute_ref, *, alpha, kf, w, n_sub, approx_ok):
+def _bq_strip_kernel(sl_ref, lv_ref, a_ref, b_ref, scale_ref, bias_ref,
+                     outv_ref, oute_ref, *, alpha, kf, w, n_sub, approx_ok):
     """One strip (× one sub-block when n_sub > 1): in-VMEM unpack + MXU
     matmul + fused top-kf. Mirrors strip_scan._strip_kernel with the packed
     B operand and the per-entry scale; padding strips (strip_list == -1)
-    skip the body via ``pl.when`` exactly like the fp kernel."""
+    skip the body via ``pl.when`` exactly like the fp kernel, and dead
+    sub-blocks (``lv_ref`` word 0: every bias lane +inf — filtered out /
+    tombstoned / padding) skip the unpack+matmul and write the all-dead
+    extraction constant on first visit (bit parity argument in
+    strip_scan._strip_kernel)."""
     slv = sl_ref[pl.program_id(0)]
     j = pl.program_id(1) if n_sub > 1 else 0
+    lvv = lv_ref[jnp.maximum(slv, 0) * n_sub + (j if n_sub > 1 else 0)]
 
-    @pl.when(slv >= 0)
+    @pl.when((slv >= 0) & (lvv > 0))
     def _compute():
         nv, ne = _score_topk(a_ref[0], b_ref[0], scale_ref[0], bias_ref[0],
                              alpha, kf, w, approx_ok)
@@ -205,6 +210,14 @@ def _bq_strip_kernel(sl_ref, a_ref, b_ref, scale_ref, bias_ref, outv_ref,
             outv_ref[0] = mv
             oute_ref[0] = me
 
+    c = outv_ref.shape[1]
+    first = (j == 0) if n_sub > 1 else True
+
+    @pl.when((slv >= 0) & (lvv == 0) & first)
+    def _dead_first():
+        outv_ref[0] = jnp.full((c, kf), jnp.inf, jnp.float32)
+        oute_ref[0] = lax.broadcasted_iota(jnp.int32, (c, kf), 1)
+
 
 @functools.partial(
     jax.jit,
@@ -220,28 +233,44 @@ def _bq_class_call(strip_list, a_grouped, list_codes, scale3, bias3,
     s_pad, c, rot_dim = a_grouped.shape
     w = w_blocks * MC
     nb = list_codes.shape[-1]
+    n_lists = bias3.shape[0]
+
+    # per-(list, sub-block) liveness words: all-+inf-bias sub-blocks skip
+    # their DMAs and compute (strip_scan._strip_class_call convention)
+    fin = jnp.isfinite(bias3[:, 0, : n_sub * w]).reshape(n_lists, n_sub, w)
+    sub_live = jnp.any(fin, axis=2).astype(jnp.int32).reshape(-1)
 
     # padding strips: block maps collapse to constants (no refetch), outputs
-    # route to the trash row — the fp kernel's exact convention
+    # route to the trash row — the fp kernel's exact convention; dead
+    # sub-blocks collapse their code/scale/bias maps the same way but keep
+    # their output row (the kernel writes the all-dead constant)
     if n_sub > 1:
         grid = (s_pad, n_sub)
         pad_ = lambda i, sl: sl[i] < 0
-        a_map = lambda i, j, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
-        b_map = lambda i, j, sl: (jnp.maximum(sl[i], 0),
-                                  jnp.where(pad_(i, sl), 0, j), 0)
-        sb_map = lambda i, j, sl: (jnp.maximum(sl[i], 0), 0,
-                                   jnp.where(pad_(i, sl), 0, j))
-        o_map = lambda i, j, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+        dead_ = lambda i, j, sl, lv: pad_(i, sl) | (
+            lv[jnp.maximum(sl[i], 0) * n_sub + j] == 0)
+        a_map = lambda i, j, sl, lv: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, j, sl, lv: (
+            jnp.where(dead_(i, j, sl, lv), 0, jnp.maximum(sl[i], 0)),
+            jnp.where(dead_(i, j, sl, lv), 0, j), 0)
+        sb_map = lambda i, j, sl, lv: (
+            jnp.where(dead_(i, j, sl, lv), 0, jnp.maximum(sl[i], 0)), 0,
+            jnp.where(dead_(i, j, sl, lv), 0, j))
+        o_map = lambda i, j, sl, lv: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
     else:
         grid = (s_pad,)
         pad_ = lambda i, sl: sl[i] < 0
-        a_map = lambda i, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
-        b_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
-        sb_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
-        o_map = lambda i, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+        dead_ = lambda i, sl, lv: pad_(i, sl) | (
+            lv[jnp.maximum(sl[i], 0)] == 0)
+        a_map = lambda i, sl, lv: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, sl, lv: (
+            jnp.where(dead_(i, sl, lv), 0, jnp.maximum(sl[i], 0)), 0, 0)
+        sb_map = lambda i, sl, lv: (
+            jnp.where(dead_(i, sl, lv), 0, jnp.maximum(sl[i], 0)), 0, 0)
+        o_map = lambda i, sl, lv: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, c, rot_dim), a_map),
@@ -260,7 +289,7 @@ def _bq_class_call(strip_list, a_grouped, list_codes, scale3, bias3,
             jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
         ),
         interpret=interpret,
-    )(strip_list, a_grouped, list_codes, scale3, bias3)
+    )(strip_list, sub_live, a_grouped, list_codes, scale3, bias3)
     return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
             lax.slice_in_dim(oe, 0, s_pad, axis=0))
 
@@ -406,21 +435,25 @@ def _paged_bq_score_topk(a, packed_block, scale_row, bias_row, live_rows,
     return ss._topk_block(s, kf, w, approx_ok)
 
 
-def _paged_bq_kernel(sl_ref, tbl_ref, chain_ref, a_ref, codes_hbm,
+def _paged_bq_kernel(sl_ref, tbl_ref, chain_ref, lv_ref, a_ref, codes_hbm,
                      scale_hbm, bias_hbm, outv_ref, oute_ref, code_s,
                      scale_s, bias_s, csem, ssem, bsem, *, alpha, kf, w,
                      n_sub, ppf, page_rows, table_width, approx_ok):
     """One (strip × page sub-block) of the paged ±1 scan: DMA the live
     code/scale/bias pages HBM→VMEM, unpack to ±1 in VMEM, one MXU matmul +
     fused top-kf (strip_scan._paged_strip_kernel with the packed B operand
-    and the per-row scale)."""
+    and the per-row scale). ``lv_ref`` carries the per-(list, sub-block)
+    filter-liveness words — a dead sub-block (every row +inf-biased)
+    issues no DMAs and skips ranking, same contract as the fp paged
+    kernel."""
     i = pl.program_id(0)
     slv = sl_ref[i]
     j = pl.program_id(1) if n_sub > 1 else 0
     l = jnp.maximum(slv, 0)
     chain = jnp.where(slv >= 0, chain_ref[l], 0)
+    lvv = lv_ref[l * n_sub + (j if n_sub > 1 else 0)]
     base = j * ppf
-    nv = jnp.clip(chain - base, 0, ppf)
+    nv = jnp.clip(chain - base, 0, ppf) * lvv
     R = page_rows
 
     def issue(t, _):
@@ -446,7 +479,7 @@ def _paged_bq_kernel(sl_ref, tbl_ref, chain_ref, a_ref, codes_hbm,
     lax.fori_loop(0, nv, issue, 0)
     lax.fori_loop(0, nv, drain, 0)
 
-    @pl.when((slv >= 0) & ((j == 0) | (base < chain)))
+    @pl.when((slv >= 0) & ((j == 0) | ((base < chain) & (lvv > 0))))
     def _compute():
         bv, be = _paged_bq_score_topk(a_ref[0], code_s[...], scale_s[...],
                                       bias_s[...], nv * R, alpha, kf, w,
@@ -477,25 +510,28 @@ def _paged_bq_kernel(sl_ref, tbl_ref, chain_ref, a_ref, codes_hbm,
     static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
                      "kf", "interpret", "approx_ok"),
 )
-def _paged_bq_class_call(strip_list, table_flat, chain_pages, a_grouped,
-                         codes, scale_pool, bias_pool, ppf: int, n_sub: int,
-                         page_rows: int, table_width: int, alpha: float,
-                         kf: int, interpret: bool, approx_ok: bool = False):
+def _paged_bq_class_call(strip_list, table_flat, chain_pages, sub_live,
+                         a_grouped, codes, scale_pool, bias_pool, ppf: int,
+                         n_sub: int, page_rows: int, table_width: int,
+                         alpha: float, kf: int, interpret: bool,
+                         approx_ok: bool = False):
     s_pad, c, rot_dim = a_grouped.shape
     w = ppf * page_rows
 
     if n_sub > 1:
         grid = (s_pad, n_sub)
-        a_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
-        o_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i),
-                                          0, 0)
+        a_map = lambda i, j, sl, tb, ch, lv: (jnp.where(sl[i] < 0, 0, i),
+                                              0, 0)
+        o_map = lambda i, j, sl, tb, ch, lv: (jnp.where(sl[i] < 0, s_pad, i),
+                                              0, 0)
     else:
         grid = (s_pad,)
-        a_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
-        o_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i), 0, 0)
+        a_map = lambda i, sl, tb, ch, lv: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, sl, tb, ch, lv: (jnp.where(sl[i] < 0, s_pad, i),
+                                           0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, c, rot_dim), a_map),
@@ -523,8 +559,8 @@ def _paged_bq_class_call(strip_list, table_flat, chain_pages, a_grouped,
             jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
         ),
         interpret=interpret,
-    )(strip_list, table_flat, chain_pages, a_grouped, codes, scale_pool,
-      bias_pool)
+    )(strip_list, table_flat, chain_pages, sub_live, a_grouped, codes,
+      scale_pool, bias_pool)
     return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
             lax.slice_in_dim(oe, 0, s_pad, axis=0))
 
@@ -534,29 +570,34 @@ def _paged_bq_class_call(strip_list, table_flat, chain_pages, a_grouped,
     static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
                      "kf", "approx_ok"),
 )
-def _paged_bq_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
-                        codes, scale_pool, bias_pool, ppf: int, n_sub: int,
-                        page_rows: int, table_width: int, alpha: float,
-                        kf: int, approx_ok: bool = False):
+def _paged_bq_class_jnp(strip_list, table_flat, chain_pages, sub_live,
+                        a_grouped, codes, scale_pool, bias_pool, ppf: int,
+                        n_sub: int, page_rows: int, table_width: int,
+                        alpha: float, kf: int, approx_ok: bool = False):
     """jnp reference of the paged packed scan (shared
-    :func:`_paged_bq_score_topk`; the bit-parity oracle)."""
+    :func:`_paged_bq_score_topk`; the bit-parity oracle — same skip
+    predicate as the kernel for chain-exhausted or filter-dead
+    sub-blocks)."""
     w = ppf * page_rows
     table2 = table_flat.reshape(-1, table_width)
+    live2 = sub_live.reshape(-1, n_sub)
 
     def one_strip(args):
         sl, a = args
         l = jnp.maximum(sl, 0)
         chain = jnp.where(sl >= 0, chain_pages[l], 0)
         trow = table2[l]
+        lrow = live2[l]
 
         def sub(j, carry):
             ov, oe = carry
+            lw = lax.dynamic_index_in_dim(lrow, j, keepdims=False)
             pidx = jnp.maximum(
                 lax.dynamic_slice_in_dim(trow, j * ppf, ppf), 0)
             blk = codes[pidx].reshape(w, codes.shape[-1])
             srow = scale_pool[pidx].reshape(1, w)
             brow = bias_pool[pidx].reshape(1, w)
-            live = jnp.clip(chain - j * ppf, 0, ppf) * page_rows
+            live = jnp.clip(chain - j * ppf, 0, ppf) * lw * page_rows
             bv, be = _paged_bq_score_topk(a, blk, srow, brow, live, alpha,
                                           kf, w, approx_ok)
             be = be + j * w
@@ -567,7 +608,7 @@ def _paged_bq_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
             mv, me = ss._extract_topk(cv, ce, kf)
             first = j == 0
             dead = jnp.logical_and(jnp.logical_not(first),
-                                   j * ppf >= chain)
+                                   jnp.logical_or(j * ppf >= chain, lw == 0))
             out_v = jnp.where(first, bv, jnp.where(dead, ov, mv))
             out_e = jnp.where(first, be, jnp.where(dead, oe, me))
             return out_v, out_e
@@ -606,6 +647,21 @@ def paged_bq_search_traced(queries_rot, probes, codes, scale_pool,
     table_flat = table.reshape(-1)
     translator = PagedIds(page_ids, table, page_rows)
 
+    # per-(list, sub-block) filter-liveness words — the
+    # strip_scan.paged_strip_search_traced convention (all-+inf-bias pages
+    # contribute nothing; dead sub-blocks skip their DMAs and compute)
+    span = n_sub * ppf
+    page_live = jnp.any(jnp.isfinite(bias_pool), axis=1)
+    slot_live = page_live[jnp.maximum(table, 0)] & (table >= 0)
+    if span > table_width:
+        slot_live = jnp.pad(slot_live, ((0, 0), (0, span - table_width)))
+    elif span < table_width:
+        slot_live = slot_live[:, :span]
+    pos = jnp.arange(span, dtype=jnp.int32)[None, :]
+    slot_live = slot_live & (pos < chain_pages[:, None])
+    sub_live = jnp.any(slot_live.reshape(n_lists, n_sub, ppf),
+                       axis=2).astype(jnp.int32).reshape(-1)
+
     out_v, out_i = [], []
     for start in range(0, q, q_tile):
         qt = min(q_tile, q - start)
@@ -624,9 +680,10 @@ def paged_bq_search_traced(queries_rot, probes, codes, scale_pool,
         fn = (_paged_bq_class_call if impl == "pallas"
               else _paged_bq_class_jnp)
         kwargs = {"interpret": interpret} if impl == "pallas" else {}
-        ov, oe = fn(strip_list, table_flat, chain_pages, a_grouped, codes,
-                    scale_pool, bias_pool, ppf, n_sub, page_rows,
-                    table_width, alpha, kf, approx_ok=approx_ok, **kwargs)
+        ov, oe = fn(strip_list, table_flat, chain_pages, sub_live,
+                    a_grouped, codes, scale_pool, bias_pool, ppf, n_sub,
+                    page_rows, table_width, alpha, kf, approx_ok=approx_ok,
+                    **kwargs)
         v, i = ss.merge_strip_candidates(
             ov, oe, strip_list, pair_strip, pair_slot, translator, layout,
             k, kf, interpret,

@@ -337,7 +337,7 @@ def _topk_block(s, kf: int, w: int, approx_ok: bool):
     return _extract_topk_packed(jnp.concatenate(pool, axis=1), kf)
 
 
-def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref,
+def _strip_kernel(sl_ref, lv_ref, a_ref, b_ref, bias_ref, outv_ref,
                   oute_ref, *, alpha, kf, w, n_sub, approx_ok):
     """One strip (× one sub-block when n_sub > 1): matmul + fused top-kf.
 
@@ -351,11 +351,25 @@ def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref,
     ``pl.when``, so worst-case grid padding costs only the block DMA —
     their outputs stay unwritten garbage and the merge never reads them.
     (program_id/sl_ref reads happen at kernel top level — the CPU interpret
-    path rejects primitive calls inside a ``pl.when`` region.)"""
+    path rejects primitive calls inside a ``pl.when`` region.)
+
+    ``lv_ref`` (round 19, predicate push-down) is the per-(list,
+    sub-block) liveness word: 0 when the sub-block's bias lanes are ALL
+    ``+inf`` (every row filtered out / tombstoned / padding). A dead
+    sub-block's B/bias block maps are collapsed to block 0 (DMA skipped
+    after the first fetch) and the matmul+top-k is skipped: the first
+    visit writes the all-dead extraction result directly — value ``+inf``
+    at offsets ``0..kf-1`` in column order, exactly what
+    ``_topk_block``/``_extract_topk`` produce for an all-inf score block —
+    and revisits leave the carry untouched, which is bitwise what merging
+    with an all-inf block returns (ascending carry + earliest-column inf
+    ties). Filtered scans therefore stay bit-identical to the
+    compute-everything path while skipping dead work entirely."""
     slv = sl_ref[pl.program_id(0)]
     j = pl.program_id(1) if n_sub > 1 else 0
+    lvv = lv_ref[jnp.maximum(slv, 0) * n_sub + (j if n_sub > 1 else 0)]
 
-    @pl.when(slv >= 0)
+    @pl.when((slv >= 0) & (lvv > 0))
     def _compute():
         a = a_ref[0]                                   # (C, dim) bf16
         b = b_ref[0].astype(jnp.bfloat16)              # (w, dim)
@@ -384,6 +398,16 @@ def _strip_kernel(sl_ref, a_ref, b_ref, bias_ref, outv_ref,
             outv_ref[0] = mv
             oute_ref[0] = me
 
+    # dead sub-block, first visit: write the all-inf extraction constant
+    # (revisits skip — the carry IS the merge result, see docstring)
+    c = outv_ref.shape[1]
+    first = (j == 0) if n_sub > 1 else True
+
+    @pl.when((slv >= 0) & (lvv == 0) & first)
+    def _dead_first():
+        outv_ref[0] = jnp.full((c, kf), jnp.inf, jnp.float32)
+        oute_ref[0] = lax.broadcasted_iota(jnp.int32, (c, kf), 1)
+
 
 @functools.partial(
     jax.jit,
@@ -396,32 +420,54 @@ def _strip_class_call(strip_list, a_grouped, list_data, bias3,
     """Run one length-class: grid (S,) or (S, n_sub) over (C, W) strips."""
     s_pad, c, dim = a_grouped.shape
     w = w_blocks * MC
+    n_lists = bias3.shape[0]
+
+    # Per-(list, sub-block) liveness words (round 19, predicate push-down):
+    # a sub-block whose bias lanes are ALL +inf (filtered out, tombstoned,
+    # or padding) contributes nothing to any top-k, so its DMAs and compute
+    # are skipped. One cheap VPU pass over the bias operand — rides the
+    # same jit as the scan, so mask changes re-dispatch, never recompile.
+    fin = jnp.isfinite(bias3[:, 0, : n_sub * w]).reshape(n_lists, n_sub, w)
+    sub_live = jnp.any(fin, axis=2).astype(jnp.int32).reshape(-1)
 
     # Padding strips (sl = -1, kernel-skipped) get ALL their block maps
     # collapsed to constants — consecutive identical block indices make
     # Pallas skip the refetch, so a padding step costs only grid
     # bookkeeping (~1-2 µs), not the 512 KB list DMA + output writeback.
     # Outputs for padding route to a dedicated trash row (s_pad) so real
-    # rows are never clobbered by stale-buffer writebacks.
+    # rows are never clobbered by stale-buffer writebacks. Dead sub-blocks
+    # (sub_live == 0) collapse their B/bias maps the same way — a fully
+    # filtered-out list costs grid bookkeeping, not its list DMA — but
+    # keep their output row: the kernel writes the all-dead extraction
+    # constant on first visit (bit-parity with computing, see
+    # _strip_kernel).
     if n_sub > 1:
         grid = (s_pad, n_sub)
         pad_ = lambda i, sl: sl[i] < 0
-        a_map = lambda i, j, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
-        b_map = lambda i, j, sl: (jnp.maximum(sl[i], 0),
-                                  jnp.where(pad_(i, sl), 0, j), 0)
-        bias_map = lambda i, j, sl: (jnp.maximum(sl[i], 0), 0,
-                                     jnp.where(pad_(i, sl), 0, j))
-        o_map = lambda i, j, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+        dead_ = lambda i, j, sl, lv: pad_(i, sl) | (
+            lv[jnp.maximum(sl[i], 0) * n_sub + j] == 0)
+        a_map = lambda i, j, sl, lv: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, j, sl, lv: (
+            jnp.where(dead_(i, j, sl, lv), 0, jnp.maximum(sl[i], 0)),
+            jnp.where(dead_(i, j, sl, lv), 0, j), 0)
+        bias_map = lambda i, j, sl, lv: (
+            jnp.where(dead_(i, j, sl, lv), 0, jnp.maximum(sl[i], 0)), 0,
+            jnp.where(dead_(i, j, sl, lv), 0, j))
+        o_map = lambda i, j, sl, lv: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
     else:
         grid = (s_pad,)
         pad_ = lambda i, sl: sl[i] < 0
-        a_map = lambda i, sl: (jnp.where(pad_(i, sl), 0, i), 0, 0)
-        b_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
-        bias_map = lambda i, sl: (jnp.maximum(sl[i], 0), 0, 0)
-        o_map = lambda i, sl: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
+        dead_ = lambda i, sl, lv: pad_(i, sl) | (
+            lv[jnp.maximum(sl[i], 0)] == 0)
+        a_map = lambda i, sl, lv: (jnp.where(pad_(i, sl), 0, i), 0, 0)
+        b_map = lambda i, sl, lv: (
+            jnp.where(dead_(i, sl, lv), 0, jnp.maximum(sl[i], 0)), 0, 0)
+        bias_map = lambda i, sl, lv: (
+            jnp.where(dead_(i, sl, lv), 0, jnp.maximum(sl[i], 0)), 0, 0)
+        o_map = lambda i, sl, lv: (jnp.where(pad_(i, sl), s_pad, i), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, c, dim), a_map),
@@ -439,7 +485,7 @@ def _strip_class_call(strip_list, a_grouped, list_data, bias3,
             jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
         ),
         interpret=interpret,
-    )(strip_list, a_grouped, list_data, bias3)
+    )(strip_list, sub_live, a_grouped, list_data, bias3)
     return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
             lax.slice_in_dim(oe, 0, s_pad, axis=0))
 
@@ -906,23 +952,30 @@ def _paged_score_topk(a, block, bias_row, live_rows, alpha: float, kf: int,
     return _topk_block(s, kf, w, approx_ok)
 
 
-def _paged_strip_kernel(sl_ref, tbl_ref, chain_ref, a_ref, pages_hbm,
+def _paged_strip_kernel(sl_ref, tbl_ref, chain_ref, lv_ref, a_ref, pages_hbm,
                         bias_hbm, outv_ref, oute_ref, pay_s, bias_s,
                         psem, bsem, *, alpha, kf, w, n_sub, ppf,
                         page_rows, table_width, approx_ok):
     """One (strip × page sub-block): DMA the live pages HBM→VMEM, then the
     shared matmul + fused top-kf. Scalar prefetch carries the strip table
-    (``sl``), the flattened page table and the per-list chain lengths;
-    only live pages are copied (a dynamic-trip fori_loop — the Ragged
-    Paged Attention fetch shape), dead sub-blocks and padding strips skip
-    the body entirely."""
+    (``sl``), the flattened page table, the per-list chain lengths and the
+    per-(list, sub-block) filter-liveness words (``lv_ref``: 0 when every
+    row the sub-block's pages hold is +inf-biased — filtered out or
+    tombstoned); only live pages of live sub-blocks are copied (a
+    dynamic-trip fori_loop — the Ragged Paged Attention fetch shape), dead
+    sub-blocks and padding strips skip the body entirely. A filter-dead
+    first sub-block still writes: ``live_rows = 0`` masks every lane, so
+    the write is the all-inf extraction — bitwise what the jnp reference
+    computes from the all-+inf bias lanes."""
     i = pl.program_id(0)
     slv = sl_ref[i]
     j = pl.program_id(1) if n_sub > 1 else 0
     l = jnp.maximum(slv, 0)
     chain = jnp.where(slv >= 0, chain_ref[l], 0)   # live pages in the list
+    lvv = lv_ref[l * n_sub + (j if n_sub > 1 else 0)]
     base = j * ppf
-    nv = jnp.clip(chain - base, 0, ppf)            # live pages this block
+    # live pages this block; a filter-dead block fetches and ranks nothing
+    nv = jnp.clip(chain - base, 0, ppf) * lvv
     R = page_rows
 
     # issue every copy before draining any: latencies overlap; the two
@@ -948,8 +1001,9 @@ def _paged_strip_kernel(sl_ref, tbl_ref, chain_ref, a_ref, pages_hbm,
 
     # j == 0 always writes (a strip's outputs must be defined even for an
     # empty list — all-+inf, which the merge translates to id -1); later
-    # sub-blocks past the chain end keep the running top-kf untouched
-    @pl.when((slv >= 0) & ((j == 0) | (base < chain)))
+    # sub-blocks past the chain end — or filter-dead (lvv == 0) — keep the
+    # running top-kf untouched
+    @pl.when((slv >= 0) & ((j == 0) | ((base < chain) & (lvv > 0))))
     def _compute():
         bv, be = _paged_score_topk(a_ref[0], pay_s[...], bias_s[...],
                                    nv * R, alpha, kf, w, approx_ok)
@@ -979,28 +1033,33 @@ def _paged_strip_kernel(sl_ref, tbl_ref, chain_ref, a_ref, pages_hbm,
     static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
                      "kf", "interpret", "approx_ok"),
 )
-def _paged_class_call(strip_list, table_flat, chain_pages, a_grouped,
-                      pages, bias_pool, ppf: int, n_sub: int,
+def _paged_class_call(strip_list, table_flat, chain_pages, sub_live,
+                      a_grouped, pages, bias_pool, ppf: int, n_sub: int,
                       page_rows: int, table_width: int, alpha: float,
                       kf: int, interpret: bool, approx_ok: bool = False):
     """Run the (single) paged length class through the Pallas kernel:
     grid (S,) or (S, n_sub); pages/bias stay HBM-resident (memory_space
-    ANY) and are fetched per grid step by the kernel's own DMAs."""
+    ANY) and are fetched per grid step by the kernel's own DMAs.
+    ``sub_live`` (n_lists·n_sub,) int32 carries the per-sub-block
+    filter-liveness words (0 ⇒ the kernel issues no page DMAs and skips
+    ranking for that block)."""
     s_pad, c, dim = a_grouped.shape
     w = ppf * page_rows
 
     if n_sub > 1:
         grid = (s_pad, n_sub)
-        a_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
-        o_map = lambda i, j, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i),
-                                          0, 0)
+        a_map = lambda i, j, sl, tb, ch, lv: (jnp.where(sl[i] < 0, 0, i),
+                                              0, 0)
+        o_map = lambda i, j, sl, tb, ch, lv: (jnp.where(sl[i] < 0, s_pad, i),
+                                              0, 0)
     else:
         grid = (s_pad,)
-        a_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, 0, i), 0, 0)
-        o_map = lambda i, sl, tb, ch: (jnp.where(sl[i] < 0, s_pad, i), 0, 0)
+        a_map = lambda i, sl, tb, ch, lv: (jnp.where(sl[i] < 0, 0, i), 0, 0)
+        o_map = lambda i, sl, tb, ch, lv: (jnp.where(sl[i] < 0, s_pad, i),
+                                           0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, c, dim), a_map),
@@ -1025,7 +1084,8 @@ def _paged_class_call(strip_list, table_flat, chain_pages, a_grouped,
             jax.ShapeDtypeStruct((s_pad + 1, c, kf), jnp.int32),
         ),
         interpret=interpret,
-    )(strip_list, table_flat, chain_pages, a_grouped, pages, bias_pool)
+    )(strip_list, table_flat, chain_pages, sub_live, a_grouped, pages,
+      bias_pool)
     return (lax.slice_in_dim(ov, 0, s_pad, axis=0),
             lax.slice_in_dim(oe, 0, s_pad, axis=0))
 
@@ -1035,33 +1095,37 @@ def _paged_class_call(strip_list, table_flat, chain_pages, a_grouped,
     static_argnames=("ppf", "n_sub", "page_rows", "table_width", "alpha",
                      "kf", "approx_ok"),
 )
-def _paged_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
-                     pages, bias_pool, ppf: int, n_sub: int,
+def _paged_class_jnp(strip_list, table_flat, chain_pages, sub_live,
+                     a_grouped, pages, bias_pool, ppf: int, n_sub: int,
                      page_rows: int, table_width: int, alpha: float,
                      kf: int, approx_ok: bool = False):
     """Pure-jnp reference for the paged class: the SAME per-(strip,
     sub-block) op sequence as the kernel — shared :func:`_paged_score_topk`,
     same ``_extract_topk`` sub-block merge, same skip predicate for dead
-    sub-blocks — driven by a sequential ``lax.map`` over strips. This IS
-    the jnp gather path of the paged engine: pages are fetched with jnp
-    advanced indexing and scored identically, so tier-1 pins bitwise
-    (ids + values) parity against the kernel."""
+    sub-blocks (chain-exhausted OR filter-dead ``sub_live`` word) — driven
+    by a sequential ``lax.map`` over strips. This IS the jnp gather path
+    of the paged engine: pages are fetched with jnp advanced indexing and
+    scored identically, so tier-1 pins bitwise (ids + values) parity
+    against the kernel."""
     w = ppf * page_rows
     table2 = table_flat.reshape(-1, table_width)
+    live2 = sub_live.reshape(-1, n_sub)
 
     def one_strip(args):
         sl, a = args
         l = jnp.maximum(sl, 0)
         chain = jnp.where(sl >= 0, chain_pages[l], 0)
         trow = table2[l]
+        lrow = live2[l]
 
         def sub(j, carry):
             ov, oe = carry
+            lw = lax.dynamic_index_in_dim(lrow, j, keepdims=False)
             pidx = jnp.maximum(
                 lax.dynamic_slice_in_dim(trow, j * ppf, ppf), 0)
             blk = pages[pidx].reshape(w, pages.shape[-1])
             brow = bias_pool[pidx].reshape(1, w)
-            live = jnp.clip(chain - j * ppf, 0, ppf) * page_rows
+            live = jnp.clip(chain - j * ppf, 0, ppf) * lw * page_rows
             bv, be = _paged_score_topk(a, blk, brow, live, alpha, kf, w,
                                        approx_ok)
             be = be + j * w
@@ -1074,7 +1138,7 @@ def _paged_class_jnp(strip_list, table_flat, chain_pages, a_grouped,
             # dead sub-blocks keep the running top-kf (kernel skip path)
             first = j == 0
             dead = jnp.logical_and(jnp.logical_not(first),
-                                   j * ppf >= chain)
+                                   jnp.logical_or(j * ppf >= chain, lw == 0))
             out_v = jnp.where(first, bv, jnp.where(dead, ov, mv))
             out_e = jnp.where(first, be, jnp.where(dead, oe, me))
             return out_v, out_e
@@ -1144,6 +1208,25 @@ def paged_strip_search_traced(queries_mat, probes, pages, bias_pool,
     table_flat = table.reshape(-1)
     translator = PagedIds(page_ids, table, page_rows)
 
+    # Per-(list, sub-block) filter-liveness words (round 19, predicate
+    # push-down): a page whose bias rows are ALL +inf (every row filtered
+    # out or tombstoned) holds nothing rankable; a sub-block whose live
+    # chain slots all point at such pages skips its page DMAs and compute
+    # in the kernel. Derived from the SAME capacity-shaped operands as the
+    # scan (one cheap VPU pass), so it rides the fused jit: mask changes
+    # re-dispatch, never recompile.
+    span = n_sub * ppf
+    page_live = jnp.any(jnp.isfinite(bias_pool), axis=1)   # (cap_pages,)
+    slot_live = page_live[jnp.maximum(table, 0)] & (table >= 0)
+    if span > table_width:
+        slot_live = jnp.pad(slot_live, ((0, 0), (0, span - table_width)))
+    elif span < table_width:
+        slot_live = slot_live[:, :span]
+    pos = jnp.arange(span, dtype=jnp.int32)[None, :]
+    slot_live = slot_live & (pos < chain_pages[:, None])
+    sub_live = jnp.any(slot_live.reshape(n_lists, n_sub, ppf),
+                       axis=2).astype(jnp.int32).reshape(-1)
+
     out_v, out_i = [], []
     for start in range(0, q, q_tile):
         qt = min(q_tile, q - start)
@@ -1161,9 +1244,9 @@ def paged_strip_search_traced(queries_mat, probes, pages, bias_pool,
         ).astype(jnp.bfloat16)
         fn = _paged_class_call if impl == "pallas" else _paged_class_jnp
         kwargs = {"interpret": interpret} if impl == "pallas" else {}
-        ov, oe = fn(strip_list, table_flat, chain_pages, a_grouped, pages,
-                    bias_pool, ppf, n_sub, page_rows, table_width, alpha,
-                    kf, approx_ok=approx_ok, **kwargs)
+        ov, oe = fn(strip_list, table_flat, chain_pages, sub_live,
+                    a_grouped, pages, bias_pool, ppf, n_sub, page_rows,
+                    table_width, alpha, kf, approx_ok=approx_ok, **kwargs)
         v, i = merge_strip_candidates(
             ov, oe, strip_list, pair_strip, pair_slot, translator, layout,
             k, kf, interpret,

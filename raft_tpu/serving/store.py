@@ -242,6 +242,10 @@ class PagedListStore:
         self._dev_lens = None   # guarded-by: _lock -- device chain-length mirror (paged Pallas)
         self._version = 0       # guarded-by: _lock -- bumped on every committed mutation
         self._growths = 0       # guarded-by: _lock
+        # standing predicate applied by every search_paged that doesn't
+        # pass its own filter; survives compaction/re-clustering swaps
+        # (not in _SWAP_FIELDS — clones are built filterless)
+        self.filter = None      # guarded-by: _lock, reads-ok
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -424,6 +428,32 @@ class PagedListStore:
                 "growth_events": self._growths,
                 "mutation_version": self._version,
             }
+
+    def set_filter(self, mask) -> None:
+        """Install (or clear, with ``None``) the store's standing predicate.
+
+        ``mask`` is a :class:`~raft_tpu.core.bitset.Bitset` over source-row
+        ids, or any boolean/0-1 array convertible to one. Every
+        ``search_paged`` call that doesn't pass its own ``filter`` picks
+        this one up (a per-call filter takes precedence); ids at or beyond
+        the mask length are excluded, so rows upserted after the mask was
+        built don't leak through unfiltered.
+
+        Zero-recompile contract: the filter rides the fused search jits as
+        a pytree operand whose static aux is only ``n_bits``. Installing
+        the FIRST filter (None→Bitset) retraces once, as does changing the
+        mask length; mutating mask *contents* at a fixed length re-dispatches
+        the same compiled program (tier-1 asserts this via
+        ``serving.scan_trace_count()``)."""
+        from raft_tpu.core.bitset import Bitset
+
+        if mask is not None and not isinstance(mask, Bitset):
+            mask = Bitset.from_mask(jnp.asarray(mask))
+        with self._lock:
+            self.filter = mask
+            self._version += 1
+        if obs.enabled():
+            obs.add("serving.store.set_filter")
 
     def device_table(self):
         """Device mirror of the page table (rebuilt only after a table

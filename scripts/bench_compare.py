@@ -235,6 +235,16 @@ def direction(metric: str) -> str:
         return "down"
     if tail in ("flight_windows", "frontier_points"):
         return "up"
+    # filtered & hybrid search (round 20): filtered recall, the fused
+    # hybrid recall and the filtered-to-unfiltered throughput ratio grow
+    # toward good — push-down means a filter costs VMEM masking plus plan
+    # widening, never a second scan, so the ratio regressing is the
+    # kernel operand path degrading (zero tolerance below); recompiles
+    # during filtered search are caught by the recompile rule above
+    # (down, zero tolerance)
+    if tail in ("filtered_recall", "hybrid_recall",
+                "filtered_to_unfiltered_qps_ratio"):
+        return "up"
     # cost-model accuracy (round 11): the predicted/measured HBM ratio is
     # best AT 1.0 — drift in either direction is the predictor degrading,
     # so the verdict compares |ratio − 1| across rounds ("one" direction);
@@ -304,6 +314,21 @@ _DEFAULT_METRIC_THRESHOLDS = {
     # unclassified residue likewise
     "capacity.oom_verdicts": 0.0,
     "capacity.unclassified": 0.0,
+    # filtered search (round 20): the filtered-to-unfiltered throughput
+    # ratio and the recompile count are contracts of the push-down path,
+    # not throughput — ANY slip is a regression row; filtered recall gets
+    # the same 1% band the family recalls use
+    "filtered.ivf_flat.sel10.filtered_to_unfiltered_qps_ratio": 0.0,
+    "filtered.ivf_flat.sel01.filtered_to_unfiltered_qps_ratio": 0.0,
+    "filtered.ivf_bq.sel10.filtered_to_unfiltered_qps_ratio": 0.0,
+    "filtered.ivf_bq.sel01.filtered_to_unfiltered_qps_ratio": 0.0,
+    "filtered.ivf_flat.recompiles_during_filtered_search": 0.0,
+    "filtered.ivf_bq.recompiles_during_filtered_search": 0.0,
+    "filtered.ivf_flat.sel10.filtered_recall": 0.01,
+    "filtered.ivf_flat.sel01.filtered_recall": 0.01,
+    "filtered.ivf_bq.sel10.filtered_recall": 0.01,
+    "filtered.ivf_bq.sel01.filtered_recall": 0.01,
+    "filtered.hybrid.hybrid_recall": 0.01,
 }
 
 

@@ -203,6 +203,15 @@ echo "== maintenance smoke (always-live index drift + re-clustering, ISSUE 18) =
 JAX_PLATFORMS=cpu python scripts/maintenance_smoke.py || fail=1
 
 echo
+echo "== filter smoke (predicate push-down + widening + hybrid, round 20) =="
+# Filtered recall >= 0.9 at a selective filter through the widened plan,
+# ZERO scan recompiles across filter-mask content mutations (pytree
+# operand contract), the armed ivf_flat.search.filter faultpoint
+# surfacing classified + recovering, and the fused hybrid rung ranking
+# sanely — zero unclassified residue across the window.
+JAX_PLATFORMS=cpu python scripts/filter_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1
