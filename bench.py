@@ -1008,9 +1008,31 @@ def run_suite():
                         srv_idx = ivf_flat.build(dataset, ivf_flat.IvfFlatParams(
                             n_lists=NLIST, kmeans_trainset_fraction=0.2))
                         _force(srv_idx.list_norms)
+                # consume the tuner's emitted operating point when one is
+                # present AND was tuned for THIS configuration (the
+                # context knobs in its fingerprint must match) — else fall
+                # back to the sweep defaults. The provenance is stamped
+                # either way; the hand-written sweep_r*_config.json flow
+                # is retired (scripts/archive/README.md).
+                from raft_tpu.tuning import autotune as _autotune
+                srv_nprobe = (flat or {}).get("nprobe", NPROBE0)
+                srv_tuned = None
+                op = _autotune.load_operating_point()
+                op_knobs = (op or {}).get("knobs") or {}
+                if op is not None and op.get("meets_slo") \
+                        and op_knobs.get("algo") == "ivf_flat" \
+                        and op_knobs.get("n_lists") == NLIST \
+                        and op_knobs.get("k") == K \
+                        and isinstance(op_knobs.get("n_probes"), int):
+                    srv_nprobe = int(op_knobs["n_probes"])
+                    srv_tuned = {"tuned_by": op.get("tuned_by"),
+                                 "tuned_fp": op.get("fp")}
                 out = _serving_streaming(
-                    srv_idx, queries, K, nprobe=(flat or {}).get(
-                        "nprobe", NPROBE0), tiny=tiny, rng_seed=7)
+                    srv_idx, queries, K, nprobe=srv_nprobe, tiny=tiny,
+                    rng_seed=7)
+                # tuned_by: None = no compatible operating point on disk,
+                # serving ran the defaults — explicit, not silent
+                out.update(srv_tuned or {"tuned_by": None})
                 # the cache learns the post-traffic compact() snapshot:
                 # upserted rows survive into the next run's store
                 if srv_cache != "hit":
@@ -1060,6 +1082,25 @@ def run_suite():
         else:
             extras["maintenance"] = {"error": "skipped: time budget"}
         hb.section("maintenance", extras["maintenance"])
+
+    # --- Tuning: the closed autotuning loop (ISSUE 20 / ROADMAP item 2) ---
+    # Offline: the diagnosis-driven tuner converges onto a calibrated
+    # synthetic SLO with no hand-written sweep config and emits
+    # results/operating_point.json (which the serving section above reads
+    # back on the NEXT run — the same learn-across-runs shape as the index
+    # cache). Online: an induced load spike at the tuned point that the
+    # burn-rate controller must absorb — zero recompiles, zero
+    # unclassified verdicts, burn states back in budget after recovery.
+    if section_on("tuning"):
+        if on_cpu or elapsed() < 1200:
+            hb.set_section("tuning")
+            try:
+                extras["tuning"] = _autotune_rung(tiny=tiny)
+            except Exception as e:
+                extras["tuning"] = section_error(e)
+        else:
+            extras["tuning"] = {"error": "skipped: time budget"}
+        hb.section("tuning", extras["tuning"])
 
     # --- CAGRA at the FULL bench scale and the FULL query batch (VERDICT
     # r4 weak #3: q=2000 vs the IVF rows' q=10000 needed a footnote).
@@ -2238,6 +2279,434 @@ def _maintenance_rung(tiny: bool, rng_seed: int = 13) -> dict:
         "recompiles_during_serving": int(tc1 - tc0),
         "unclassified": int(unclassified + rep["failures"]),
     }
+    return out
+
+
+def _autotune_rung(tiny: bool, rng_seed: int = 17) -> dict:
+    """Closed-loop autotuning rung (ISSUE 20 acceptance): the offline
+    diagnosis-driven tuner converges to an operating point meeting a
+    synthetic SLO with NO hand-written sweep config, then the online
+    burn-rate controller absorbs an induced load spike at that point.
+
+    Phase A — offline: an :class:`raft_tpu.tuning.autotune.Autotuner`
+    serves propose → window → explain iterations over a live
+    QueryQueue/store (every window a flight fingerprint, every proposal
+    justified by a ranked diagnosis from ``obs.explain``), accumulates
+    the Pareto frontier and emits ``results/operating_point.json``. The
+    recall floor is CALIBRATED, not hand-written: the widest recall gap
+    on the measured probe ladder places the target between two rungs, so
+    the loop must actually move to meet it.
+
+    Phase B — online: serving restarts AT the emitted point (read back
+    from disk — the same consumption path the serving section uses), a
+    saturating load spike drives the p99 SLO into burn, and the
+    :class:`raft_tpu.serving.BurnRateController` nudges knobs down
+    (recall-guardrailed), then reverts toward the tuned point over cool
+    windows. Every action lands as a ``tuning.action`` event on the
+    flight timeline, and the episode must close with zero scan
+    recompiles, zero unexplained retraces, zero unclassified request
+    verdicts, and the final burn states back inside the error budget
+    (``spike_budget_burn == 0``).
+    """
+    import numpy as np
+
+    from raft_tpu import obs, serving
+    from raft_tpu.bench import progress as prog
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import explain as obs_explain
+    from raft_tpu.obs import flight as obs_flight
+    from raft_tpu.obs import report as obs_report
+    from raft_tpu.obs import shadow as obs_shadow
+    from raft_tpu.obs import slo as obs_slo
+    from raft_tpu.tuning import autotune
+
+    rng = np.random.default_rng(rng_seed)
+    if tiny:
+        n0, dim, n_lists, n_req = 1500, 16, 16, 40
+        probe_ladder, cap_ladder = [2, 4, 8], [4, 8, 16]
+    else:
+        n0, dim, n_lists, n_req = 6000, 32, 32, 96
+        probe_ladder, cap_ladder = [4, 8, 16], [8, 16, 32]
+    k = 10
+    cap_max = cap_ladder[-1]
+
+    data = rng.standard_normal((n0, dim)).astype(np.float32)
+    q_pool = rng.standard_normal((max(64, 2 * n_req), dim)) \
+        .astype(np.float32)
+    idx = ivf_flat.build(data, ivf_flat.IvfFlatParams(
+        n_lists=n_lists, kmeans_trainset_fraction=0.5))
+    store = serving.PagedListStore.from_index(idx)
+
+    # warm EVERY (probe rung ∪ exact-scan) × pow2-bucket program off every
+    # measured clock: the whole closed loop below — tuner windows, the
+    # controller's live n_probes / batch-cap moves, the shadow sampler's
+    # exact scans — must re-dispatch compiled programs only
+    for np_ in list(probe_ladder) + [n_lists]:
+        b = 1
+        while True:
+            v, _ = serving.search(store, np.repeat(q_pool[:1], b, axis=0),
+                                  k, n_probes=np_)
+            _force(v)
+            if b >= cap_max:
+                break
+            b = min(2 * b, cap_max)
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        v, _ = serving.search(store, q_pool[:1], k,
+                              n_probes=probe_ladder[-1])
+        _force(v)
+    lat1 = max(1e-6, (time.perf_counter() - t0) / 3)
+    t0 = time.perf_counter()
+    v, _ = serving.search(store, np.repeat(q_pool[:1], cap_max, axis=0),
+                          k, n_probes=probe_ladder[-1])
+    _force(v)
+    lat_full = max(1e-6, time.perf_counter() - t0)
+    slo_s = max(4.0 * lat_full, 2.0 * lat1)
+
+    # calibrate the synthetic recall SLO off the MEASURED ladder: the
+    # floor sits in the widest recall gap between adjacent rungs, so it
+    # is meetable (some rung clears it with margin) and binding (the
+    # start rung misses it with margin) at any corpus/seed
+    q_cal = q_pool[:cap_max]
+    _, exact_cal = serving.search(store, q_cal, k, n_probes=n_lists)
+    exact_cal = np.asarray(exact_cal)
+
+    def _recall_at(nprobe: int) -> float:
+        _, got = serving.search(store, q_cal, k, n_probes=nprobe)
+        got = np.asarray(got)
+        hits = sum(
+            len(set(got[i].tolist()) & set(exact_cal[i].tolist()))
+            for i in range(q_cal.shape[0]))
+        return hits / (q_cal.shape[0] * k)
+
+    ladder_recall = [_recall_at(p) for p in probe_ladder]
+    gaps = [ladder_recall[i + 1] - ladder_recall[i]
+            for i in range(len(ladder_recall) - 1)]
+    if gaps and max(gaps) > 0.08:
+        gi = gaps.index(max(gaps))
+        floor = (ladder_recall[gi] + ladder_recall[gi + 1]) / 2.0
+    else:  # degenerate ladder (all rungs alike): aim just under the top
+        floor = ladder_recall[-1] - 0.03
+    floor = round(min(0.95, max(0.2, floor)), 3)
+    # the deployment's HARD recall SLO sits a band below the preferred
+    # point: the controller may spend recall down to it under pressure,
+    # never through it (the Wilson-CI guardrail enforces exactly this)
+    floor_hard = round(max(0.05, floor - 0.1), 3)
+    slo = {"p99_s": 5.0 * slo_s, "recall_floor": floor}
+    out = {"n": n0, "dim": dim, "n_lists": n_lists, "k": k,
+           "probe_ladder": probe_ladder, "cap_ladder": cap_ladder,
+           "ladder_recall": [round(r, 4) for r in ladder_recall],
+           "recall_floor": floor, "recall_floor_hard": floor_hard,
+           "slo_p99_ms": round(slo["p99_s"] * 1e3, 3)}
+
+    base_rate = 0.5 / lat1
+
+    def _window_traffic(queue, rate, n, timeout_mult=50.0, flight=None,
+                        ctrl=None, ctrl_every=0):
+        """One Poisson traffic slice: submit at ``rate`` req/s, pump the
+        queue in the gaps (the bench loop IS the serving worker)."""
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+        handles = []
+        i = 0
+        t_start = time.perf_counter()
+        while i < n:
+            if flight is not None:
+                flight.maybe_sample()
+            now = time.perf_counter() - t_start
+            if now >= arrivals[i]:
+                handles.append(queue.submit(
+                    q_pool[i % len(q_pool)],
+                    timeout_s=timeout_mult * slo_s))
+                i += 1
+                if ctrl is not None and ctrl_every and i % ctrl_every == 0:
+                    ctrl.pump()
+                continue
+            if not queue.pump():
+                time.sleep(min(arrivals[i] - now, 2e-4))
+        queue.drain(timeout=120.0)
+        return handles, time.perf_counter() - t_start
+
+    # --- Phase A: offline tuner ------------------------------------------
+    windows_path = os.path.join("results", "autotune_windows.jsonl")
+    prog.truncate(windows_path)
+
+    def serve_window(values):
+        """Serve ONE window under the proposed knob vector with a FRESH
+        sampler + SLO engine (windowed Wilson CI — a cumulative estimate
+        would lag the knob moves it is supposed to judge)."""
+        nprobe = int(values["n_probes"])
+        cap = int(values["batch_cap"])
+        sampler = obs_shadow.ShadowSampler(
+            lambda qq: serving.search(store, qq, k, n_probes=n_lists),
+            k=k, rate=1.0, seed=rng_seed, max_pending=n_req + 8)
+        engine = obs_slo.SloEngine(
+            obs_slo.default_serving_slos(slo_s, sampler=sampler,
+                                         recall_floor=floor),
+            fast_window_s=30.0, slow_window_s=120.0)
+        queue = serving.QueryQueue(
+            serving.searcher(store, k, n_probes=nprobe),
+            slo_s=slo_s, max_batch=cap, fill_wait_s=lat_full,
+            shadow=sampler)
+        handles, wall = _window_traffic(queue, base_rate, n_req)
+        sampler.drain(timeout_s=60.0)
+        ok = [h.latency_s for h in handles if h.verdict == "ok"]
+        return {
+            "ops": {"qps": round(len(ok) / wall, 1) if wall > 0 else 0.0,
+                    "p99_ub_s": (float(np.percentile(ok, 99))
+                                 if ok else None),
+                    "requests_ok": len(ok)},
+            "report": obs_report.collect(engine=engine, sampler=sampler,
+                                         queue=queue),
+        }
+
+    # single-value context knobs (algo / n_lists / k) ride along so the
+    # emitted operating point names the configuration it was tuned FOR —
+    # the serving section's compatibility gate keys off them
+    tuner = autotune.Autotuner(
+        serve_window,
+        [autotune.Knob("n_probes", probe_ladder),
+         autotune.Knob("batch_cap", cap_ladder, start=cap_ladder[1]),
+         autotune.Knob("algo", ["ivf_flat"]),
+         autotune.Knob("n_lists", [n_lists]),
+         autotune.Knob("k", [k])],
+        slo=slo, settle=3, max_windows=10, deadline_s=60.0,
+        path=windows_path)
+    tuner_stats = tuner.run()
+    op_emitted = tuner.emit_operating_point()
+    frontier = tuner.frontier()
+    prog.write_artifact(os.path.join("results", "autotune_frontier.json"),
+                        frontier)
+
+    windows = tuner.windows()
+    primaries = {}
+    explain_invalid = 0
+    proposals_undiagnosed = 0
+    unexplained = 0
+    for rec in windows:
+        diag = rec.get("explain") or {}
+        key = diag.get("primary") or "healthy"
+        primaries[key] = primaries.get(key, 0) + 1
+        explain_invalid += len(obs_explain.validate(diag))
+        prop = rec.get("proposal")
+        if not isinstance(prop, dict) or "diagnosis" not in prop:
+            proposals_undiagnosed += 1
+        # zero-tolerance gate counts CONSEQUENTIAL unknowns only: a
+        # window that FAILED its tuner bound with no diagnosis. At tiny
+        # CPU scale a burn-rate row can blip warn/breach on scheduler
+        # jitter in a window whose measurement still meets the bound by
+        # miles — explain honestly says unknown (it is in
+        # diagnosis_counts), but that blip is not an unexplained
+        # slowdown the gate should fail on
+        elif key == "unknown" and not prop.get("meets_slo", True):
+            unexplained += 1
+    out["tuner"] = tuner_stats
+    out["diagnosis_counts"] = primaries
+    out["unexplained_diagnoses"] = unexplained
+    out["explain_invalid"] = explain_invalid
+    out["proposals_undiagnosed"] = proposals_undiagnosed
+    out["frontier_points"] = frontier.get("pareto_points", 0)
+    out["frontier_file"] = os.path.join("results", "autotune_frontier.json")
+    out["windows_file"] = windows_path
+    if op_emitted is None:
+        out["operating_point_error"] = "no frontier point emitted"
+        return out
+    out["operating_point_file"] = autotune.default_operating_point_path()
+    out["meets_slo"] = bool(op_emitted.get("meets_slo"))
+    out["tuned_qps"] = op_emitted.get("qps")
+    out["tuned_recall"] = op_emitted.get("recall")
+    p99 = op_emitted.get("p99_ub_s")
+    out["tuned_p99_ms"] = round(p99 * 1e3, 3) if p99 else None
+
+    # --- Phase B: online control at the tuned point ----------------------
+    # the operating point is read BACK FROM DISK — the same
+    # load_operating_point consumption path bench sections use; the
+    # hand-written sweep config is dead code from here on
+    op = autotune.load_operating_point()
+    op_knobs = (op or {}).get("knobs") or {}
+    nprobe_tuned = op_knobs.get("n_probes")
+    cap_tuned = op_knobs.get("batch_cap")
+    if nprobe_tuned not in probe_ladder:
+        nprobe_tuned = probe_ladder[-1]
+    if cap_tuned not in cap_ladder:
+        cap_tuned = cap_ladder[1]
+    out["tuned_by"] = (op or {}).get("tuned_by")
+    out["tuned_fp"] = (op or {}).get("fp")
+    out["tuned_knobs"] = {"n_probes": nprobe_tuned, "batch_cap": cap_tuned}
+
+    live = {"n_probes": int(nprobe_tuned)}
+
+    def live_search(qq):
+        return serving.search(store, qq, k, n_probes=live["n_probes"])
+
+    sampler2 = obs_shadow.ShadowSampler(
+        lambda qq: serving.search(store, qq, k, n_probes=n_lists),
+        k=k, rate=1.0, seed=rng_seed + 1, max_pending=8 * n_req)
+    # burn windows scaled to the rung's wall clock (the production 60 s /
+    # 600 s pair would never see this spike end): fast ≈ one calm slice,
+    # slow ≈ the spike; threshold 5 on the 1% latency budget means ≥5%
+    # of a fast window slow ⇒ hot. The latency target is 2× the serving
+    # bound: the engine's pow2-bucket bad-counting is ≤2× conservative
+    # (every request in the bucket CONTAINING the target counts bad), so
+    # a target inside the healthy tail's own bucket burns budget on
+    # ordinary calm traffic — the controller gate needs the whole
+    # healthy bucket under the target, while spike queue waits (≈6×
+    # slo_s by construction) still land far above it
+    engine2 = obs_slo.SloEngine(
+        obs_slo.default_serving_slos(2.0 * slo_s, sampler=sampler2,
+                                     recall_floor=floor_hard),
+        fast_window_s=0.6, slow_window_s=2.5, threshold=5.0)
+    queue2 = serving.QueryQueue(
+        live_search, slo_s=slo_s, max_batch=cap_max,
+        fill_wait_s=lat_full, shadow=sampler2)
+    queue2.set_batch_cap(int(cap_tuned))
+    actuators = [
+        serving.KnobActuator(
+            "n_probes", probe_ladder,
+            lambda: live["n_probes"],
+            lambda vv: live.__setitem__("n_probes", int(vv)),
+            costs_recall=True),
+        serving.KnobActuator(
+            "batch_cap", cap_ladder,
+            lambda: queue2.batch_cap, queue2.set_batch_cap),
+    ]
+    ctrl = serving.BurnRateController(
+        engine2, actuators, sampler=sampler2, recall_floor=floor_hard,
+        max_actions=1, cool_windows=2, deadline_s=60.0)
+
+    flight_path = os.path.join("results", "flight_autotune.jsonl")
+    prog.truncate(flight_path)
+
+    def _spike_knobs():
+        knobs = {"algo": store.kind, "n_lists": n_lists, "k": k,
+                 "n_probes": live["n_probes"]}
+        knobs.update(queue2.knobs())
+        return knobs
+
+    flight2 = obs_flight.FlightRecorder(
+        flight_path, knobs=_spike_knobs, engine=engine2, sampler=sampler2,
+        queue=queue2, interval_s=0.1)
+    flight2.sample()  # window 0, off every measured clock
+
+    traces0 = serving.scan_trace_count()
+    unexplained0 = obs_compile.unexplained_retraces()
+
+    # calm phase at the tuned point: the controller must HOLD (any action
+    # here is a livelock bug, not control)
+    calm_handles, calm_wall = _window_traffic(
+        queue2, base_rate, n_req, flight=flight2, ctrl=ctrl, ctrl_every=8)
+    sampler2.drain(timeout_s=60.0)
+    calm_ok = [h.latency_s for h in calm_handles if h.verdict == "ok"]
+    out["calm_qps"] = round(len(calm_ok) / calm_wall, 1) \
+        if calm_wall > 0 else 0.0
+    out["calm_actions"] = (ctrl.report() or {}).get("actions", 0)
+
+    # induced load spike: each burst is DUMPED at once (arrival rate far
+    # above any service rate), so the backlog's tail queue wait is
+    # burst/service_rate by construction — sized to ≈6× slo_s off the
+    # MEASURED per-dispatch cost at the tuned batch (lat_full, the
+    # one-shot batch-cap_max timing, overestimates the steady-state
+    # dispatch by whatever first-call slack it caught, and a Poisson
+    # spike sized off it can fail to outrun the real service rate). The
+    # controller is pumped BETWEEN bursts: latencies only exist once a
+    # burst's backlog drains
+    t0_disp = time.perf_counter()
+    _force(live_search(q_pool[:int(cap_tuned)])[0])
+    t_disp = max(time.perf_counter() - t0_disp, 1e-5)
+    burst = int(cap_tuned) * min(96, max(3, int(6.0 * slo_s / t_disp) + 1))
+    spike_rate = 1e9
+    spike_handles = []
+    for _ in range(4):
+        hs, _ = _window_traffic(queue2, spike_rate, burst,
+                                timeout_mult=400.0, flight=flight2)
+        spike_handles.extend(hs)
+        ctrl.pump()
+        flight2.maybe_sample()
+
+    # recovery: calm slices until the controller walks every knob back to
+    # its tuned rung (cool-streak hysteresis pays one revert per
+    # cool_windows quiet ticks — bounded, asserted below)
+    restored = False
+    recovery_handles = []
+    for _ in range(40):
+        hs, _ = _window_traffic(queue2, base_rate, 8, flight=flight2)
+        recovery_handles.extend(hs)
+        tick = ctrl.pump() or {}
+        flight2.maybe_sample()
+        restored = all(a.idx == a.tuned_idx for a in actuators)
+        if restored and tick.get("status") == "cool" \
+                and not tick.get("actions"):
+            break
+        time.sleep(0.05)
+    # the error-budget verdict of record: burn states the moment the
+    # controller declares the episode over (cool tick, knobs restored).
+    # Scored HERE — the shadow drain below takes real wall time with no
+    # fresh traffic, so a later evaluate would re-anchor the 0.6 s fast
+    # window onto a sparse mid-recovery sample and re-count spike bads.
+    final_rows = engine2.evaluate()
+    sampler2.drain(timeout_s=60.0)
+    flight2.sample()
+
+    out["recompiles_during_spike"] = serving.scan_trace_count() - traces0
+    out["unexplained_retraces"] = \
+        obs_compile.unexplained_retraces() - unexplained0
+    all_handles = calm_handles + spike_handles + recovery_handles
+    misses = sum(1 for h in all_handles if h.verdict == "deadline")
+    n_ok = sum(1 for h in all_handles if h.verdict == "ok")
+    out["spike_requests"] = len(all_handles)
+    out["spike_deadline_misses"] = misses
+    out["unclassified"] = len(all_handles) - n_ok - misses
+    out["knobs_restored"] = bool(restored)
+
+    crep = ctrl.report() or {}
+    out["controller_actions"] = crep.get("actions", 0)
+    out["controller_nudges"] = crep.get("nudges", 0)
+    out["controller_reverts"] = crep.get("reverts", 0)
+    out["guardrail_holds"] = crep.get("guardrail_holds", 0)
+    out["controller_failures"] = crep.get("failures", 0)
+    out["slo_breach_windows"] = crep.get("breach_ticks", 0)
+
+    # a spike the loop absorbed leaves no SLO in breach once the fast
+    # window clears (zero-tolerance in bench_compare)
+    out["spike_budget_burn"] = sum(
+        1 for r in final_rows.values()
+        if isinstance(r, dict) and r.get("state") == "breach")
+    out["final_slo"] = {
+        name: {"state": row.get("state"),
+               "burn_fast": round(row["burn_fast"], 4)}
+        if "burn_fast" in row else {"state": row.get("state")}
+        for name, row in final_rows.items()}
+
+    # the reconstructible-episode check: every controller action must be
+    # a validating tuning.action event on the flight timeline
+    out["flight_file"] = flight_path
+    out["flight_windows"] = flight2.windows_recorded
+    try:
+        recording = obs_flight.read_recording(flight_path)
+        actions_seen = [
+            e for rec in recording if rec.get("type") == "flight_window"
+            for e in (rec.get("events") or [])
+            if e.get("event") == "tuning.action"]
+        bad = sum(1 for e in actions_seen
+                  if not all(f in e for f in ("knob", "frm", "to",
+                                              "action")))
+        out["tuning_action_events"] = len(actions_seen)
+        out["tuning_action_events_invalid"] = bad
+    except Exception as e:
+        out["flight_error"] = section_error(e)
+
+    # the v6 report with the controller's tuning section must validate
+    final_report = obs_report.collect(
+        engine=engine2, sampler=sampler2, queue=queue2, controller=ctrl)
+    prog.write_artifact(os.path.join("results", "autotune_report.json"),
+                        final_report)
+    out["report_tuning_problems"] = [
+        p for p in obs_report.validate(final_report)
+        if "tuning" in p]
+    if obs.enabled():
+        obs.add("bench.tuning.requests",
+                len(all_handles) + len(windows) * n_req)
     return out
 
 

@@ -47,10 +47,11 @@ __all__ = ["SCHEMA_VERSION", "collect", "export", "main", "render",
 #: 2 = + compile ledger and admission sections (round 11);
 #: 3 = + roofline section (round 15);
 #: 4 = + capacity section, explicit version + window stamps (round 19);
-#: 5 = + maintenance section (round 19 — drift/re-clustering manager).
+#: 5 = + maintenance section (round 19 — drift/re-clustering manager);
+#: 6 = + tuning section (round 21 — burn-rate controller actions).
 #: Records with NO version field are legacy streams: every later section
 #: is lenient-on-absence for them, exactly as before the stamp existed.
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 #: monotonic window id for records collect() stamps itself (a caller-run
 #: windowed sampler — obs/flight.py — passes its own instead)
@@ -96,7 +97,7 @@ def _classified(fn, label: str, out_errors: dict):
 
 
 def collect(engine=None, sampler=None, queue=None, capacity=None,
-            maintenance=None,
+            maintenance=None, controller=None,
             snapshot: Optional[dict] = None,
             extra: Optional[dict] = None,
             window: Optional[int] = None) -> dict:
@@ -170,6 +171,11 @@ def collect(engine=None, sampler=None, queue=None, capacity=None,
             "maintenance": (_classified(maintenance.report, "maintenance",
                                         errors)
                             if maintenance is not None else None),
+            # tuning plane (schema v6): the burn-rate controller's action
+            # ledger — what the online loop DID to the knobs this stream,
+            # and where they sit relative to the tuned operating point
+            "tuning": (_classified(controller.report, "tuning", errors)
+                       if controller is not None else None),
             "verdicts": {
                 **verdicts,
                 "unclassified": int(sum(
@@ -361,6 +367,28 @@ def validate(report: dict,
                     f"maintenance.{key} not a non-negative int: {v!r}")
         if not isinstance(maint.get("recall"), dict):
             problems.append("maintenance section carries no recall record")
+    # tuning plane (schema v6): a populated section must carry integral
+    # action accounting (actions = nudges + reverts — an action that is
+    # neither is an unclassified knob move) and a knob map. Lenient on
+    # absence at every version (None = no controller wired — the
+    # uncontrolled shape), lenient on SHAPE below v6 like maintenance.
+    tun = report.get("tuning")
+    if isinstance(tun, dict) and version >= 6:
+        for key in ("actions", "nudges", "reverts", "holds", "failures"):
+            v = tun.get(key)
+            if not (isinstance(v, int) and v >= 0):
+                problems.append(
+                    f"tuning.{key} not a non-negative int: {v!r}")
+        if isinstance(tun.get("actions"), int) \
+                and isinstance(tun.get("nudges"), int) \
+                and isinstance(tun.get("reverts"), int) \
+                and tun["actions"] != tun["nudges"] + tun["reverts"]:
+            problems.append(
+                f"tuning action ledger inconsistent: actions "
+                f"{tun['actions']} != nudges {tun['nudges']} + reverts "
+                f"{tun['reverts']}")
+        if not isinstance(tun.get("knobs"), dict):
+            problems.append("tuning section carries no knob map")
     return problems
 
 

@@ -55,6 +55,16 @@ from raft_tpu.serving.capacity import (
     TenantRegistry,
     TenantResult,
 )
+from raft_tpu.serving.controller import (
+    CONTROL_INTERVAL_ENV,
+    COOL_WINDOWS_ENV,
+    MAX_ACTIONS_ENV,
+    BurnRateController,
+    KnobActuator,
+    default_control_interval,
+    default_cool_windows,
+    default_max_actions,
+)
 from raft_tpu.serving.compaction import (
     COMPACT_DEADLINE_ENV,
     COMPACT_INTERVAL_ENV,
@@ -124,14 +134,19 @@ def scan_trace_count() -> int:
 
 
 __all__ = [
+    "BurnRateController",
     "COLD",
     "COMPACT_DEADLINE_ENV",
     "COMPACT_INTERVAL_ENV",
     "COMPACT_RATIO_ENV",
+    "CONTROL_INTERVAL_ENV",
+    "COOL_WINDOWS_ENV",
     "CapacityController",
     "CapacityRejected",
     "CompactionManager",
     "HOT",
+    "KnobActuator",
+    "MAX_ACTIONS_ENV",
     "MAINT_DEADLINE_ENV",
     "MAINT_DRIFT_ENV",
     "MAINT_INTERVAL_ENV",
@@ -150,7 +165,10 @@ __all__ = [
     "WINDOW_ENV",
     "default_compact_deadline",
     "default_compact_ratio",
+    "default_control_interval",
+    "default_cool_windows",
     "default_drift_threshold",
+    "default_max_actions",
     "default_maintenance_deadline",
     "default_maintenance_interval",
     "default_max_pairs",

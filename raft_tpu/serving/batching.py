@@ -628,6 +628,16 @@ class QueryQueue:
         """Current adaptive batch-size cap (halved by OOM dispatches)."""
         return self._batch_cap
 
+    def set_batch_cap(self, cap: int) -> int:
+        """Clamp the live dispatch cap — the burn-rate controller's batch
+        actuator (round 21). Never above ``max_batch`` (no new compiled
+        bucket can appear mid-serving), never below 1; returns the cap
+        actually installed. The next ``pump`` dispatches under it."""
+        with self._cv:
+            self._batch_cap = max(1, min(int(cap), self.max_batch))
+            self._cv.notify_all()
+            return self._batch_cap
+
     def knobs(self) -> dict:
         """The queue's live config-knob vector — the serving slice of the
         flight recorder's fingerprint (obs/flight.py). Includes the

@@ -1,0 +1,14 @@
+"""Decision layer over the observability plane (ROADMAP item 2).
+
+``raft_tpu.tuning.autotune`` closes the offline loop: diagnosis-driven
+knob moves over a live serving window (no grid search), a Pareto
+frontier over the accumulated fingerprinted windows, and an emitted
+operating-point JSON the bench sections and serving entry points
+consume. The ONLINE half — the SLO burn-rate controller that nudges
+knobs under live pressure — lives with the thing it controls, in
+``raft_tpu.serving.controller``.
+
+Like ``obs.report``/``obs.flight``, the heavyweight module is deliberately
+NOT imported at package level: ``python -m raft_tpu.tuning.autotune``
+stays clean, and importing :mod:`raft_tpu.tuning` costs nothing.
+"""

@@ -245,6 +245,19 @@ def direction(metric: str) -> str:
     if tail in ("filtered_recall", "hybrid_recall",
                 "filtered_to_unfiltered_qps_ratio"):
         return "up"
+    # autotuning loop (round 21): the tuned operating point's throughput
+    # and recall grow toward good; controller actions during the induced
+    # spike, SLO-breach windows and unexplained diagnoses shrink toward
+    # good (a louder controller or a diagnosis the attribution engine
+    # can't classify is the loop degrading, not the workload); the
+    # post-spike budget burn is caught by the `burn` rule above (down,
+    # zero tolerance below) — an episode that ends with any SLO still in
+    # breach means the controller failed to absorb the spike
+    if tail in ("tuned_qps", "tuned_recall"):
+        return "up"
+    if tail in ("controller_actions", "slo_breach_windows",
+                "unexplained_diagnoses", "calm_actions"):
+        return "down"
     # cost-model accuracy (round 11): the predicted/measured HBM ratio is
     # best AT 1.0 — drift in either direction is the predictor degrading,
     # so the verdict compares |ratio − 1| across rounds ("one" direction);
@@ -329,6 +342,15 @@ _DEFAULT_METRIC_THRESHOLDS = {
     "filtered.ivf_bq.sel10.filtered_recall": 0.01,
     "filtered.ivf_bq.sel01.filtered_recall": 0.01,
     "filtered.hybrid.hybrid_recall": 0.01,
+    # autotuning loop (round 21): a post-spike error-budget burn means the
+    # controller left an SLO in breach — zero tolerance; the calm phase
+    # acting at all is a livelock, likewise zero tolerance; the tuned
+    # recall is a promise of the emitted operating point (1% band, like
+    # the family recalls)
+    "tuning.spike_budget_burn": 0.0,
+    "tuning.calm_actions": 0.0,
+    "tuning.unexplained_diagnoses": 0.0,
+    "tuning.tuned_recall": 0.01,
 }
 
 

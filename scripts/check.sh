@@ -212,6 +212,17 @@ echo "== filter smoke (predicate push-down + widening + hybrid, round 20) =="
 JAX_PLATFORMS=cpu python scripts/filter_smoke.py || fail=1
 
 echo
+echo "== autotune smoke (closed loop: explain -> tuner -> controller, round 21) =="
+# The bench tuning rung end to end on a tiny store: the offline tuner
+# converges on an SLO-meeting operating point in >=3 diagnosed windows
+# (zero unknown/invalid explain records), the point round-trips from
+# disk with provenance, and the induced load spike is absorbed by the
+# burn-rate controller — knobs restored, zero recompiles, zero
+# unclassified residue, final burn states inside the error budget —
+# with every action reconstructible from the flight recording.
+JAX_PLATFORMS=cpu python scripts/autotune_smoke.py || fail=1
+
+echo
 echo "== bench tiny smoke (fused cagra traversal kernel) =="
 RAFT_TPU_BENCH_CHILD=cpu RAFT_TPU_BENCH_TINY=1 RAFT_TPU_BENCH_SECTIONS=cagra \
 RAFT_TPU_BENCH_HEARTBEAT=/tmp/_check_hb.jsonl python - <<'EOF' || fail=1

@@ -32,11 +32,11 @@ echo "$(date) RC=$? : bench.py (results/bench_r5_local.out)" >> "$LOG"
 # 3. DEEP-100M streamed build + search
 run 4200 python scripts/deep100m.py
 # 4. 1M frontier sweep
-run 3600 python -m raft_tpu.bench.runner results/sweep_r5_config.json -o results/sweep_r5.json
+run 3600 python -m raft_tpu.bench.runner results/archive/sweep_r5_config.json -o results/sweep_r5.json
 # 5. CAGRA stage microbench (diagnostics)
 run 1500 python scripts/archive/cagra_stage_micro.py 4096 4
 # 5b. merge-strategy A/B: slack+re-select everywhere vs all-pairs dedup
 run 1800 env RAFT_TPU_CAGRA_DEDUP_LIMIT=0 python scripts/archive/cagra_r5_exp.py results/cagra_r5_exp5_dedup0.jsonl
 # 6. 10M IVF-PQ curve
-run 3600 python -m raft_tpu.bench.runner results/sweep_r5_10m_config.json -o results/sweep_r5_10m.json
+run 3600 python -m raft_tpu.bench.runner results/archive/sweep_r5_10m_config.json -o results/sweep_r5_10m.json
 echo "$(date) pipeline done" >> "$LOG"

@@ -1,0 +1,272 @@
+"""Offline frontier tuner (raft_tpu/tuning/autotune.py): diagnosis-driven
+knob moves, Pareto frontier, operating-point emit/load round-trip, the
+telemetry-off NOOP gate, and the round-7 faultpoint contract on
+``tuning.autotune.window`` (armed oom/hang/fatal skip ONE window
+classified — the loop never dies on a bad window).
+"""
+
+import json
+import time
+
+import pytest
+
+from raft_tpu import obs, resilience
+from raft_tpu.obs import explain as obs_explain
+from raft_tpu.tuning import autotune
+from raft_tpu.tuning.autotune import Autotuner, Knob
+
+
+@pytest.fixture
+def telemetry():
+    obs.reset()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+#: synthetic serving surface: recall/qps as a function of n_probes — the
+#: shape every IVF family shares (recall up, throughput down the ladder)
+_SURFACE = {2: (0.60, 300.0), 4: (0.80, 200.0), 8: (0.96, 120.0)}
+_FLOOR = 0.9
+
+
+def _serve(values):
+    recall, qps = _SURFACE[values["n_probes"]]
+    state = "breach" if recall < _FLOOR else "ok"
+    report = {
+        "t": 1.0, "type": "obs_report", "schema_version": 6, "errors": {},
+        "recall": {"recall": recall, "ci_low": recall - 0.04,
+                   "ci_high": recall + 0.04, "samples": 200},
+        "slo": {"serving_recall": {"kind": "recall", "state": state,
+                                   "target": _FLOOR, "value": recall,
+                                   "burn_fast": 20.0 if state == "breach"
+                                   else 0.0}},
+    }
+    return {"ops": {"qps": qps, "p99_ub_s": 0.01}, "report": report}
+
+
+def _tuner(tmp_path=None, **kw):
+    kw.setdefault("slo", {"p99_s": 0.05, "recall_floor": _FLOOR})
+    kw.setdefault("settle", 2)
+    kw.setdefault("deadline_s", 5.0)
+    return Autotuner(_serve, [Knob("n_probes", [2, 4, 8])], **kw)
+
+
+# ---------------------------------------------------------------------------
+# the loop: diagnosis → rule move → convergence
+# ---------------------------------------------------------------------------
+
+
+def test_rule_table_moves_then_settles(telemetry):
+    tuner = _tuner()
+    stats = tuner.run(max_windows=8)
+    # 2 recall_limited moves up the ladder, then `settle` SLO-meeting holds
+    assert stats["moves"] == 2 and stats["holds"] == 2
+    assert stats["converged"] is True and stats["skipped"] == 0
+    assert stats["knobs"] == {"n_probes": 8}
+    assert stats["windows"] == 4
+    wins = tuner.windows()
+    # every window carries a VALID explain record and its proposal
+    for rec in wins:
+        assert obs_explain.validate(rec["explain"]) == []
+        assert "proposal" in rec and "fingerprint" in rec
+    assert [w["explain"]["primary"] for w in wins[:2]] \
+        == ["recall_limited", "recall_limited"]
+    assert wins[0]["proposal"]["move"] == {"knob": "n_probes",
+                                           "frm": 2, "to": 4}
+    assert wins[-1]["proposal"]["move"] is None
+    assert wins[-1]["proposal"]["meets_slo"] is True
+
+
+def test_rule_table_first_applicable_knob_wins(telemetry):
+    """recall_limited prefers n_probes; a tuner WITHOUT that knob falls
+    through to k_fetch — one table serves every family."""
+    tuner = Autotuner(lambda values: _serve({"n_probes": 2}),
+                      [Knob("k_fetch", [32, 64])],
+                      slo={"recall_floor": _FLOOR}, settle=2)
+    rec = tuner.step()
+    assert rec["proposal"]["move"]["knob"] == "k_fetch"
+
+
+def test_ladder_bound_holds_instead_of_extrapolating(telemetry):
+    """At the top rung with the SLO still failing: no applicable move —
+    the tuner holds (and never converges, because meets_slo is False)."""
+    surface = {8: (0.70, 100.0)}  # recall stuck under the floor
+
+    def serve(values):
+        recall, qps = surface[values["n_probes"]]
+        return {"ops": {"qps": qps, "p99_ub_s": 0.01},
+                "report": {"t": 1.0, "type": "obs_report",
+                           "schema_version": 6, "errors": {},
+                           "recall": {"recall": recall, "ci_high": 0.74},
+                           "slo": {"serving_recall": {
+                               "kind": "recall", "state": "breach",
+                               "target": _FLOOR, "value": recall}}}}
+
+    tuner = Autotuner(serve, [Knob("n_probes", [8])],
+                      slo={"recall_floor": _FLOOR}, settle=2)
+    stats = tuner.run(max_windows=3)
+    assert stats["moves"] == 0 and stats["holds"] == 3
+    assert stats["converged"] is False
+    rec = tuner.windows()[-1]
+    assert rec["proposal"]["reason"] == "no_applicable_knob"
+
+
+def test_missing_measurement_fails_its_slo_bound(telemetry):
+    """Absence of evidence is not compliance: a window with no p99
+    measurement cannot meet a p99 bound."""
+    tuner = Autotuner(
+        lambda values: {"ops": {"qps": 100.0},
+                        "report": _serve({"n_probes": 8})["report"]},
+        [Knob("n_probes", [8])], slo={"p99_s": 0.05}, settle=1)
+    rec = tuner.step()
+    assert rec["proposal"]["meets_slo"] is False
+    assert tuner.converged is False
+
+
+def test_window_without_report_is_unknown_classified(telemetry):
+    tuner = Autotuner(lambda values: {"ops": {"qps": 1.0}},
+                      [Knob("n_probes", [2, 4])], settle=2)
+    rec = tuner.step()
+    assert rec["explain"]["primary"] == "unknown"
+    # unknown maps to NO move: a blind window is a bug, not a knob
+    assert rec["proposal"]["move"] is None
+
+
+# ---------------------------------------------------------------------------
+# frontier + operating point
+# ---------------------------------------------------------------------------
+
+
+def test_frontier_and_operating_point_round_trip(telemetry, tmp_path):
+    tuner = _tuner()
+    tuner.run(max_windows=8)
+    front = tuner.frontier()
+    assert front["points"] == 3  # one group per visited knob vector
+    assert front["pareto_points"] >= 1
+    path = str(tmp_path / "op.json")
+    doc = tuner.emit_operating_point(path=path)
+    # highest-QPS point MEETING the SLO: the top rung (only one ≥ floor)
+    assert doc["meets_slo"] is True
+    assert doc["knobs"]["n_probes"] == 8
+    assert doc["recall"] == pytest.approx(0.96)
+    assert doc["tuned_by"] == "raft_tpu.tuning.autotune"
+    assert doc["type"] == "operating_point" and doc["fp"]
+    loaded = autotune.load_operating_point(path)
+    assert loaded == json.loads(json.dumps(doc))  # disk round-trip
+
+
+def test_emit_flags_point_that_misses_the_slo(telemetry, tmp_path):
+    """No frontier point meets an impossible SLO: the best Pareto point
+    still lands, stamped meets_slo=false — the outcome is on disk either
+    way, and the consumer refuses it."""
+    tuner = _tuner(slo={"p99_s": 0.05, "recall_floor": 0.999})
+    tuner.run(max_windows=6)
+    path = str(tmp_path / "op.json")
+    doc = tuner.emit_operating_point(path=path)
+    assert doc is not None and doc["meets_slo"] is False
+    assert autotune.load_operating_point(path)["meets_slo"] is False
+
+
+def test_load_operating_point_degrades_to_none(tmp_path, monkeypatch):
+    assert autotune.load_operating_point(str(tmp_path / "absent.json")) \
+        is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert autotune.load_operating_point(str(bad)) is None
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"type": "flight_window", "knobs": {}}))
+    assert autotune.load_operating_point(str(wrong)) is None
+    # the env knob aims the default path
+    target = tmp_path / "op_env.json"
+    target.write_text(json.dumps({"type": "operating_point",
+                                  "knobs": {"n_probes": 4}}))
+    monkeypatch.setenv(autotune.OPERATING_POINT_ENV, str(target))
+    assert autotune.load_operating_point()["knobs"] == {"n_probes": 4}
+
+
+def test_env_knob_defaults(monkeypatch):
+    monkeypatch.setenv(autotune.MAX_WINDOWS_ENV, "7")
+    monkeypatch.setenv(autotune.DEADLINE_ENV, "2.5")
+    assert autotune.default_tune_windows() == 7
+    assert autotune.default_tune_deadline() == 2.5
+    monkeypatch.setenv(autotune.MAX_WINDOWS_ENV, "junk")
+    monkeypatch.setenv(autotune.DEADLINE_ENV, "-3")
+    assert autotune.default_tune_windows() == 16
+    assert autotune.default_tune_deadline() == 30.0
+
+
+# ---------------------------------------------------------------------------
+# NOOP gate + faultpoints
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_off_means_zero_tuner_state():
+    assert not obs.enabled()
+    tuner = _tuner()
+    assert tuner.enabled is False
+    assert tuner.step() is None and tuner.run() == {}
+    assert tuner.knob_values() == {} and tuner.windows() == []
+    assert tuner.stats() == {} and tuner.converged is False
+    assert tuner.emit_operating_point() is None
+    assert tuner.frontier()["points"] == 0
+
+
+def test_window_faultpoint_oom_skips_one_window(telemetry):
+    tuner = _tuner()
+    resilience.arm_faults("tuning.autotune.window=oom:1")
+    out = tuner.step()
+    assert out["status"] == resilience.OOM
+    assert tuner.stats()["skipped"] == 1
+    events = [e for e in resilience.recent_events()
+              if e.get("event") == "tuning.window_skipped"]
+    assert events and events[-1]["kind"] == resilience.OOM
+    # fault consumed: the NEXT window serves and diagnoses normally
+    rec = tuner.step()
+    assert rec.get("status") is None and "explain" in rec
+    assert tuner.stats()["windows"] == 1
+
+
+def test_window_faultpoint_fatal_skips_classified(telemetry):
+    tuner = _tuner()
+    resilience.arm_faults("tuning.autotune.window=fatal:1")
+    assert tuner.step()["status"] == resilience.FATAL
+    stats = tuner.run(max_windows=8)
+    assert stats["converged"] is True and stats["skipped"] == 1
+
+
+def test_window_deadline_bounds_injected_hang(telemetry):
+    tuner = _tuner(deadline_s=0.3)
+    resilience.arm_faults("tuning.autotune.window=hang:1")
+    t0 = time.perf_counter()
+    out = tuner.step()
+    assert time.perf_counter() - t0 < 10.0
+    assert out["status"] == resilience.DEADLINE
+    assert tuner.step().get("status") is None  # healthy again
+
+
+# ---------------------------------------------------------------------------
+# knob ladder semantics
+# ---------------------------------------------------------------------------
+
+
+def test_knob_ladder_validation_and_moves():
+    with pytest.raises(ValueError, match="empty ladder"):
+        Knob("x", [])
+    with pytest.raises(ValueError, match="not on its ladder"):
+        Knob("x", [1, 2], start=3)
+    k = Knob("x", [1, 2, 4], start=2)
+    assert k.value == 2 and k.can(+1) and k.can(-1)
+    assert k.apply(+1) == (2, 4)
+    assert not k.can(+1)  # top rung: no extrapolation
+    assert k.apply(-1) == (4, 2)

@@ -393,3 +393,73 @@ def test_maintenance_plane_improvements_not_regressions(tmp_path):
     assert rows["maintenance.recall_estimate"] == "improved"
     assert rows["maintenance.stale_aborts"] == "·"
     assert "regression" not in rows.values(), proc.stdout
+
+
+def test_tuning_plane_direction_rules(tmp_path):
+    """Round 21 (ISSUE 20 satellite): the tuned operating point's
+    throughput and recall gate UPWARD; controller actions, SLO-breach
+    windows and unexplained diagnoses gate DOWNWARD (a louder controller
+    or an unclassifiable diagnosis is the loop degrading); the post-spike
+    `spike_budget_burn` is zero-tolerance — one SLO left in breach after
+    the induced spike is the controller failing its one job."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"tuning": {"tuned_qps": 600.0,
+                                 "tuned_recall": 0.95,
+                                 "frontier_points": 3,
+                                 "controller_actions": 4,
+                                 "slo_breach_windows": 6,
+                                 "unexplained_diagnoses": 0,
+                                 "spike_budget_burn": 0}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"tuning": {"tuned_qps": 400.0,
+                                 "tuned_recall": 0.88,
+                                 "frontier_points": 1,
+                                 "controller_actions": 11,
+                                 "slo_breach_windows": 25,
+                                 "unexplained_diagnoses": 2,
+                                 "spike_budget_burn": 1}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["tuning.tuned_qps"] == "regression"
+    assert rows["tuning.tuned_recall"] == "regression"
+    assert rows["tuning.frontier_points"] == "regression"
+    assert rows["tuning.controller_actions"] == "regression"
+    assert rows["tuning.slo_breach_windows"] == "regression"
+    # both from-zero transitions: direction still decides (down), and the
+    # budget burn's zero-tolerance threshold makes ANY burn a row
+    assert rows["tuning.unexplained_diagnoses"] == "regression"
+    assert rows["tuning.spike_budget_burn"] == "regression"
+
+
+def test_tuning_plane_improvements_not_regressions(tmp_path):
+    """Both polarities pinned: a faster/higher-recall tuned point, a
+    growing frontier, a quieter controller and a clean budget must render
+    as improvements, never regressions."""
+    a = _driver_file(tmp_path, "a.json",
+                     {"tuning": {"tuned_qps": 400.0,
+                                 "tuned_recall": 0.88,
+                                 "frontier_points": 1,
+                                 "controller_actions": 11,
+                                 "slo_breach_windows": 25,
+                                 "unexplained_diagnoses": 2,
+                                 "spike_budget_burn": 1}}, 1000.0)
+    b = _driver_file(tmp_path, "b.json",
+                     {"tuning": {"tuned_qps": 600.0,
+                                 "tuned_recall": 0.95,
+                                 "frontier_points": 3,
+                                 "controller_actions": 4,
+                                 "slo_breach_windows": 6,
+                                 "unexplained_diagnoses": 0,
+                                 "spike_budget_burn": 0}}, 1000.0)
+    proc = _run(a, b)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = _verdict_rows(proc.stdout)
+    assert rows["tuning.tuned_qps"] == "improved"
+    assert rows["tuning.tuned_recall"] == "improved"
+    assert rows["tuning.frontier_points"] == "improved"
+    assert rows["tuning.controller_actions"] == "improved"
+    assert rows["tuning.slo_breach_windows"] == "improved"
+    assert rows["tuning.unexplained_diagnoses"] == "improved"
+    assert rows["tuning.spike_budget_burn"] == "improved"
+    assert "regression" not in rows.values(), proc.stdout

@@ -1,0 +1,304 @@
+"""Burn-rate controller (raft_tpu/serving/controller.py): burn-driven
+nudges, Wilson-CI recall guardrail, cool-window hysteresis + reverts,
+the per-tick action bound, every action a ``tuning.action`` event, the
+telemetry-off NOOP gate, the v6 report section, and the round-7
+faultpoint contract on ``serving.controller.tick`` (armed oom/hang/fatal
+skip the tick classified — serving never wedges on its controller).
+"""
+
+import time
+
+import pytest
+
+from raft_tpu import obs, resilience, serving
+from raft_tpu.obs import report as obs_report
+from raft_tpu.resilience.retry import clear_events, recent_events
+from raft_tpu.serving import BurnRateController, KnobActuator
+
+
+@pytest.fixture
+def telemetry():
+    obs.reset()
+    clear_events()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+class _FakeEngine:
+    """Scripted SloEngine stand-in: evaluate() returns the next row set
+    (sticking on the last one)."""
+
+    slos = ()
+
+    def __init__(self, *rows):
+        self._rows = list(rows)
+
+    def evaluate(self):
+        return self._rows.pop(0) if len(self._rows) > 1 else self._rows[0]
+
+
+def _hot(state="breach"):
+    return {"serving_p99": {"kind": "latency", "state": state,
+                            "burn_fast": 30.0}}
+
+
+def _cool():
+    return {"serving_p99": {"kind": "latency", "state": "ok",
+                            "burn_fast": 0.0}}
+
+
+def _recall_burn():
+    return {"serving_recall": {"kind": "recall", "state": "breach",
+                               "burn_fast": 30.0}}
+
+
+class _FakeSampler:
+    def __init__(self, ci_low):
+        self.ci_low = ci_low
+
+    def estimate(self):
+        return {"recall": 0.95, "ci_low": self.ci_low, "ci_high": 0.99}
+
+
+def _setup(engine, *, live=None, sampler=None, floor=None, **kw):
+    live = live if live is not None else {"n_probes": 8, "cap": 16}
+    acts = [
+        KnobActuator("n_probes", [2, 4, 8],
+                     lambda: live["n_probes"],
+                     lambda v: live.__setitem__("n_probes", v),
+                     costs_recall=True),
+        KnobActuator("cap", [4, 8, 16],
+                     lambda: live["cap"],
+                     lambda v: live.__setitem__("cap", v)),
+    ]
+    kw.setdefault("max_actions", 1)
+    kw.setdefault("cool_windows", 2)
+    kw.setdefault("deadline_s", 5.0)
+    ctrl = BurnRateController(engine, acts, sampler=sampler,
+                              recall_floor=floor, **kw)
+    return ctrl, live
+
+
+# ---------------------------------------------------------------------------
+# nudges, guardrail, hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_hot_tick_nudges_first_actuator_one_rung(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()))
+    tick = ctrl.pump()
+    assert tick["status"] == "hot"
+    assert tick["actions"] == [{"knob": "n_probes", "frm": 8, "to": 4,
+                                "action": "nudge",
+                                "reason": "serving_p99"}]
+    assert live == {"n_probes": 4, "cap": 16}
+    rep = ctrl.report()
+    assert rep["nudges"] == 1 and rep["breach_ticks"] == 1
+    # the reconstructible episode: the move IS a ring event
+    ev = [e for e in recent_events() if e.get("event") == "tuning.action"]
+    assert ev[-1]["knob"] == "n_probes" and ev[-1]["action"] == "nudge"
+    assert ev[-1]["frm"] == 8 and ev[-1]["to"] == 4
+
+
+def test_max_actions_bounds_moves_per_tick(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()), max_actions=2)
+    tick = ctrl.pump()
+    assert len(tick["actions"]) == 2
+    assert live["n_probes"] == 2  # two rungs down the same cheapest knob
+    ctrl2, live2 = _setup(_FakeEngine(_hot()), max_actions=1)
+    assert len(ctrl2.pump()["actions"]) == 1
+
+
+def test_guardrail_blocks_recall_costing_knob(telemetry):
+    """ci_low at/under the floor: the n_probes nudge is forbidden — the
+    controller spends the batch cap instead and counts the hold."""
+    ctrl, live = _setup(_FakeEngine(_hot()),
+                        sampler=_FakeSampler(ci_low=0.85), floor=0.9)
+    tick = ctrl.pump()
+    assert tick["actions"][0]["knob"] == "cap"
+    assert live == {"n_probes": 8, "cap": 8}
+    assert ctrl.report()["guardrail_holds"] == 1
+    ev = [e for e in recent_events()
+          if e.get("event") == "tuning.guardrail_hold"]
+    assert ev and ev[-1]["knob"] == "n_probes"
+
+
+def test_guardrail_open_with_ci_above_floor(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()),
+                        sampler=_FakeSampler(ci_low=0.93), floor=0.9)
+    assert ctrl.pump()["actions"][0]["knob"] == "n_probes"
+    assert ctrl.report()["guardrail_holds"] == 0
+
+
+def test_guardrail_blindness_is_not_permission(telemetry):
+    """A floor with NO sampler (or a broken estimate) guards every
+    recall-costing move: you cannot spend what you cannot see."""
+    ctrl, live = _setup(_FakeEngine(_hot()), floor=0.9)  # no sampler
+    assert ctrl.pump()["actions"][0]["knob"] == "cap"
+
+    class Broken:
+        def estimate(self):
+            raise RuntimeError("shadow down")
+
+    ctrl2, live2 = _setup(_FakeEngine(_hot()), sampler=Broken(), floor=0.9)
+    assert ctrl2.pump()["actions"][0]["knob"] == "cap"
+
+
+def test_cool_hysteresis_then_revert_toward_tuned(telemetry):
+    """One nudge under burn, then cool traffic: the first cool tick
+    HOLDS (streak 1 < cool_windows 2), the second reverts one rung back
+    toward the tuned point, and once restored the controller holds."""
+    ctrl, live = _setup(_FakeEngine(_hot(), _cool()), cool_windows=2)
+    assert ctrl.pump()["actions"]  # nudge: n_probes 8 → 4
+    t1 = ctrl.pump()
+    assert t1["status"] == "cool" and t1["actions"] == []
+    t2 = ctrl.pump()
+    assert t2["actions"] == [{"knob": "n_probes", "frm": 4, "to": 8,
+                              "action": "revert", "reason": "cool"}]
+    assert live["n_probes"] == 8
+    # restored: further cool ticks are pure holds
+    t3 = ctrl.pump()
+    t4 = ctrl.pump()
+    assert t3["actions"] == [] and t4["actions"] == []
+    rep = ctrl.report()
+    assert rep["nudges"] == 1 and rep["reverts"] == 1
+    assert rep["knobs"] == rep["tuned"]
+
+
+def test_hot_tick_resets_cool_streak(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot(), _cool(), _hot("warn"),
+                                    _cool()), cool_windows=2)
+    ctrl.pump()                     # nudge
+    ctrl.pump()                     # cool streak 1
+    assert ctrl.pump()["status"] == "hot"  # warn burns: streak resets
+    assert ctrl.pump()["actions"] == []    # cool streak 1 again — no revert
+    assert live["n_probes"] == 2           # warm tick nudged 4 → 2
+
+
+def test_recall_burn_reverts_immediately_without_hysteresis(telemetry):
+    """A burning recall SLO re-raises a recall-costing knob sitting
+    below its tuned rung on THIS tick — the one move class exempt from
+    the cool streak."""
+    ctrl, live = _setup(_FakeEngine(_hot(), _recall_burn()))
+    ctrl.pump()  # n_probes 8 → 4
+    tick = ctrl.pump()
+    assert tick["status"] == "cool" and tick["recall_burn"]
+    assert tick["actions"] == [{"knob": "n_probes", "frm": 4, "to": 8,
+                                "action": "revert",
+                                "reason": "serving_recall"}]
+    assert live["n_probes"] == 8
+
+
+def test_actuator_floor_never_stepped_past(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()), max_actions=10)
+    tick = ctrl.pump()
+    # both ladders walked to their floors, then the tick ran out of moves
+    assert live == {"n_probes": 2, "cap": 4}
+    assert len(tick["actions"]) == 4
+    assert ctrl.pump()["actions"] == []  # everything at its floor: hold
+    assert ctrl.report()["holds"] == 1
+
+
+def test_actuator_validates_live_value_on_ladder():
+    with pytest.raises(ValueError, match="empty ladder"):
+        KnobActuator("x", [], lambda: 1, lambda v: None)
+    with pytest.raises(ValueError, match="not on its ladder"):
+        KnobActuator("x", [1, 2], lambda: 9, lambda v: None)
+
+
+# ---------------------------------------------------------------------------
+# report section (schema v6) + NOOP gate + faultpoints
+# ---------------------------------------------------------------------------
+
+
+def test_report_rides_obs_report_v6_and_validates(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot(), _cool()))
+    ctrl.pump()
+    rec = obs_report.collect(controller=ctrl)
+    tun = rec["tuning"]
+    assert tun["actions"] == tun["nudges"] + tun["reverts"] == 1
+    assert tun["knobs"] == {"n_probes": 4, "cap": 16}
+    assert tun["tuned"] == {"n_probes": 8, "cap": 16}
+    assert not [p for p in obs_report.validate(rec) if "tuning" in p]
+    # no controller ⇒ a None section, still valid
+    rec2 = obs_report.collect()
+    assert rec2["tuning"] is None
+    assert not [p for p in obs_report.validate(rec2) if "tuning" in p]
+
+
+def test_telemetry_off_means_zero_controller_state():
+    assert not obs.enabled()
+    ctrl, live = _setup(_FakeEngine(_hot()))
+    assert ctrl.enabled is False
+    assert ctrl.pump() is None and ctrl.tick() is None
+    assert ctrl.report() is None and ctrl.stats() is None
+    ctrl.start()
+    ctrl.stop()
+    assert live == {"n_probes": 8, "cap": 16}  # never touched
+
+
+def test_tick_faultpoint_oom_skips_classified(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()))
+    resilience.arm_faults("serving.controller.tick=oom:1")
+    tick = ctrl.pump()
+    assert tick == {"status": resilience.OOM, "actions": []}
+    assert live == {"n_probes": 8, "cap": 16}  # faulted tick moved nothing
+    rep = ctrl.report()
+    assert rep["failures"] == 1 and rep["last_status"] == resilience.OOM
+    ev = [e for e in recent_events() if e.get("event") == "tuning.tick_error"]
+    assert ev and ev[-1]["kind"] == resilience.OOM
+    # fault consumed: the next tick nudges normally
+    assert ctrl.pump()["actions"]
+
+
+def test_tick_faultpoint_fatal_never_wedges_serving(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()))
+    resilience.arm_faults("serving.controller.tick=fatal:1")
+    assert ctrl.pump()["status"] == resilience.FATAL
+    assert ctrl.pump()["status"] == "hot"
+
+
+def test_tick_deadline_bounds_injected_hang(telemetry):
+    ctrl, live = _setup(_FakeEngine(_hot()), deadline_s=0.3)
+    resilience.arm_faults("serving.controller.tick=hang:1")
+    t0 = time.perf_counter()
+    tick = ctrl.pump()
+    assert time.perf_counter() - t0 < 10.0
+    assert tick["status"] == resilience.DEADLINE
+    assert ctrl.pump()["status"] == "hot"
+
+
+def test_engine_recall_floor_default(telemetry):
+    """No explicit floor: the engine's recall SLO target is the floor."""
+
+    class _Slo:
+        kind = "recall"
+        target = 0.92
+
+    class _Eng(_FakeEngine):
+        slos = (_Slo(),)
+
+    ctrl, live = _setup(_Eng(_cool()), floor=None)
+    assert ctrl.recall_floor == pytest.approx(0.92)
+    ctrl2, live2 = _setup(_FakeEngine(_cool()), floor=None)
+    assert ctrl2.recall_floor is None
+
+
+def test_serving_package_exports_controller():
+    assert serving.BurnRateController is BurnRateController
+    assert serving.KnobActuator is KnobActuator
+    assert serving.MAX_ACTIONS_ENV == "RAFT_TPU_TUNE_MAX_ACTIONS"
+    assert serving.default_max_actions() == 1
+    assert serving.default_cool_windows() == 2
+    assert serving.default_control_interval() == 1.0

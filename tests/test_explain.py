@@ -1,0 +1,244 @@
+"""Attribution engine (obs/explain.py): hand-built report records must
+fold into the EXPECTED ranked diagnosis — one table row per kind, plus
+the degraded→unknown path and malformed records through ``validate()``.
+
+The tuner keys knob moves off ``primary`` and the controller stamps it
+into every ``tuning.action`` event, so these polarities are contracts:
+a detector drifting to a different kind silently re-aims the whole
+closed loop.
+"""
+
+import pytest
+
+from raft_tpu.obs import explain as obs_explain
+
+
+def _report(**sections):
+    """A minimal obs_report record; sections override/extend the base."""
+    base = {"t": 1.0, "type": "obs_report", "schema_version": 6,
+            "window": 3, "errors": {}}
+    base.update(sections)
+    return base
+
+
+def _kinds(rec):
+    return [d["kind"] for d in rec["diagnoses"]]
+
+
+# ---------------------------------------------------------------------------
+# one table row per diagnosis kind
+# ---------------------------------------------------------------------------
+
+
+def test_mxu_underfill_on_compute_bound_idle_mxu():
+    rec = obs_explain.explain(_report(roofline={"entries": {
+        "ivf_flat::scan": {"bound": "compute", "mxu_utilization": 0.2,
+                           "measured_s": 0.5, "dispatches": 4,
+                           "occupancy": {"tile_fill": 0.4,
+                                         "mxu_m_fill": 0.25}}}}))
+    assert rec["primary"] == "mxu_underfill"
+    d = rec["diagnoses"][0]
+    assert d["score"] == pytest.approx(0.8)
+    assert d["evidence"]["entry"] == "ivf_flat::scan"
+    assert d["evidence"]["tile_fill"] == 0.4
+    assert obs_explain.validate(rec) == []
+
+
+def test_hbm_bound_on_memory_bound_entry():
+    rec = obs_explain.explain(_report(roofline={"entries": {
+        "scan": {"bound": "memory", "hbm_bw_utilization": 0.9,
+                 "mxu_utilization": 0.1, "bytes": 1 << 30,
+                 "measured_s": 0.5, "dispatches": 4}}}))
+    assert rec["primary"] == "hbm_bound"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.9)
+    assert obs_explain.validate(rec) == []
+
+
+def test_padding_waste_on_padded_dispatches():
+    # compute-bound with a FULL MXU: the only defect is the dead rows
+    rec = obs_explain.explain(_report(roofline={"entries": {
+        "scan": {"bound": "compute", "mxu_utilization": 0.9,
+                 "padded_fraction": 0.6, "measured_s": 0.5,
+                 "dispatches": 4}}}))
+    assert rec["primary"] == "padding_waste"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.6)
+    assert obs_explain.validate(rec) == []
+
+
+def test_recall_limited_on_burning_recall_slo():
+    rec = obs_explain.explain(_report(slo={
+        "serving_recall": {"kind": "recall", "state": "breach",
+                           "target": 0.9, "value": 0.5,
+                           "burn_fast": 20.0}}))
+    assert rec["primary"] == "recall_limited"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.9)
+    assert rec["pressure"] == {"serving_recall": "breach"}
+    assert rec["healthy"] is False
+    assert obs_explain.validate(rec) == []
+
+
+def test_recall_limited_on_ci_under_floor_without_burn():
+    """The CI branch: the SLO row is quiet but the Wilson interval's
+    upper bound sits UNDER the floor — the estimate itself rules out
+    compliance."""
+    rec = obs_explain.explain(_report(
+        slo={"serving_recall": {"kind": "recall", "state": "ok",
+                                "target": 0.9}},
+        recall={"recall": 0.6, "ci_low": 0.52, "ci_high": 0.7,
+                "samples": 120}))
+    assert rec["primary"] == "recall_limited"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.7)
+    assert rec["diagnoses"][0]["evidence"]["ci_high"] == 0.7
+    assert rec["healthy"] is True  # no SLO pressure — still diagnosable
+    assert obs_explain.validate(rec) == []
+
+
+def test_queue_limited_on_backlog_behind_cap():
+    rec = obs_explain.explain(_report(queue={
+        "depth": 40, "batch_cap": 8, "requeued": 2}))
+    assert rec["primary"] == "queue_limited"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(40 / 64)
+    assert obs_explain.validate(rec) == []
+
+
+def test_queue_below_depth_ratio_is_not_a_diagnosis():
+    rec = obs_explain.explain(_report(queue={"depth": 8, "batch_cap": 8}))
+    assert rec["diagnoses"] == [] and rec["primary"] is None
+
+
+def test_capacity_limited_on_admission_denials():
+    rec = obs_explain.explain(_report(admission={
+        "admit": 2, "queue": 5, "reject": 3}))
+    assert rec["primary"] == "capacity_limited"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.8)
+    assert rec["diagnoses"][0]["evidence"] == {
+        "queued": 5, "rejected": 3, "admitted": 2}
+    assert obs_explain.validate(rec) == []
+
+
+def test_capacity_counters_delta_against_prev_window():
+    """Admission counters are cumulative: with a prev report the window-
+    local delta is the evidence, so an old backlog stops re-diagnosing."""
+    prev = _report(admission={"admit": 10, "queue": 5, "reject": 3})
+    cur = _report(admission={"admit": 30, "queue": 5, "reject": 3})
+    rec = obs_explain.explain(cur, prev=prev)
+    # no NEW denials this window: capacity_limited must not fire
+    assert all(d["kind"] != "capacity_limited" for d in rec["diagnoses"])
+
+
+def test_retrace_tax_on_unexplained_retrace():
+    rec = obs_explain.explain(_report(compile={
+        "unexplained_retraces": 1, "total_traces": 10}))
+    assert rec["primary"] == "retrace_tax"
+    assert rec["diagnoses"][0]["score"] == 1.0
+    assert obs_explain.validate(rec) == []
+
+
+def test_retrace_tax_on_window_trace_delta():
+    prev = _report(compile={"unexplained_retraces": 0, "total_traces": 5})
+    cur = _report(compile={"unexplained_retraces": 0, "total_traces": 8})
+    rec = obs_explain.explain(cur, prev=prev)
+    assert rec["primary"] == "retrace_tax"
+    assert rec["diagnoses"][0]["score"] == pytest.approx(0.8)
+    assert rec["diagnoses"][0]["evidence"]["traces_this_window"] == 3
+    # same cumulative count next window: the tax is paid, not re-billed
+    rec2 = obs_explain.explain(cur, prev=cur)
+    assert all(d["kind"] != "retrace_tax" for d in rec2["diagnoses"])
+
+
+# ---------------------------------------------------------------------------
+# unknown / healthy
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_evidence_section_diagnoses_unknown():
+    rec = obs_explain.explain(_report(errors={"roofline": "OOM: boom"}))
+    assert rec["primary"] == "unknown"
+    assert rec["healthy"] is False
+    assert rec["diagnoses"][0]["evidence"]["degraded"] == {
+        "roofline": "OOM: boom"}
+    assert obs_explain.validate(rec) == []
+
+
+def test_pressure_without_evidence_is_unknown_not_silent():
+    rec = obs_explain.explain(_report(slo={
+        "serving_p99": {"kind": "latency", "state": "warn",
+                        "burn_fast": 30.0}}))
+    assert rec["primary"] == "unknown"
+    assert rec["diagnoses"][0]["evidence"]["burning"] == {
+        "serving_p99": "warn"}
+    assert obs_explain.validate(rec) == []
+
+
+def test_healthy_window_yields_empty_diagnosis_not_unknown():
+    """The acceptance gate counts `unknown` on a healthy window as a
+    failure of the module: clean sections ⇒ healthy=True, primary=None,
+    NO diagnoses."""
+    rec = obs_explain.explain(_report(
+        slo={"serving_p99": {"kind": "latency", "state": "ok",
+                             "burn_fast": 0.0}},
+        queue={"depth": 0, "batch_cap": 8},
+        compile={"unexplained_retraces": 0, "total_traces": 4},
+        admission={"admit": 9, "queue": 0, "reject": 0}))
+    assert rec["healthy"] is True
+    assert rec["primary"] is None and rec["diagnoses"] == []
+    assert obs_explain.validate(rec) == []
+
+
+def test_non_evidence_section_error_does_not_blind():
+    """Only _EVIDENCE_SECTIONS degradation blinds the attribution — a
+    broken memory section must not turn a clean window unknown."""
+    rec = obs_explain.explain(_report(errors={"memory": "boom"}))
+    assert rec["healthy"] is True and rec["diagnoses"] == []
+
+
+# ---------------------------------------------------------------------------
+# ranking + malformed inputs
+# ---------------------------------------------------------------------------
+
+
+def test_diagnoses_ranked_by_score_and_primary_is_top():
+    rec = obs_explain.explain(_report(
+        compile={"unexplained_retraces": 2, "total_traces": 9},   # 1.0
+        queue={"depth": 24, "batch_cap": 8},                      # 0.375
+        admission={"admit": 2, "queue": 5, "reject": 3}))         # 0.8
+    assert _kinds(rec) == ["retrace_tax", "capacity_limited",
+                           "queue_limited"]
+    assert rec["primary"] == "retrace_tax"
+    scores = [d["score"] for d in rec["diagnoses"]]
+    assert scores == sorted(scores, reverse=True)
+    assert obs_explain.validate(rec) == []
+
+
+def test_explain_rejects_non_report_input():
+    with pytest.raises(ValueError, match="obs_report"):
+        obs_explain.explain({"type": "flight_window"})
+    with pytest.raises(ValueError):
+        obs_explain.explain(None)
+
+
+def test_validate_flags_malformed_records():
+    assert obs_explain.validate({"type": "nope"}) \
+        == ["not an explain record: dict"]
+    bad = {
+        "type": "explain", "schema_version": 99, "healthy": True,
+        "primary": "hbm_bound",
+        "diagnoses": [
+            {"kind": "made_up", "score": 2.0},            # kind + score + evidence
+            {"kind": "unknown", "score": 0.4, "evidence": {}},
+            {"kind": "queue_limited", "score": 0.9,       # out of rank order
+             "evidence": {}},
+        ],
+    }
+    problems = obs_explain.validate(bad)
+    assert any("schema_version" in p for p in problems)
+    assert any("kind unknown" in p for p in problems)
+    assert any("score" in p for p in problems)
+    assert any("evidence" in p for p in problems)
+    assert any("not ranked" in p for p in problems)
+    assert any("primary" in p for p in problems)
+    assert any("unknown diagnosis on a healthy window" in p
+               for p in problems)
+    assert obs_explain.validate({"type": "explain", "schema_version": 1,
+                                 "diagnoses": "x"}) \
+        == ["diagnoses is not a list"]
